@@ -1,0 +1,22 @@
+"""Test harness: CPU-simulated 8-device mesh (SURVEY.md §4.3).
+
+The reference tests against a dockerized single-node Flyte sandbox
+(reference: tests/integration/test_flyte_remote.py:33-57); the TPU-native
+equivalent is the JAX CPU backend with a forced 8-device host platform so
+DP/FSDP/TP/SP sharding is exercised without hardware. Env must be set
+before the first jax import, hence at conftest import time.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# keep stage caches inside the test tmp area, not the user cache
+os.environ.setdefault("UNIONML_TPU_CACHE_DIR", "/tmp/unionml_tpu_test_cache")
+
+# The env var JAX_PLATFORMS can be overridden by pre-registered TPU plugins
+# (e.g. the axon tunnel); the config API takes precedence over both.
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,52 @@
+"""Shared fixtures (reference: tests/unit/conftest.py + model_fixtures.py)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from unionml_tpu import Dataset, Model
+
+
+@pytest.fixture
+def mock_data() -> pd.DataFrame:
+    """Synthetic 100-row frame (reference: tests/unit/model_fixtures.py:12-20)."""
+    rng = np.random.default_rng(42)
+    return pd.DataFrame(
+        {
+            "x": rng.normal(size=100),
+            "x2": rng.normal(size=100),
+            "y": rng.integers(0, 2, size=100),
+        }
+    )
+
+
+@pytest.fixture
+def dataset(mock_data) -> Dataset:
+    ds = Dataset(name="test_dataset", features=["x", "x2"], targets=["y"], test_size=0.2, shuffle=True, random_state=99)
+
+    @ds.reader
+    def reader(sample_frac: float = 1.0, random_state: int = 123) -> pd.DataFrame:
+        return mock_data.sample(frac=sample_frac, random_state=random_state)
+
+    return ds
+
+
+@pytest.fixture
+def model(dataset) -> Model:
+    from sklearn.linear_model import LogisticRegression
+
+    model = Model(name="test_model", init=LogisticRegression, dataset=dataset)
+
+    @model.trainer
+    def trainer(m: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> LogisticRegression:
+        return m.fit(features, target.squeeze())
+
+    @model.predictor
+    def predictor(m: LogisticRegression, features: pd.DataFrame) -> list:
+        return [float(x) for x in m.predict(features)]
+
+    @model.evaluator
+    def evaluator(m: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> float:
+        return float(m.score(features, target.squeeze()))
+
+    return model

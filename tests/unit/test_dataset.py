@@ -1,0 +1,168 @@
+"""Dataset spec tests (reference: tests/unit/test_dataset.py)."""
+
+import json
+from typing import Dict, List, NamedTuple, Tuple
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from unionml_tpu import Dataset
+from unionml_tpu.dataset import ReaderReturnTypeSource
+from unionml_tpu.stage import Stage
+
+
+def test_reader_compiles_to_stage(dataset):
+    task = dataset.dataset_task()
+    assert isinstance(task, Stage)
+    assert task.name == "test_dataset.reader"
+    assert "sample_frac" in task.input_types
+    assert task.output_type is pd.DataFrame
+    # direct-callable: the local executor doubles as the test fake
+    out = task(sample_frac=1.0, random_state=123)
+    assert isinstance(out, pd.DataFrame)
+    assert len(out) == 100
+
+
+def test_reader_requires_return_annotation():
+    ds = Dataset(name="bad")
+    with pytest.raises(TypeError):
+
+        @ds.reader
+        def reader():
+            return pd.DataFrame()
+
+
+def test_get_data_default_pipeline(dataset):
+    raw = dataset.dataset_task()(sample_frac=1.0, random_state=123)
+    data = dataset.get_data(raw)
+    assert set(data) == {"train", "test"}
+    X_train, y_train = data["train"]
+    X_test, y_test = data["test"]
+    assert list(X_train.columns) == ["x", "x2"]
+    assert list(y_train.columns) == ["y"]
+    assert len(X_train) == 80 and len(X_test) == 20
+    # deterministic splits under fixed random_state
+    data2 = dataset.get_data(raw)
+    pd.testing.assert_frame_equal(data["train"][0], data2["train"][0])
+
+
+def test_custom_splitter_parser_over_list_dict():
+    """Custom splitter/parser over List[Dict] data
+    (reference: tests/unit/test_dataset.py:80-115)."""
+    ds = Dataset(name="listdict", targets=["y"])
+
+    @ds.reader
+    def reader() -> List[Dict]:
+        return [{"x": float(i), "y": i % 2} for i in range(10)]
+
+    @ds.splitter
+    def splitter(data: List[Dict], test_size: float, shuffle: bool, random_state: int):
+        k = int(len(data) * (1 - test_size))
+        return data[:k], data[k:]
+
+    Parsed = Tuple[List[List[float]], List[int]]
+
+    @ds.parser
+    def parser(data: List[Dict], features, targets) -> Parsed:
+        return [[d["x"]] for d in data], [d["y"] for d in data]
+
+    data = ds.get_data(reader())
+    X_train, y_train = data["train"]
+    assert X_train == [[float(i)] for i in range(8)]
+    assert y_train == [i % 2 for i in range(8)]
+    assert len(data["test"][0]) == 2
+
+
+def test_custom_loader_json_str():
+    """JSON-string reader + custom loader (reference: tests/unit/test_dataset.py:118-126)."""
+    ds = Dataset(name="jsonds", features=["a"], targets=["b"])
+
+    @ds.reader
+    def reader() -> str:
+        return json.dumps([{"a": 1.0, "b": 0}, {"a": 2.0, "b": 1}, {"a": 3.0, "b": 0}])
+
+    @ds.loader
+    def loader(data: str) -> pd.DataFrame:
+        return pd.DataFrame.from_records(json.loads(data))
+
+    assert ds.dataset_datatype_source is ReaderReturnTypeSource.LOADER
+    assert ds.dataset_datatype["data"] is pd.DataFrame
+    data = ds.get_data(reader(), splitter_kwargs={"test_size": 0.34, "shuffle": False})
+    assert len(data["train"][0]) == 2
+
+
+def test_feature_pipeline_defaults(dataset):
+    feats = dataset.get_features([{"x": 1.0, "x2": 2.0}])
+    assert isinstance(feats, pd.DataFrame)
+    assert list(feats.columns) == ["x", "x2"]
+    # JSON string path
+    feats2 = dataset.get_features(json.dumps([{"x": 1.0, "x2": 2.0}]))
+    pd.testing.assert_frame_equal(feats, feats2)
+
+
+def test_feature_pipeline_custom():
+    ds = Dataset(name="custom_feat")
+
+    @ds.reader
+    def reader() -> np.ndarray:
+        return np.ones((4, 2))
+
+    @ds.feature_loader
+    def feature_loader(raw) -> np.ndarray:
+        return np.asarray(raw, dtype=np.float32)
+
+    @ds.feature_transformer
+    def feature_transformer(x: np.ndarray) -> np.ndarray:
+        return x / 2.0
+
+    out = ds.get_features([[2.0, 4.0]])
+    np.testing.assert_allclose(out, [[1.0, 2.0]])
+
+
+def test_kwargs_dataclass_synthesis(dataset):
+    sk = dataset.splitter_kwargs_type()
+    assert sk.test_size == 0.2 and sk.shuffle is True and sk.random_state == 99
+    pk = dataset.parser_kwargs_type()
+    assert pk.features == ["x", "x2"] and pk.targets == ["y"]
+
+
+def test_stage_caching(tmp_path, monkeypatch):
+    monkeypatch.setenv("UNIONML_TPU_CACHE_DIR", str(tmp_path))
+    calls = {"n": 0}
+    ds = Dataset(name="cached")
+
+    @ds.reader(cache=True, cache_version="1")
+    def reader(n: int = 3) -> List[float]:
+        calls["n"] += 1
+        return [float(i) for i in range(n)]
+
+    task = ds.dataset_task()
+    assert task(n=3) == [0.0, 1.0, 2.0]
+    assert task(n=3) == [0.0, 1.0, 2.0]
+    assert calls["n"] == 1  # second call served from cache
+    assert task(n=4) == [0.0, 1.0, 2.0, 3.0]
+    assert calls["n"] == 2
+
+
+def test_sqlite_dataset(tmp_path):
+    import sqlite3
+
+    db = tmp_path / "data.db"
+    with sqlite3.connect(db) as conn:
+        conn.execute("CREATE TABLE points (x REAL, y INTEGER)")
+        conn.executemany(
+            "INSERT INTO points VALUES (?, ?)", [(float(i), i % 2) for i in range(20)]
+        )
+    ds = Dataset.from_sqlite_task(
+        "sqlds",
+        db_path=str(db),
+        query_template="SELECT * FROM points LIMIT {limit}",
+        features=["x"],
+        targets=["y"],
+    )
+    task = ds.dataset_task()
+    frame = task(limit=10)
+    assert len(frame) == 10
+    data = ds.get_data(frame)
+    assert len(data["train"][0]) == 8

@@ -1,0 +1,145 @@
+"""Model spec tests (reference: tests/unit/test_model.py)."""
+
+import io
+from dataclasses import is_dataclass
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from unionml_tpu import Model, ModelArtifact
+from unionml_tpu.stage import Stage, Workflow
+
+
+def test_decorator_registration(model):
+    assert model._trainer is not None
+    assert model._predictor is not None
+    assert model._evaluator is not None
+
+
+def test_hyperparameter_type_synthesis(dataset):
+    def init_fn(C: float = 1.0, max_iter: int = 100) -> "object":
+        ...
+
+    model = Model(name="hp_model", init=init_fn, dataset=dataset)
+    hp_type = model.hyperparameter_type
+    assert is_dataclass(hp_type)
+    hp = hp_type()
+    assert hp.C == 1.0 and hp.max_iter == 100
+
+    # unannotated init falls back to dict (reference: model.py:144-146)
+    def untyped_init(C=1.0):
+        ...
+
+    model2 = Model(name="hp2", init=untyped_init, dataset=dataset)
+    assert model2.hyperparameter_type is dict
+
+    # explicit hyperparameter_config wins
+    model3 = Model(
+        name="hp3", init=untyped_init, hyperparameter_config={"C": float}, dataset=dataset
+    )
+    assert is_dataclass(model3.hyperparameter_type)
+
+
+def test_task_interfaces(model):
+    train_task = model.train_task()
+    assert isinstance(train_task, Stage)
+    assert list(train_task.input_types)[:2] == ["hyperparameters", "data"]
+    predict_task = model.predict_task()
+    assert "model_object" in predict_task.input_types
+    pff_task = model.predict_from_features_task()
+    assert list(pff_task.input_types) == ["model_object", "features"]
+
+
+def test_local_train_and_predict(model):
+    model_obj, metrics = model.train(
+        hyperparameters={"C": 1.0, "max_iter": 1000}, sample_frac=1.0, random_state=123
+    )
+    assert set(metrics) == {"train", "test"}
+    assert 0.0 <= metrics["test"] <= 1.0
+    assert isinstance(model.artifact, ModelArtifact)
+
+    preds = model.predict(sample_frac=1.0, random_state=123)
+    assert isinstance(preds, list) and len(preds) == 100
+    preds2 = model.predict(features=[{"x": 0.1, "x2": -0.2}])
+    assert len(preds2) == 1
+
+
+def test_train_with_kwargs_overrides(model):
+    _, metrics = model.train(
+        hyperparameters={"C": 0.1},
+        splitter_kwargs={"test_size": 0.5, "shuffle": False},
+        sample_frac=1.0,
+        random_state=123,
+    )
+    assert set(metrics) == {"train", "test"}
+
+
+def test_saver_loader_roundtrip(model, tmp_path):
+    model.train(hyperparameters={"C": 1.0}, sample_frac=1.0, random_state=123)
+    path = tmp_path / "model.joblib"
+    model.save(path)
+
+    fresh = Model(
+        name="test_model",
+        init=type(model.artifact.model_object),
+        dataset=model.dataset,
+    )
+    loaded = fresh.load(path)
+    np.testing.assert_array_equal(loaded.coef_, model.artifact.model_object.coef_)
+
+    # file-object round trip (reference: tests/unit/test_model.py:126-142)
+    buf = io.BytesIO()
+    model.save(buf)
+    buf.seek(0)
+    loaded2 = fresh.load(buf)
+    np.testing.assert_array_equal(loaded2.coef_, model.artifact.model_object.coef_)
+
+
+def test_load_from_env(model, tmp_path, monkeypatch):
+    model.train(hyperparameters={"C": 1.0}, sample_frac=1.0, random_state=123)
+    path = tmp_path / "model.joblib"
+    model.save(path)
+    monkeypatch.setenv("UNIONML_MODEL_PATH", str(path))
+    fresh = Model(
+        name="test_model", init=type(model.artifact.model_object), dataset=model.dataset
+    )
+    loaded = fresh.load_from_env()
+    assert loaded is fresh.artifact.model_object
+
+
+def test_predict_requires_artifact(model):
+    with pytest.raises(RuntimeError):
+        model.predict(features=[{"x": 0.0, "x2": 0.0}])
+
+
+def test_predict_requires_input(model):
+    with pytest.raises(ValueError):
+        model.predict()
+
+
+def test_stage_interop_in_custom_workflow(model):
+    """unionml stages composed in a user-authored workflow DAG
+    (reference: tests/unit/test_model.py:145-196)."""
+    model.train(hyperparameters={"C": 1.0}, sample_frac=1.0, random_state=123)
+
+    wf = Workflow("custom")
+    wf.add_input("sample_frac", float)
+    wf.add_input("random_state", int)
+    wf.add_input("model_object", object)
+    ds_idx = wf.add_node(
+        model.dataset.dataset_task(), {"sample_frac": "sample_frac", "random_state": "random_state"}
+    )
+    p_idx = wf.add_node(
+        model.predict_task(), {"model_object": "model_object", "data": (ds_idx, None)}
+    )
+    wf.add_output("preds", p_idx, None)
+    preds = wf(sample_frac=1.0, random_state=123, model_object=model.artifact.model_object)
+    assert len(preds) == 100
+
+
+def test_workflow_names(model):
+    assert model.train_workflow_name == "test_model.train"
+    assert model.predict_workflow_name == "test_model.predict"
+    assert model.predict_from_features_workflow_name == "test_model.predict_from_features"
+    assert repr(model.train_workflow())

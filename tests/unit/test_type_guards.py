@@ -1,0 +1,227 @@
+"""Table-driven signature-contract tests
+(reference: tests/unit/test_type_guards.py, 407 LoC valid/invalid matrices)."""
+
+from typing import Dict, List, Optional, Tuple, Union
+
+import pandas as pd
+import pytest
+
+from unionml_tpu import type_guards
+from unionml_tpu.type_guards import SignatureError
+
+
+class FakeModel:
+    ...
+
+
+# ---------------------------------------------------------------- reader
+
+def test_guard_reader_valid():
+    def reader() -> pd.DataFrame:
+        ...
+
+    type_guards.guard_reader(reader)
+
+
+def test_guard_reader_invalid():
+    def reader():
+        ...
+
+    with pytest.raises(SignatureError):
+        type_guards.guard_reader(reader)
+
+
+# ---------------------------------------------------------------- loader
+
+@pytest.mark.parametrize(
+    "annotation, ok",
+    [
+        (pd.DataFrame, True),
+        (str, False),
+        (Union[pd.DataFrame, str], True),
+    ],
+)
+def test_guard_loader(annotation, ok):
+    def loader(data: annotation) -> pd.DataFrame:  # type: ignore[valid-type]
+        ...
+
+    loader.__annotations__["data"] = annotation
+    if ok:
+        type_guards.guard_loader(loader, pd.DataFrame)
+    else:
+        with pytest.raises(SignatureError):
+            type_guards.guard_loader(loader, pd.DataFrame)
+
+
+# ---------------------------------------------------------------- splitter
+
+def test_guard_splitter_valid():
+    def splitter(
+        data: pd.DataFrame, test_size: float, shuffle: bool, random_state: int
+    ) -> Tuple[pd.DataFrame, pd.DataFrame]:
+        ...
+
+    type_guards.guard_splitter(splitter, pd.DataFrame, "reader")
+
+
+def test_guard_splitter_kwargs_via_var_keyword():
+    def splitter(data: pd.DataFrame, **kwargs) -> Tuple[pd.DataFrame, pd.DataFrame]:
+        ...
+
+    type_guards.guard_splitter(splitter, pd.DataFrame, "reader")
+
+
+def test_guard_splitter_missing_kwargs():
+    def splitter(data: pd.DataFrame, test_size: float) -> Tuple[pd.DataFrame, pd.DataFrame]:
+        ...
+
+    with pytest.raises(SignatureError):
+        type_guards.guard_splitter(splitter, pd.DataFrame, "reader")
+
+
+def test_guard_splitter_wrong_data_type():
+    def splitter(data: int, test_size: float, shuffle: bool, random_state: int):
+        ...
+
+    with pytest.raises(SignatureError):
+        type_guards.guard_splitter(splitter, pd.DataFrame, "reader")
+
+
+# ---------------------------------------------------------------- parser
+
+def test_guard_parser_valid():
+    def parser(
+        data: pd.DataFrame, features: Optional[List[str]], targets: List[str]
+    ) -> Tuple[pd.DataFrame, pd.DataFrame]:
+        ...
+
+    type_guards.guard_parser(parser, pd.DataFrame, "reader")
+
+
+def test_guard_parser_missing_kwargs():
+    def parser(data: pd.DataFrame, features: Optional[List[str]]):
+        ...
+
+    with pytest.raises(SignatureError):
+        type_guards.guard_parser(parser, pd.DataFrame, "reader")
+
+
+# ---------------------------------------------------------------- trainer
+
+def test_guard_trainer_valid():
+    def trainer(model: FakeModel, features: pd.DataFrame, target: pd.DataFrame) -> FakeModel:
+        ...
+
+    type_guards.guard_trainer(trainer, FakeModel, (pd.DataFrame, pd.DataFrame))
+
+
+def test_guard_trainer_wrong_model_type():
+    def trainer(model: int, features: pd.DataFrame) -> FakeModel:
+        ...
+
+    with pytest.raises(SignatureError):
+        type_guards.guard_trainer(trainer, FakeModel, (pd.DataFrame,))
+
+
+def test_guard_trainer_wrong_return():
+    def trainer(model: FakeModel, features: pd.DataFrame) -> int:
+        ...
+
+    with pytest.raises(SignatureError):
+        type_guards.guard_trainer(trainer, FakeModel, (pd.DataFrame,))
+
+
+def test_guard_trainer_too_many_data_args():
+    def trainer(model: FakeModel, a: pd.DataFrame, b: pd.DataFrame, c: pd.DataFrame) -> FakeModel:
+        ...
+
+    with pytest.raises(SignatureError):
+        type_guards.guard_trainer(trainer, FakeModel, (pd.DataFrame, pd.DataFrame))
+
+
+def test_guard_trainer_keyword_only_args_allowed():
+    def trainer(
+        model: FakeModel, features: pd.DataFrame, *, num_epochs: int = 3
+    ) -> FakeModel:
+        ...
+
+    type_guards.guard_trainer(trainer, FakeModel, (pd.DataFrame,))
+
+
+# ---------------------------------------------------------------- evaluator
+
+def test_guard_evaluator_valid():
+    def evaluator(model: FakeModel, features: pd.DataFrame, target: pd.DataFrame) -> float:
+        ...
+
+    type_guards.guard_evaluator(evaluator, FakeModel, (pd.DataFrame, pd.DataFrame))
+
+
+def test_guard_evaluator_wrong_model():
+    def evaluator(model: str, features: pd.DataFrame) -> float:
+        ...
+
+    with pytest.raises(SignatureError):
+        type_guards.guard_evaluator(evaluator, FakeModel, (pd.DataFrame,))
+
+
+# ---------------------------------------------------------------- predictor
+
+def test_guard_predictor_valid():
+    def predictor(model: FakeModel, features: pd.DataFrame) -> List[float]:
+        ...
+
+    type_guards.guard_predictor(predictor, FakeModel, pd.DataFrame)
+
+
+def test_guard_predictor_with_unions():
+    """Union-type acceptance (reference: test_type_guards.py:322)."""
+
+    def predictor(model: FakeModel, features: Union[pd.DataFrame, List[Dict]]) -> List[float]:
+        ...
+
+    type_guards.guard_predictor(predictor, FakeModel, pd.DataFrame)
+
+
+def test_guard_predictor_extra_args():
+    def predictor(model: FakeModel, features: pd.DataFrame, other: int) -> List[float]:
+        ...
+
+    with pytest.raises(SignatureError):
+        type_guards.guard_predictor(predictor, FakeModel, pd.DataFrame)
+
+
+def test_guard_predictor_no_return_annotation():
+    def predictor(model: FakeModel, features: pd.DataFrame):
+        ...
+
+    with pytest.raises(SignatureError):
+        type_guards.guard_predictor(predictor, FakeModel, pd.DataFrame)
+
+
+# ------------------------------------------------- feature loader/transformer
+
+def test_guard_feature_loader():
+    def feature_loader(raw) -> pd.DataFrame:
+        ...
+
+    type_guards.guard_feature_loader(feature_loader)
+
+    def bad_loader(a, b):
+        ...
+
+    with pytest.raises(SignatureError):
+        type_guards.guard_feature_loader(bad_loader)
+
+
+def test_guard_feature_transformer():
+    def feature_transformer(features: pd.DataFrame) -> pd.DataFrame:
+        ...
+
+    type_guards.guard_feature_transformer(feature_transformer)
+
+    def bad(a, b):
+        ...
+
+    with pytest.raises(SignatureError):
+        type_guards.guard_feature_transformer(bad)

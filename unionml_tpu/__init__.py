@@ -1,0 +1,36 @@
+"""unionml_tpu: a TPU-native declarative ML-microservice framework.
+
+A ground-up rebuild of the capability surface of UnionML
+(reference: /root/reference/unionml/__init__.py:1-35) designed TPU-first:
+
+- user functions registered on ``Dataset`` / ``Model`` compile into named,
+  cached, resource-annotated **stages** (the flytekit-task analog, but with a
+  JAX execution substrate instead of Flyte),
+- trainer / predictor bodies can be jit/pjit-compiled over a
+  ``jax.sharding.Mesh`` with first-class DP/FSDP/TP/SP/PP/EP strategies,
+- the data path streams host batches to HBM with double buffering,
+- serving batches requests on-device,
+- the remote backend targets TPU VM slices with git-SHA app versioning and
+  an execution-history model registry.
+
+Public API mirrors the reference (`unionml/__init__.py:4-5`): the two core
+objects are :class:`Dataset` and :class:`Model`.
+"""
+
+from unionml_tpu.dataset import Dataset
+from unionml_tpu.model import Model, ModelArtifact, BaseHyperparameters
+
+try:  # single-source the version from package metadata when installed
+    from importlib.metadata import version as _version
+
+    __version__ = _version("unionml_tpu")
+except Exception:  # pragma: no cover - not installed as a distribution
+    __version__ = "0.1.0"
+
+__all__ = [
+    "Dataset",
+    "Model",
+    "ModelArtifact",
+    "BaseHyperparameters",
+    "__version__",
+]

@@ -1,0 +1,11 @@
+"""Framework logger (reference: unionml/_logging.py:3-7)."""
+
+import logging
+
+logger = logging.getLogger("unionml_tpu")
+logger.setLevel(logging.INFO)
+
+_handler = logging.StreamHandler()
+_handler.setFormatter(logging.Formatter("[unionml-tpu] %(message)s"))
+logger.addHandler(_handler)
+logger.propagate = False

@@ -1,0 +1,25 @@
+"""Checkpoint/artifact layer: pytree serialization + sharded checkpoints.
+
+The reference's artifact layer is joblib/torch.save per framework
+(reference: unionml/model.py:931-988) with no mid-training checkpointing
+(SURVEY.md §5.4). Here the JAX-native family gets:
+
+- :func:`save_pytree` / :func:`load_pytree` — single-file msgpack artifact
+  (flax serialization) for the Model.save/load path,
+- :mod:`unionml_tpu.checkpoint.sharded` — Orbax sharded checkpoints of
+  params + optimizer state for mid-training checkpoint/resume on a mesh,
+- :mod:`unionml_tpu.checkpoint.registry` — "registry = execution history"
+  semantics (version = app git SHA × run id, ``latest``-or-pinned;
+  reference: unionml/remote.py:150-218).
+"""
+
+from unionml_tpu.checkpoint.pytree_io import load_pytree, save_pytree
+from unionml_tpu.checkpoint.sharded import CheckpointManager, restore_sharded, save_sharded
+
+__all__ = [
+    "save_pytree",
+    "load_pytree",
+    "save_sharded",
+    "restore_sharded",
+    "CheckpointManager",
+]

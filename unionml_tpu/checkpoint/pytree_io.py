@@ -1,0 +1,66 @@
+"""Single-file pytree artifacts (the Model.save/load path).
+
+Replaces the reference's joblib single-file artifact
+(reference: unionml/model.py:940-946) for JAX model objects: leaves are
+serialized with flax's msgpack wire format plus a JSON header carrying
+hyperparameters, so an artifact is self-describing and loadable in a fresh
+process given the app's ``init`` to rebuild the pytree structure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import IO, Any, Callable, Optional, Union
+
+_MAGIC = b"UTPU1"
+
+
+def _open(file: Union[str, os.PathLike, IO], mode: str):
+    if hasattr(file, "write") or hasattr(file, "read"):
+        return file, False
+    return open(file, mode), True
+
+
+def save_pytree(pytree: Any, hyperparameters: Optional[dict], file: Union[str, os.PathLike, IO]) -> None:
+    """Serialize ``pytree`` + hyperparameters to ``file``."""
+    from flax import serialization
+
+    payload = serialization.to_bytes(pytree)
+    header = json.dumps({"hyperparameters": hyperparameters}).encode()
+    f, should_close = _open(file, "wb")
+    try:
+        f.write(_MAGIC)
+        f.write(struct.pack("<Q", len(header)))
+        f.write(header)
+        f.write(payload)
+    finally:
+        if should_close:
+            f.close()
+
+
+def load_pytree(
+    file: Union[str, os.PathLike, IO],
+    target_factory: Callable[[Optional[dict]], Any],
+) -> Any:
+    """Load a pytree artifact; ``target_factory(hyperparameters)`` rebuilds
+    the target structure (typically the app's ``init``)."""
+    from flax import serialization
+
+    f, should_close = _open(file, "rb")
+    try:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise ValueError(
+                f"not a unionml_tpu pytree artifact (bad magic {magic!r}); "
+                "use a custom @model.loader for non-JAX artifacts"
+            )
+        (header_len,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(header_len))
+        payload = f.read()
+    finally:
+        if should_close:
+            f.close()
+    target = target_factory(header.get("hyperparameters"))
+    return serialization.from_bytes(target, payload)

@@ -1,0 +1,82 @@
+"""Sharded checkpoints for mid-training checkpoint/resume on a mesh.
+
+No reference counterpart — the reference checkpoints only final artifacts
+(SURVEY.md §5.3-5.4). TPU training needs preemption-safe, sharded
+checkpoints: each host writes only its addressable shards (Orbax), and
+restore re-places shards per the target's NamedSharding, enabling
+deterministic resume from step N after slice preemption.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any, Optional, Union
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+def save_sharded(path: Union[str, os.PathLike], state: Any, *, step: Optional[int] = None, force: bool = True) -> None:
+    """Write a sharded checkpoint of ``state`` (params + opt state pytree)."""
+    ocp = _ocp()
+    path = Path(path).absolute()
+    if step is not None:
+        path = path / f"step_{step}"
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state, force=force)
+
+
+def restore_sharded(path: Union[str, os.PathLike], target: Any = None, *, step: Optional[int] = None) -> Any:
+    """Restore a sharded checkpoint, re-placing shards to match ``target``'s
+    shardings (abstract or concrete pytree)."""
+    ocp = _ocp()
+    path = Path(path).absolute()
+    if step is not None:
+        path = path / f"step_{step}"
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(path, target) if target is not None else ckptr.restore(path)
+
+
+class CheckpointManager:
+    """Step-indexed checkpoint rotation for training loops.
+
+    Keeps the most recent ``max_to_keep`` step checkpoints under ``root``;
+    ``latest_step()`` enables deterministic resume (SURVEY.md §5.3).
+    """
+
+    def __init__(self, root: Union[str, os.PathLike], *, max_to_keep: int = 3):
+        self.root = Path(root).absolute()
+        self.max_to_keep = max_to_keep
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _steps(self):
+        steps = []
+        for p in self.root.glob("step_*"):
+            try:
+                steps.append(int(p.name.split("_", 1)[1]))
+            except ValueError:
+                continue
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self._steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, state: Any) -> None:
+        save_sharded(self.root, state, step=step)
+        steps = self._steps()
+        while len(steps) > self.max_to_keep:
+            victim = steps.pop(0)
+            import shutil
+
+            shutil.rmtree(self.root / f"step_{victim}", ignore_errors=True)
+
+    def restore(self, state_target: Any = None, step: Optional[int] = None) -> Any:
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.root}")
+        return restore_sharded(self.root, state_target, step=step)

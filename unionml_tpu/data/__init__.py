@@ -1,0 +1,5 @@
+"""Device data path: host batching, shard-aware placement, double buffering."""
+
+from unionml_tpu.data.pipeline import DeviceFeed, prefetch_to_device
+
+__all__ = ["DeviceFeed", "prefetch_to_device"]

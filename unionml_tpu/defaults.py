@@ -1,0 +1,23 @@
+"""Default resources applied to every generated stage.
+
+Reference: unionml/defaults.py:5 (``DEFAULT_RESOURCES = Resources(cpu="1",
+mem="1Gi")``). The TPU-native resource model adds an accelerator request:
+``chips`` is the number of TPU chips a stage asks for (0 = host-only stage).
+"""
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class Resources:
+    """Resource request attached to a compiled stage."""
+
+    cpu: str = "1"
+    mem: str = "1Gi"
+    chips: int = 0
+    accelerator: Optional[str] = None  # e.g. "tpu-v5e", "tpu-v5p"
+
+
+DEFAULT_RESOURCES = Resources(cpu="1", mem="1Gi", chips=0)
+DEFAULT_DEVICE_RESOURCES = Resources(cpu="4", mem="8Gi", chips=1, accelerator="tpu-v5e")

@@ -1,0 +1,37 @@
+"""Parallelism layer: device meshes, sharding strategies, collectives.
+
+No reference counterpart (SURVEY.md §2: the reference's only "distribution"
+is task-level Flyte orchestration). This package is the TPU-native
+first-class replacement: strategies compose as axes of one
+``jax.sharding.Mesh`` and XLA/GSPMD inserts the collectives over ICI/DCN.
+
+- :mod:`unionml_tpu.parallel.mesh` — mesh construction (single-chip, slice,
+  multi-slice with DCN axes), multi-host bring-up.
+- :mod:`unionml_tpu.parallel.sharding` — :class:`ShardingConfig` with named
+  strategies (dp/fsdp/tp/sp/pp/ep), partition rules, ``compile_step``.
+- :mod:`unionml_tpu.parallel.collectives` — named collective wrappers for
+  shard_map kernels.
+- :mod:`unionml_tpu.parallel.pipeline` — pipeline-parallel stage executor.
+"""
+
+from unionml_tpu.parallel.mesh import make_mesh, mesh_devices, multihost_initialize
+from unionml_tpu.parallel.sharding import (
+    PartitionRule,
+    ShardingConfig,
+    compile_step,
+    named_sharding,
+    shard_pytree,
+    state_shardings,
+)
+
+__all__ = [
+    "make_mesh",
+    "mesh_devices",
+    "multihost_initialize",
+    "PartitionRule",
+    "ShardingConfig",
+    "compile_step",
+    "named_sharding",
+    "shard_pytree",
+    "state_shardings",
+]

@@ -1,0 +1,59 @@
+"""Named collective wrappers for shard_map kernels.
+
+TPU-native replacement for the reference's absent NCCL/MPI layer
+(SURVEY.md §5.8): all hot-path tensor exchange is XLA collectives compiled
+over ICI/DCN. Inside ``jax.jit`` GSPMD inserts these automatically from
+shardings; these explicit wrappers are for ``shard_map`` kernels (ring
+attention KV rotation, Ulysses all-to-all, MoE dispatch) where the
+communication schedule is the algorithm.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = Union[str, Sequence[str]]
+
+
+def psum(x: Any, axis: AxisName):
+    """Sum-reduce across an axis (gradient reduction on the data axis)."""
+    return lax.psum(x, axis)
+
+
+def pmean(x: Any, axis: AxisName):
+    return lax.pmean(x, axis)
+
+
+def all_gather(x: Any, axis: AxisName, *, gather_axis: int = 0, tiled: bool = True):
+    """Gather shards along ``gather_axis`` (fsdp param gather)."""
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter(x: Any, axis: AxisName, *, scatter_axis: int = 0):
+    """Sum-reduce then scatter along ``scatter_axis`` (fsdp grad shard)."""
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def ppermute_shift(x: Any, axis: str, *, shift: int = 1):
+    """Rotate shards around a ring (ring-attention KV rotation over ICI)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def all_to_all(x: Any, axis: str, *, split_axis: int, concat_axis: int, tiled: bool = True):
+    """Transpose sharding between two tensor dims (Ulysses head↔sequence
+    reshuffle, MoE token dispatch)."""
+    return lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.axis_size(axis)

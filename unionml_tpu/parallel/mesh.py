@@ -1,0 +1,108 @@
+"""Device-mesh construction and multi-host bring-up.
+
+TPU-native replacement for the reference's Flyte-container distribution
+model (SURVEY.md §5.8): a training step is laid out over one
+``jax.sharding.Mesh`` whose axes name the parallelism strategies; XLA
+compiles collectives that ride ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+
+def mesh_devices(n: Optional[int] = None):
+    """The devices to build a mesh over (all visible by default)."""
+    import jax
+
+    devices = jax.devices()
+    if n is not None:
+        if n > len(devices):
+            raise ValueError(
+                f"requested {n} devices but only {len(devices)} are visible. "
+                "For CPU-simulated meshes set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=N."
+            )
+        devices = devices[:n]
+    return devices
+
+
+def make_mesh(
+    axes: Dict[str, int],
+    *,
+    devices=None,
+    dcn_axes: Optional[Dict[str, int]] = None,
+):
+    """Build a ``jax.sharding.Mesh`` with named ``axes``.
+
+    At most one axis may be ``-1`` (inferred from the device count). With
+    ``dcn_axes`` (multi-slice: axis × num_slices over the data-center
+    network) the mesh is built with
+    ``mesh_utils.create_hybrid_device_mesh`` so collectives on DCN axes
+    cross slices and all other traffic stays on ICI.
+    """
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    devices = list(devices) if devices is not None else mesh_devices()
+    n = len(devices)
+
+    axes = dict(axes)
+    inferred = [k for k, v in axes.items() if v == -1]
+    if len(inferred) > 1:
+        raise ValueError(f"only one mesh axis may be -1, got {inferred}")
+    known = int(np.prod([v for v in axes.values() if v != -1])) if axes else 1
+    if inferred:
+        if n % known:
+            raise ValueError(f"device count {n} not divisible by fixed axes product {known}")
+        axes[inferred[0]] = n // known
+    total = int(np.prod(list(axes.values())))
+    if total != n:
+        raise ValueError(
+            f"mesh axes {axes} require {total} devices but {n} are available"
+        )
+
+    if dcn_axes:
+        ici_shape = [axes[k] // dcn_axes.get(k, 1) for k in axes]
+        mesh_arr = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, [dcn_axes.get(k, 1) for k in axes], devices=devices
+        )
+        return Mesh(mesh_arr, tuple(axes))
+
+    try:
+        mesh_arr = mesh_utils.create_device_mesh(tuple(axes.values()), devices=devices)
+    except Exception:
+        # CPU-simulated or partial-device meshes: plain reshape
+        mesh_arr = np.asarray(devices).reshape(tuple(axes.values()))
+    return Mesh(mesh_arr, tuple(axes))
+
+
+def multihost_initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Bring up the multi-host runtime (``jax.distributed.initialize``).
+
+    This replaces the reference's Flyte control plane for multi-machine
+    execution (SURVEY.md §5.8): on TPU VM slices arguments are autodetected
+    from the metadata server; across DCN pass them explicitly. No-ops when
+    already initialized or when running single-process.
+    """
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu" and coordinator_address is None:
+        return False  # single-process CPU simulation
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+    except (RuntimeError, ValueError):
+        return False  # already initialized or single-process

@@ -1,0 +1,204 @@
+"""Sharding strategies: one mesh, named axes, partition rules.
+
+The strategy vocabulary (SURVEY.md §2.4 TPU additions): **dp** (batch
+sharding, gradient psum), **fsdp** (param/optimizer sharding à la ZeRO-3 —
+XLA all-gathers just-in-time), **tp** (tensor parallelism via param
+partition rules), **sp** (sequence axis for ring/Ulysses attention), **pp**
+(pipeline stages), **ep** (expert parallelism for MoE). All are axes of a
+single ``jax.sharding.Mesh``; a :class:`ShardingConfig` names the axis
+sizes, how batches shard, and how parameters partition. ``compile_step``
+then jit-compiles a ``(state, batch) -> (state, metrics)`` function with
+NamedSharding in/out specs — GSPMD inserts the ICI/DCN collectives.
+
+Axis order puts model axes innermost ("tensor" fastest-varying) so
+tensor-parallel collectives land on adjacent ICI neighbors.
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from unionml_tpu.parallel.mesh import make_mesh
+
+# outermost → innermost; DCN-friendly axes (pipeline, data) first
+AXIS_ORDER = ("pipeline", "data", "fsdp", "expert", "sequence", "tensor")
+
+
+@dataclass(frozen=True)
+class PartitionRule:
+    """Regex over the '/'-joined parameter path → PartitionSpec entries."""
+
+    pattern: str
+    spec: Tuple[Any, ...]
+
+    def matches(self, path: str) -> bool:
+        return re.search(self.pattern, path) is not None
+
+
+def _path_str(path) -> str:
+    import jax
+
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.DictKey):
+            parts.append(str(p.key))
+        elif isinstance(p, jax.tree_util.GetAttrKey):
+            parts.append(p.name)
+        elif isinstance(p, jax.tree_util.SequenceKey):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+@dataclass
+class ShardingConfig:
+    """Declarative parallelism config attached to ``@model.train_step``.
+
+    Axis sizes multiply to the device count; ``data=-1`` absorbs the
+    remainder. ``rules`` map parameter paths to PartitionSpecs (tensor/
+    expert parallelism); unmatched parameters fall back to FSDP sharding of
+    their largest divisible axis when ``fsdp > 1``, else replication.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    sequence: int = 1
+    pipeline: int = 1
+    expert: int = 1
+    rules: Sequence[PartitionRule] = ()
+    batch_spec: Optional[Tuple[Any, ...]] = None  # default: dim0 over (data, fsdp)
+    devices: Optional[Sequence[Any]] = None
+    dcn_axes: Optional[Dict[str, int]] = None
+
+    _mesh: Any = field(default=None, repr=False, compare=False)
+
+    def axis_sizes(self) -> Dict[str, int]:
+        sizes = {name: getattr(self, name) for name in AXIS_ORDER}
+        # keep axes that are inferred (-1) or used (>1); always keep data
+        return {
+            k: v for k, v in sizes.items() if v == -1 or v > 1 or k == "data"
+        }
+
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = make_mesh(
+                self.axis_sizes(), devices=self.devices, dcn_axes=self.dcn_axes
+            )
+        return self._mesh
+
+    # -- batch sharding ------------------------------------------------- #
+
+    def batch_pspec(self):
+        from jax.sharding import PartitionSpec as P
+
+        if self.batch_spec is not None:
+            return P(*self.batch_spec)
+        axes = [a for a in ("data", "fsdp") if a in self.axis_sizes()]
+        return P(tuple(axes) if len(axes) > 1 else axes[0] if axes else None)
+
+    def batch_sharding(self):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh(), self.batch_pspec())
+
+    # -- parameter sharding --------------------------------------------- #
+
+    def param_pspec(self, path: str, leaf) -> Any:
+        from jax.sharding import PartitionSpec as P
+
+        for rule in self.rules:
+            if rule.matches(path):
+                return P(*rule.spec)
+        shape = getattr(leaf, "shape", ())
+        if self.fsdp > 1 and shape:
+            # FSDP fallback: shard the largest divisible axis
+            candidates = [
+                (dim_size, i) for i, dim_size in enumerate(shape) if dim_size % self.fsdp == 0
+            ]
+            if candidates:
+                _, dim = max(candidates)
+                spec = [None] * len(shape)
+                spec[dim] = "fsdp"
+                return P(*spec)
+        return P()
+
+    def state_shardings(self, state: Any):
+        """Pytree of NamedSharding matching ``state``'s structure."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        mesh = self.mesh()
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: NamedSharding(mesh, self.param_pspec(_path_str(path), leaf)),
+            state,
+        )
+
+
+def named_sharding(mesh, *spec):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def state_shardings(config: ShardingConfig, state: Any):
+    return config.state_shardings(state)
+
+
+def shard_pytree(state: Any, config: ShardingConfig):
+    """Place a pytree on the config's mesh per its partition rules."""
+    import jax
+
+    return jax.device_put(state, config.state_shardings(state))
+
+
+def compile_step(
+    step_fn: Callable,
+    state: Any,
+    *,
+    sharding: ShardingConfig,
+    donate_state: bool = True,
+) -> Tuple[Callable, Any]:
+    """Compile ``step_fn(state, batch) -> (state, metrics)`` over the mesh.
+
+    Returns ``(compiled_step, placed_state)``: the state is device_put per
+    the partition rules (sharded init happens once, host→HBM), and the
+    compiled step constrains state in/out shardings so XLA keeps parameters
+    resident and inserts gradient collectives (psum over 'data'/'fsdp',
+    all-gathers for fsdp params) automatically. State buffers are donated —
+    parameter memory is updated in place.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = sharding.mesh()
+    ss = sharding.state_shardings(state)
+    placed = jax.device_put(state, ss)
+    bspec = sharding.batch_sharding()
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    compiled = jax.jit(
+        step_fn,
+        in_shardings=(ss, bspec),
+        out_shardings=(ss, replicated),
+        donate_argnums=(0,) if donate_state else (),
+    )
+
+    if mesh.devices.flat[0].platform == "cpu":
+        # CPU-simulated meshes (tests) deadlock when many N-participant
+        # collective programs are dispatched async onto a thread pool
+        # smaller than N (XLA rendezvous starvation on few-core hosts).
+        # Synchronize per step there; real TPU keeps async dispatch.
+        def synced(state, batch, _inner=compiled):
+            out = _inner(state, batch)
+            jax.block_until_ready(out)
+            return out
+
+        return synced, placed
+    return compiled, placed
