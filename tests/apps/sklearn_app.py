@@ -1,0 +1,39 @@
+"""Fixture app for remote/serving tests
+(reference analog: tests/integration/sklearn_app/quickstart.py)."""
+
+import numpy as np
+import pandas as pd
+from sklearn.linear_model import LogisticRegression
+
+from unionml_tpu import Dataset, Model
+
+dataset = Dataset(name="fixture_dataset", test_size=0.2, shuffle=True, targets=["y"])
+model = Model(name="fixture_model", init=LogisticRegression, dataset=dataset)
+
+
+@dataset.reader
+def reader(n: int = 200) -> pd.DataFrame:
+    rng = np.random.default_rng(17)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    y = ((x1 + x2) > 0).astype(int)
+    return pd.DataFrame({"x1": x1, "x2": x2, "y": y})
+
+
+@model.trainer
+def trainer(
+    estimator: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame
+) -> LogisticRegression:
+    return estimator.fit(features, target.squeeze())
+
+
+@model.predictor
+def predictor(estimator: LogisticRegression, features: pd.DataFrame) -> list:
+    return [float(p) for p in estimator.predict(features)]
+
+
+@model.evaluator
+def evaluator(
+    estimator: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame
+) -> float:
+    return float(estimator.score(features, target.squeeze()))

@@ -1,0 +1,86 @@
+"""CLI tests (reference analog: cli exercised via tests/integration)."""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+from click.testing import CliRunner
+
+from unionml_tpu.cli import app
+
+APPS_DIR = Path(__file__).parent.parent / "apps"
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+def test_init_scaffolds_template(runner, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    result = runner.invoke(app, ["init", "my_app"])
+    assert result.exit_code == 0, result.output
+    assert (tmp_path / "my_app" / "app.py").exists()
+    content = (tmp_path / "my_app" / "app.py").read_text()
+    assert "my_app" in content and "{{app_name}}" not in content
+    # post-gen git init ran
+    assert (tmp_path / "my_app" / ".git").exists()
+
+
+def test_init_tpu_template(runner, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    result = runner.invoke(app, ["init", "tpu_app", "--template", "basic_tpu"])
+    assert result.exit_code == 0, result.output
+    assert "train_step" in (tmp_path / "tpu_app" / "app.py").read_text()
+
+
+def test_init_rejects_bad_name(runner, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    result = runner.invoke(app, ["init", "bad-name!"])
+    assert result.exit_code != 0
+    assert "valid Python identifier" in result.output
+
+
+def test_init_rejects_existing_dir(runner, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "dup").mkdir()
+    result = runner.invoke(app, ["init", "dup"])
+    assert result.exit_code != 0 and "already exists" in result.output
+
+
+def test_deploy_train_predict_roundtrip(runner, tmp_path, monkeypatch):
+    monkeypatch.setenv("UNIONML_TPU_HOME", str(tmp_path / "backend"))
+    monkeypatch.chdir(APPS_DIR)
+    sys.path.insert(0, str(APPS_DIR))
+    try:
+        import sklearn_app
+
+        sklearn_app.model._backend = None
+        sklearn_app.model.remote(project="cli-project")
+
+        result = runner.invoke(
+            app, ["deploy", "sklearn_app:model", "--app-version", "vcli"]
+        )
+        assert result.exit_code == 0, result.output
+        assert "deployed fixture_model version vcli" in result.output
+
+        result = runner.invoke(
+            app,
+            ["train", "sklearn_app:model", "--app-version", "vcli",
+             "--inputs", json.dumps({"hyperparameters": {"max_iter": 200}, "n": 200})],
+        )
+        assert result.exit_code == 0, result.output
+        assert "metrics" in result.output
+
+        result = runner.invoke(app, ["list-model-versions", "sklearn_app:model"])
+        assert result.exit_code == 0 and "train-" in result.output
+
+        out_path = tmp_path / "fetched.joblib"
+        result = runner.invoke(
+            app, ["fetch-model", "sklearn_app:model", "-o", str(out_path)]
+        )
+        assert result.exit_code == 0, result.output
+        assert out_path.exists()
+    finally:
+        sys.path.remove(str(APPS_DIR))

@@ -1,0 +1,96 @@
+"""Remote backend tests: deploy → subprocess execute → registry
+(reference analog: tests/integration/test_flyte_remote.py, with the
+LocalBackend subprocess sandbox standing in for the Flyte sandbox)."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+APPS_DIR = Path(__file__).parent.parent / "apps"
+
+
+@pytest.fixture
+def fixture_model(monkeypatch, tmp_path):
+    monkeypatch.setenv("UNIONML_TPU_HOME", str(tmp_path / "backend"))
+    sys.path.insert(0, str(APPS_DIR))
+    try:
+        import sklearn_app
+
+        sklearn_app.model._backend = None  # reset cached backend per test
+        sklearn_app.model.remote(project="fixture-project")
+        yield sklearn_app.model
+    finally:
+        sys.path.remove(str(APPS_DIR))
+
+
+def test_deploy_and_remote_train(fixture_model):
+    version = fixture_model.remote_deploy(app_version="v1")
+    assert version == "v1"
+    dep_dir = fixture_model._remote.deployment_dir("v1")
+    assert (dep_dir / "sklearn_app.py").exists()
+    assert (dep_dir / ".unionml_manifest.json").exists()
+
+    artifact = fixture_model.remote_train(app_version="v1", hyperparameters={"max_iter": 200}, n=200)
+    assert artifact.model_object is not None
+    assert artifact.metrics["test"] > 0.8
+
+
+def test_remote_predict_and_registry(fixture_model):
+    fixture_model.remote_deploy(app_version="v1")
+    fixture_model.remote_train(app_version="v1", hyperparameters={"max_iter": 200}, n=200)
+
+    versions = fixture_model.remote_list_model_versions()
+    assert len(versions) == 1 and versions[0].startswith("train-")
+
+    preds = fixture_model.remote_predict(model_version="latest", n=50)
+    assert isinstance(preds, list) and len(preds) == 50
+
+    # predict from raw features
+    preds2 = fixture_model.remote_predict(
+        features=[{"x1": 5.0, "x2": 5.0}, {"x1": -5.0, "x2": -5.0}]
+    )
+    assert preds2 == [1.0, 0.0]
+
+
+def test_patch_deploy(fixture_model):
+    """Patch redeploy overlays source (reference: test_flyte_remote.py:131-146)."""
+    fixture_model.remote_deploy(app_version="v1")
+    version = fixture_model.remote_deploy(app_version="v1", patch=True)
+    assert version.startswith("v1-patch")
+    assert fixture_model._remote.deployment_dir(version).exists()
+
+
+def test_failed_execution_surfaces_log(fixture_model):
+    fixture_model.remote_deploy(app_version="v1")
+    with pytest.raises(RuntimeError, match="FAILED"):
+        # bogus reader kwarg -> workflow TypeError inside the runner process
+        fixture_model.remote_train(app_version="v1", bogus_kwarg=1)
+
+
+def test_execute_requires_deployment(fixture_model):
+    with pytest.raises(FileNotFoundError):
+        fixture_model.remote_train(app_version="never-deployed")
+
+
+def test_app_version_dirty_tree_guard(tmp_path, monkeypatch):
+    import subprocess
+
+    from unionml_tpu.remote import VersionFetchError, get_app_version
+
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    subprocess.run(["git", "init", "-q"], cwd=repo, check=True)
+    subprocess.run(["git", "config", "user.email", "t@t"], cwd=repo, check=True)
+    subprocess.run(["git", "config", "user.name", "t"], cwd=repo, check=True)
+    (repo / "f.txt").write_text("hello")
+    subprocess.run(["git", "add", "."], cwd=repo, check=True)
+    subprocess.run(["git", "commit", "-q", "-m", "init"], cwd=repo, check=True)
+
+    version = get_app_version(cwd=str(repo))
+    assert len(version) == 7
+
+    (repo / "f.txt").write_text("dirty")
+    with pytest.raises(VersionFetchError, match="uncommitted"):
+        get_app_version(cwd=str(repo))
+    assert get_app_version(allow_uncommitted=True, cwd=str(repo)).endswith("-dirty")

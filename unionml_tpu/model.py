@@ -765,19 +765,22 @@ class Model(TrackedInstance):
 
     def serve(
         self,
-        app,
+        app=None,
         remote: bool = False,
         app_version: Optional[str] = None,
         model_version: str = "latest",
         batch: bool = False,
         **batcher_kwargs,
     ):
-        """Mount serving endpoints on a FastAPI app
-        (reference: model.py:610-623). ``batch=True`` enables the on-device
-        micro-batcher (TPU-native addition)."""
+        """Mount serving endpoints (reference: model.py:610-623).
+
+        ``app`` may be a FastAPI instance or ``None`` for the
+        dependency-free stdlib HTTP server. ``batch=True`` enables the
+        on-device micro-batcher (TPU-native addition). Returns the app.
+        """
         from unionml_tpu.serving.fastapi import serving_app
 
-        serving_app(
+        return serving_app(
             self,
             app,
             remote=remote,

@@ -1,0 +1,362 @@
+"""Execution backends: local subprocess sandbox + TPU VM slices over SSH.
+
+The reference delegates remote execution to a Flyte cluster (admin gRPC +
+containers; reference remote.py:111-147, model.py:732-917). Here:
+
+- :class:`LocalBackend` runs each workflow in a **separate process** with
+  cwd set to the versioned deployment directory — a faithful analog of the
+  container boundary, and the single-node sandbox the test suite uses the
+  way the reference uses ``flytectl sandbox`` (reference:
+  tests/integration/test_flyte_remote.py:33-57).
+- :class:`TPUVMBackend` drives TPU VM slices over SSH: source is pushed to
+  every worker, the runner is launched on all hosts with the
+  ``jax.distributed`` coordinator env, and host 0's outputs are fetched
+  back. This is the control plane standing in for Flyte admin
+  (SURVEY.md §7 layer 7).
+
+Both share the registry layout::
+
+    {root}/deployments/{project}/{domain}/{app_version}/   # packaged source
+    {root}/executions/{project}/{execution_id}/            # inputs/outputs/status/logs
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import time
+import uuid
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from unionml_tpu._logging import logger
+
+DEFAULT_ROOT_ENV = "UNIONML_TPU_HOME"
+DEFAULT_ROOT = "~/.unionml_tpu"
+
+
+@dataclass
+class ExecutionRecord:
+    """One workflow execution (the FlyteWorkflowExecution analog)."""
+
+    execution_id: str
+    project: str
+    workflow: str
+    app_version: str
+    status: str = "QUEUED"  # QUEUED | RUNNING | SUCCEEDED | FAILED
+    created_at: float = field(default_factory=time.time)
+    exec_dir: str = ""
+    console_url: str = ""
+
+    def save(self):
+        # atomic write: wait() polls this file from another process
+        path = Path(self.exec_dir) / "record.json"
+        tmp = path.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(asdict(self)))
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, exec_dir) -> "ExecutionRecord":
+        data = json.loads((Path(exec_dir) / "record.json").read_text())
+        return cls(**data)
+
+
+class BaseBackend:
+    def __init__(self, *, project: str, domain: str = "development", root: Optional[str] = None):
+        self.project = project
+        self.domain = domain
+        self.root = Path(
+            root or os.environ.get(DEFAULT_ROOT_ENV, DEFAULT_ROOT)
+        ).expanduser()
+
+    # ---------- layout ----------
+
+    def deployment_dir(self, app_version: str) -> Path:
+        return self.root / "deployments" / self.project / self.domain / app_version
+
+    def executions_dir(self) -> Path:
+        return self.root / "executions" / self.project
+
+    def _latest_app_version(self) -> str:
+        base = self.root / "deployments" / self.project / self.domain
+        if not base.exists():
+            raise FileNotFoundError(
+                f"no deployments for project {self.project!r}; run remote_deploy first"
+            )
+        versions = sorted(base.iterdir(), key=lambda p: p.stat().st_mtime)
+        return versions[-1].name
+
+    # ---------- deploy ----------
+
+    def deploy(self, model, *, app_version: str, patch: bool = False) -> Path:
+        """Package the app source (reference deploy_wf: remote.py:111-147).
+
+        The app source dir is the directory containing the module where the
+        Model was defined; the manifest records the ``module:variable``
+        loader path (the task-resolver pointer, task_resolver.py:23-31).
+        """
+        from unionml_tpu.remote.packaging import package_source
+
+        module_name, var_name = model.loader_path()
+        module = sys.modules[module_name]
+        module_file = getattr(module, "__file__", None)
+        if module_file is None:
+            raise ValueError(
+                f"cannot deploy: app module {module_name!r} has no file (interactive?)"
+            )
+        src_dir = Path(module_file).parent
+        dest = self.deployment_dir(app_version)
+        n = package_source(src_dir, dest, patch=patch)
+        manifest = {
+            "app": f"{Path(module_file).stem}:{var_name}",
+            "model_name": model.name,
+            "app_version": app_version,
+            "project": self.project,
+            "domain": self.domain,
+            "workflows": [
+                model.train_workflow_name,
+                model.predict_workflow_name,
+                model.predict_from_features_workflow_name,
+            ],
+        }
+        (dest / ".unionml_manifest.json").write_text(json.dumps(manifest, indent=2))
+        logger.info(f"deployed {n} files to {dest}")
+        return dest
+
+    # ---------- execute ----------
+
+    def execute(
+        self,
+        model,
+        *,
+        workflow: str,
+        app_version: Optional[str] = None,
+        model_version: Optional[str] = None,
+        inputs: Optional[Dict[str, Any]] = None,
+        wait: bool = True,
+    ) -> ExecutionRecord:
+        app_version = app_version or self._latest_app_version()
+        dep_dir = self.deployment_dir(app_version)
+        if not dep_dir.exists():
+            raise FileNotFoundError(
+                f"app version {app_version!r} is not deployed (looked in {dep_dir})"
+            )
+        manifest = json.loads((dep_dir / ".unionml_manifest.json").read_text())
+
+        execution_id = f"{workflow}-{uuid.uuid4().hex[:10]}"
+        exec_dir = self.executions_dir() / execution_id
+        exec_dir.mkdir(parents=True, exist_ok=True)
+        with open(exec_dir / "inputs.pkl", "wb") as f:
+            pickle.dump(inputs or {}, f)
+
+        record = ExecutionRecord(
+            execution_id=execution_id,
+            project=self.project,
+            workflow=workflow,
+            app_version=app_version,
+            exec_dir=str(exec_dir),
+            console_url=f"file://{exec_dir}",
+        )
+        record.save()
+        self._launch(record, dep_dir, manifest, model_version=model_version)
+        # surface the console URL (reference: model.py:785-789)
+        logger.info(f"execution {execution_id}: {record.console_url}")
+        if wait:
+            return self.wait(record)
+        return record
+
+    def _launch(self, record, dep_dir, manifest, *, model_version):  # pragma: no cover
+        raise NotImplementedError
+
+    # ---------- status / outputs ----------
+
+    def wait(self, execution: ExecutionRecord, timeout: float = 3600.0, poll: float = 0.2) -> ExecutionRecord:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                execution = ExecutionRecord.load(execution.exec_dir)
+            except (json.JSONDecodeError, FileNotFoundError):
+                time.sleep(poll)  # mid-write record; retry
+                continue
+            if execution.status in ("SUCCEEDED", "FAILED"):
+                if execution.status == "FAILED":
+                    log = Path(execution.exec_dir) / "runner.log"
+                    tail = log.read_text()[-2000:] if log.exists() else "<no log>"
+                    raise RuntimeError(
+                        f"execution {execution.execution_id} FAILED. Log tail:\n{tail}"
+                    )
+                return execution
+            time.sleep(poll)
+        raise TimeoutError(f"execution {execution.execution_id} did not finish in {timeout}s")
+
+    def fetch_outputs(self, execution: ExecutionRecord) -> Dict[str, Any]:
+        with open(Path(execution.exec_dir) / "outputs.pkl", "rb") as f:
+            return pickle.load(f)
+
+    # ---------- registry = execution history (reference: remote.py:150-218) ----
+
+    def _train_executions(self, model, app_version: Optional[str]) -> List[ExecutionRecord]:
+        base = self.executions_dir()
+        if not base.exists():
+            return []
+        records = []
+        for d in base.iterdir():
+            try:
+                rec = ExecutionRecord.load(d)
+            except (FileNotFoundError, json.JSONDecodeError):
+                continue
+            if rec.workflow != "train" or rec.status != "SUCCEEDED":
+                continue
+            if app_version is not None and rec.app_version != app_version:
+                continue
+            records.append(rec)
+        return sorted(records, key=lambda r: r.created_at, reverse=True)
+
+    def get_model_execution(
+        self, model, *, app_version: Optional[str] = None, model_version: str = "latest"
+    ) -> ExecutionRecord:
+        """latest-or-pinned model version (reference: remote.py:150-183)."""
+        if model_version != "latest":
+            exec_dir = self.executions_dir() / model_version
+            record = ExecutionRecord.load(exec_dir)
+            if record.workflow != "train" or record.status != "SUCCEEDED":
+                raise ValueError(
+                    f"model_version {model_version!r} is not a SUCCEEDED train "
+                    f"execution (workflow={record.workflow!r}, status={record.status!r})"
+                )
+            return record
+        records = self._train_executions(model, app_version)
+        if not records:
+            raise FileNotFoundError(
+                f"no successful train executions for project {self.project!r}"
+                + (f" app_version {app_version!r}" if app_version else "")
+            )
+        return records[0]
+
+    def list_model_versions(self, model, *, app_version=None, limit: int = 10) -> List[str]:
+        """Model versions = succeeded train execution ids
+        (reference: remote.py:197-218)."""
+        return [r.execution_id for r in self._train_executions(model, app_version)[:limit]]
+
+
+class LocalBackend(BaseBackend):
+    """Subprocess sandbox: the single-node stand-in for a real backend."""
+
+    def _launch(self, record, dep_dir, manifest, *, model_version):
+        cmd = [
+            sys.executable,
+            "-m",
+            "unionml_tpu.remote.runner",
+            "--app", manifest["app"],
+            "--workflow", record.workflow,
+            "--exec-dir", record.exec_dir,
+        ]
+        if model_version:
+            cmd += ["--model-version", model_version]
+        env = dict(os.environ)
+        # the deployed source + the framework itself must be importable
+        fw_root = str(Path(__file__).resolve().parents[2])
+        env["PYTHONPATH"] = os.pathsep.join(
+            [str(dep_dir), fw_root, env.get("PYTHONPATH", "")]
+        )
+        env["UNIONML_TPU_HOME"] = str(self.root)
+        env["UNIONML_TPU_PROJECT"] = self.project
+        log = open(Path(record.exec_dir) / "runner.log", "w")
+        proc = subprocess.Popen(cmd, cwd=dep_dir, env=env, stdout=log, stderr=log)
+        (Path(record.exec_dir) / "pid").write_text(str(proc.pid))
+
+
+class TPUVMBackend(BaseBackend):
+    """SSH control plane for TPU VM slices (multi-host).
+
+    Config (from the backend YAML): ``hosts`` (worker addresses, host 0 is
+    the coordinator), ``ssh_user``, ``workdir``. Source is pushed to every
+    worker; the runner launches on all hosts with
+    ``jax.distributed.initialize`` coordinator env so XLA collectives span
+    the slice (SURVEY.md §5.8).
+    """
+
+    def __init__(self, *, hosts: List[str], ssh_user: str = "root",
+                 workdir: str = "/tmp/unionml_tpu_app", coordinator_port: int = 8476, **kwargs):
+        super().__init__(**kwargs)
+        if not hosts:
+            raise ValueError("TPUVMBackend requires at least one host")
+        self.hosts = hosts
+        self.ssh_user = ssh_user
+        self.workdir = workdir
+        self.coordinator_port = coordinator_port
+
+    def _ssh(self, host: str, command: str, **popen_kwargs):
+        return subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", f"{self.ssh_user}@{host}", command],
+            **popen_kwargs,
+        )
+
+    def _push(self, host: str, src: Path, app_version: str) -> str:
+        """Push the deployment to a per-version dir so repeated deploys never
+        nest inside (or silently reuse) a previous version's workdir."""
+        target = f"{self.workdir}/{app_version}"
+        subprocess.run(
+            ["ssh", "-o", "StrictHostKeyChecking=no", f"{self.ssh_user}@{host}",
+             f"rm -rf {target} && mkdir -p {target}"],
+            check=True,
+        )
+        subprocess.run(
+            ["scp", "-r", "-q", "-o", "StrictHostKeyChecking=no", f"{src}/.",
+             f"{self.ssh_user}@{host}:{target}"],
+            check=True,
+        )
+        return target
+
+    def _launch(self, record, dep_dir, manifest, *, model_version):
+        targets = [self._push(host, dep_dir, record.app_version) for host in self.hosts]
+        coordinator = f"{self.hosts[0]}:{self.coordinator_port}"
+        procs = []
+        for i, host in enumerate(self.hosts):
+            env_prefix = (
+                f"JAX_COORDINATOR_ADDRESS={coordinator} "
+                f"JAX_NUM_PROCESSES={len(self.hosts)} JAX_PROCESS_ID={i} "
+                f"UNIONML_TPU_HOME={self.root} UNIONML_TPU_PROJECT={self.project} "
+            )
+            cmd = (
+                f"cd {targets[i]} && {env_prefix}"
+                f"python -m unionml_tpu.remote.runner --app {manifest['app']} "
+                f"--workflow {record.workflow} --exec-dir {record.exec_dir}"
+                + (f" --model-version {model_version}" if model_version else "")
+            )
+            log = open(Path(record.exec_dir) / f"runner.host{i}.log", "w")
+            procs.append(self._ssh(host, cmd, stdout=log, stderr=log))
+        # host 0 writes outputs back over a shared filesystem; the record
+        # status is updated by the runner on host 0.
+
+
+def get_backend(
+    config_file: Optional[str] = None,
+    *,
+    project: str,
+    domain: str = "development",
+) -> BaseBackend:
+    """Build a backend from YAML config, defaulting to the local sandbox
+    (the reference's Config.auto localhost fallback, model.py:661-663)."""
+    if config_file:
+        import yaml
+
+        with open(config_file) as f:
+            cfg = yaml.safe_load(f) or {}
+        backend_cfg = cfg.get("backend", {})
+        if backend_cfg.get("type") == "tpu_vm":
+            return TPUVMBackend(
+                hosts=backend_cfg["hosts"],
+                ssh_user=backend_cfg.get("ssh_user", "root"),
+                workdir=backend_cfg.get("workdir", "/tmp/unionml_tpu_app"),
+                coordinator_port=backend_cfg.get("coordinator_port", 8476),
+                project=project,
+                domain=domain,
+                root=backend_cfg.get("root"),
+            )
+        return LocalBackend(project=project, domain=domain, root=backend_cfg.get("root"))
+    return LocalBackend(project=project, domain=domain)

@@ -1,0 +1,86 @@
+"""App versioning + source packaging.
+
+Reference behavior being replicated:
+- ``get_app_version`` (remote.py:43-57): version = git HEAD SHA; raises
+  :class:`VersionFetchError` on a dirty tree unless ``allow_uncommitted``.
+- fast/patch registration (remote.py:126-138): package source only,
+  skipping the expensive image build — here the "image" is the full
+  deployment copy and a patch overlays source files onto an existing
+  deployment.
+
+git is invoked via subprocess (no gitpython dependency).
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+import uuid
+from pathlib import Path
+from typing import Iterable, Optional
+
+EXCLUDE_DIRS = {".git", "__pycache__", ".pytest_cache", ".unionml_tpu", ".cache", "node_modules"}
+
+
+class VersionFetchError(RuntimeError):
+    """Raised when an app version cannot be derived (reference: remote.py:24)."""
+
+
+def _git(args, cwd=None) -> str:
+    out = subprocess.run(
+        ["git", *args], cwd=cwd, capture_output=True, text=True, check=True
+    )
+    return out.stdout.strip()
+
+
+def get_app_version(allow_uncommitted: bool = False, cwd: Optional[str] = None) -> str:
+    """Git-SHA app version with dirty-tree guard (reference: remote.py:43-57)."""
+    try:
+        dirty = _git(["status", "--porcelain"], cwd=cwd)
+        if dirty and not allow_uncommitted:
+            raise VersionFetchError(
+                "Git working tree has uncommitted changes; commit them or pass "
+                "allow_uncommitted=True to version the app anyway."
+            )
+        sha = _git(["rev-parse", "HEAD"], cwd=cwd)
+        return sha[:7] if not dirty else f"{sha[:7]}-dirty"
+    except subprocess.CalledProcessError as exc:
+        raise VersionFetchError(
+            f"Could not derive app version from git: {exc.stderr or exc}"
+        ) from exc
+    except FileNotFoundError as exc:
+        raise VersionFetchError("git binary not found") from exc
+
+
+def patch_suffix() -> str:
+    """Short unique suffix for patch versions (reference: model.py:700-701)."""
+    return uuid.uuid4().hex[:8]
+
+
+def iter_source_files(src: Path) -> Iterable[Path]:
+    for path in sorted(src.rglob("*")):
+        rel = path.relative_to(src)
+        if any(part in EXCLUDE_DIRS for part in rel.parts):
+            continue
+        if path.is_file():
+            yield path
+
+
+def package_source(src_dir, dest_dir, *, patch: bool = False) -> int:
+    """Copy the app source tree into a deployment directory.
+
+    Full mode replaces ``dest_dir``; patch mode overlays files onto the
+    existing deployment (the fast-registration analog,
+    reference remote.py:126-138). Returns the number of files packaged.
+    """
+    src, dest = Path(src_dir), Path(dest_dir)
+    if not patch and dest.exists():
+        shutil.rmtree(dest)
+    dest.mkdir(parents=True, exist_ok=True)
+    count = 0
+    for f in iter_source_files(src):
+        target = dest / f.relative_to(src)
+        target.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy2(f, target)
+        count += 1
+    return count

@@ -1,0 +1,116 @@
+"""Execution-side entrypoint: rehydrate the app and run one workflow.
+
+This is the analog of the flytekit container entrypoint + the reference's
+task resolver (reference: task_resolver.py:16-31): the runner re-imports
+the deployed app module, finds the Model variable, regenerates its
+compiled stages, and executes the requested workflow with the recorded
+inputs. On multi-host TPU slices it first brings up ``jax.distributed``
+from the coordinator env set by :class:`TPUVMBackend`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import pickle
+import sys
+import traceback
+from pathlib import Path
+
+
+def _set_status(exec_dir: Path, status: str):
+    # atomic replace: the backend's wait() polls this file concurrently
+    record_path = exec_dir / "record.json"
+    record = json.loads(record_path.read_text())
+    record["status"] = status
+    tmp = record_path.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps(record))
+    os.replace(tmp, record_path)
+
+
+def _load_model_artifact(model, exec_dir: Path, model_version: str):
+    """Resolve a model version (execution id or 'latest') from the registry
+    and load its artifact into ``model`` (reference: model.py:872-894)."""
+    from unionml_tpu.remote.backend import LocalBackend
+
+    backend = LocalBackend(
+        project=os.environ.get("UNIONML_TPU_PROJECT", model.name.replace("_", "-")),
+        root=os.environ.get("UNIONML_TPU_HOME"),
+    )
+    record = backend.get_model_execution(model, model_version=model_version)
+    outputs = backend.fetch_outputs(record)
+    from unionml_tpu.model import ModelArtifact
+
+    model.artifact = ModelArtifact(
+        outputs["model_object"], outputs.get("hyperparameters"), outputs.get("metrics")
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--app", required=True, help="module:variable of the Model")
+    parser.add_argument("--workflow", required=True,
+                        choices=["train", "predict", "predict_from_features"])
+    parser.add_argument("--exec-dir", required=True)
+    parser.add_argument("--model-version", default="latest")
+    args = parser.parse_args(argv)
+
+    exec_dir = Path(args.exec_dir)
+    _set_status(exec_dir, "RUNNING")
+    try:
+        # multi-host bring-up when the TPU VM backend set coordinator env
+        if "JAX_COORDINATOR_ADDRESS" in os.environ:
+            from unionml_tpu.parallel import multihost_initialize
+
+            multihost_initialize(
+                coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+                num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+                process_id=int(os.environ["JAX_PROCESS_ID"]),
+            )
+
+        sys.path.insert(0, os.getcwd())
+        module_name, var_name = args.app.split(":")
+        module = importlib.import_module(module_name)
+        model = getattr(module, var_name)
+
+        with open(exec_dir / "inputs.pkl", "rb") as f:
+            inputs = pickle.load(f)
+
+        if args.workflow == "train":
+            trainer_kwargs = inputs.pop("trainer_kwargs", None) or {}
+            model_object, metrics = model.train(
+                hyperparameters=inputs.pop("hyperparameters", None),
+                loader_kwargs=inputs.pop("loader_kwargs", None),
+                splitter_kwargs=inputs.pop("splitter_kwargs", None),
+                parser_kwargs=inputs.pop("parser_kwargs", None),
+                trainer_kwargs=trainer_kwargs,
+                **inputs,
+            )
+            outputs = {
+                "model_object": model.artifact.model_object,
+                "hyperparameters": model.artifact.hyperparameters,
+                "metrics": metrics,
+            }
+        else:
+            _load_model_artifact(model, exec_dir, args.model_version)
+            features = inputs.pop("features", None)
+            predictions = model.predict(features=features, **inputs)
+            outputs = {"predictions": predictions}
+
+        # only process 0 writes outputs on multi-host runs
+        process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
+        if process_id == 0:
+            with open(exec_dir / "outputs.pkl", "wb") as f:
+                pickle.dump(outputs, f)
+            _set_status(exec_dir, "SUCCEEDED")
+        return 0
+    except Exception:
+        traceback.print_exc()
+        _set_status(exec_dir, "FAILED")
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

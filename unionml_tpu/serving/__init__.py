@@ -1,0 +1,18 @@
+"""Serving: HTTP endpoints + on-device micro-batching.
+
+Reference counterpart: unionml/fastapi.py (FastAPI-only, per-request
+predictor call). The TPU-native redesign has two layers:
+
+- :mod:`unionml_tpu.serving.batcher` — a micro-batcher that coalesces
+  concurrent requests into one padded, bucketed device call (XLA compiles
+  one executable per bucket; p50 latency amortizes MXU dispatch).
+- transport: :mod:`unionml_tpu.serving.http` is a dependency-free stdlib
+  HTTP server with the same surface (``GET /``, ``POST /predict``,
+  ``GET /health``); :mod:`unionml_tpu.serving.fastapi` mounts the identical
+  routes on a FastAPI app when that stack is installed.
+"""
+
+from unionml_tpu.serving.batcher import MicroBatcher
+from unionml_tpu.serving.http import ServingApp, create_app
+
+__all__ = ["MicroBatcher", "ServingApp", "create_app"]

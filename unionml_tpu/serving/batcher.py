@@ -1,0 +1,183 @@
+"""On-device micro-batcher: coalesce requests, pad to buckets, one call.
+
+No reference counterpart — unionml/fastapi.py:50-64 runs one predictor
+call per HTTP request. On TPU that wastes the MXU (batch-1 matmuls) and
+pays dispatch latency per request. This batcher:
+
+1. queues concurrent requests,
+2. drains up to ``max_batch_size`` of them (waiting at most
+   ``max_wait_ms`` after the first arrival),
+3. concatenates features along the batch axis and right-pads to the next
+   **bucket size** so XLA compiles exactly ``len(buckets)`` executables
+   (SURVEY.md §7 hard part (e): bucketed shapes vs. recompilation),
+4. runs the predictor once, splits results back per-request.
+
+Thread-based (works under any transport, stdlib or ASGI); the device call
+itself is serialized, which is the desired behavior on a single chip.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from unionml_tpu._logging import logger
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+
+def _leading_dim(features: Any) -> int:
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(features)
+    return int(leaves[0].shape[0]) if leaves else 0
+
+
+def _concat(items: Sequence[Any]) -> Any:
+    import jax
+
+    return jax.tree_util.tree_map(lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0), *items)
+
+
+def _pad_to(features: Any, n: int) -> Any:
+    import jax
+
+    def pad(x):
+        x = np.asarray(x)
+        if x.shape[0] >= n:
+            return x
+        pad_width = [(0, n - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+        return np.pad(x, pad_width, mode="edge")
+
+    return jax.tree_util.tree_map(pad, features)
+
+
+def _slice_rows(result: Any, start: int, stop: int) -> Any:
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: np.asarray(x)[start:stop], result)
+
+
+@dataclass
+class _Pending:
+    features: Any
+    rows: int
+    event: threading.Event = field(default_factory=threading.Event)
+    result: Any = None
+    error: Optional[BaseException] = None
+
+
+class MicroBatcher:
+    """Coalesce concurrent predict calls into bucketed device batches."""
+
+    def __init__(
+        self,
+        predict_fn: Callable[[Any], Any],
+        *,
+        max_batch_size: int = 64,
+        max_wait_ms: float = 5.0,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+    ):
+        self._predict_fn = predict_fn
+        self.max_batch_size = max_batch_size
+        self.max_wait_s = max_wait_ms / 1000.0
+        self.buckets = tuple(sorted(set(buckets) | {max_batch_size}))
+        self._queue: "queue.Queue[_Pending]" = queue.Queue()
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._run, daemon=True, name="unionml-tpu-batcher")
+        self._worker.start()
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def submit(self, features: Any, timeout: Optional[float] = 60.0) -> Any:
+        """Block until the batched prediction for ``features`` is ready."""
+        pending = _Pending(features=features, rows=_leading_dim(features))
+        self._queue.put(pending)
+        if not pending.event.wait(timeout):
+            raise TimeoutError("micro-batcher did not produce a result in time")
+        if pending.error is not None:
+            raise pending.error
+        return pending.result
+
+    def close(self):
+        self._stop.set()
+        self._worker.join(timeout=1.0)
+        # fail fast for requests still queued instead of letting their
+        # submit() calls block until timeout
+        while True:
+            try:
+                pending = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            pending.error = RuntimeError("micro-batcher closed")
+            pending.event.set()
+
+    # ------------------------------------------------------------------ #
+
+    def _drain(self) -> List[_Pending]:
+        try:
+            first = self._queue.get(timeout=0.1)
+        except queue.Empty:
+            return []
+        batch = [first]
+        rows = first.rows
+        deadline = threading.Event()
+        timer = threading.Timer(self.max_wait_s, deadline.set)
+        timer.start()
+        try:
+            while rows < self.max_batch_size and not deadline.is_set():
+                try:
+                    nxt = self._queue.get(timeout=self.max_wait_s / 4)
+                except queue.Empty:
+                    continue
+                if rows + nxt.rows > self.max_batch_size:
+                    self._queue.put(nxt)  # over cap: leave for the next batch
+                    break
+                batch.append(nxt)
+                rows += nxt.rows
+        finally:
+            timer.cancel()
+        return batch
+
+    def _run(self):
+        while not self._stop.is_set():
+            batch = self._drain()
+            if not batch:
+                continue
+            try:
+                feats = _concat([p.features for p in batch])
+                total = sum(p.rows for p in batch)
+                # a single request may exceed the largest bucket: chunk the
+                # device calls so every call still hits a bucketed shape
+                cap = self.buckets[-1]
+                parts = []
+                for start in range(0, total, cap):
+                    stop = min(start + cap, total)
+                    chunk = _slice_rows(feats, start, stop) if total > cap else feats
+                    padded = _pad_to(chunk, self._bucket(stop - start))
+                    out = self._predict_fn(padded)
+                    if isinstance(out, list):
+                        # predictors returning plain lists: the list IS the
+                        # batch axis, not a pytree of per-example outputs
+                        out = np.asarray(out)
+                    parts.append(_slice_rows(out, 0, stop - start))
+                result = _concat(parts) if len(parts) > 1 else parts[0]
+                offset = 0
+                for p in batch:
+                    p.result = _slice_rows(result, offset, offset + p.rows)
+                    offset += p.rows
+            except BaseException as exc:  # surface errors to every waiter
+                logger.info(f"micro-batcher error: {exc!r}")
+                for p in batch:
+                    p.error = exc
+            finally:
+                for p in batch:
+                    p.event.set()

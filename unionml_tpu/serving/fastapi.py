@@ -1,0 +1,76 @@
+"""FastAPI adapter: mount the serving surface on a user-supplied app.
+
+Route-for-route parity with reference unionml/fastapi.py:15-70, delegating
+all behavior to :class:`unionml_tpu.serving.http.ServingApp` so the stdlib
+and FastAPI transports cannot drift. FastAPI/pydantic are optional — when
+absent (e.g. minimal TPU VM images), use ``unionml_tpu.serving.create_app``
+or pass ``app=None`` to ``Model.serve``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from unionml_tpu.serving.http import ServingApp
+
+
+def serving_app(
+    model,
+    app: Any = None,
+    *,
+    remote: bool = False,
+    app_version: Optional[str] = None,
+    model_version: str = "latest",
+    batch: bool = False,
+    **batcher_kwargs,
+):
+    """Mount ``/``, ``/predict``, ``/health`` (reference: fastapi.py:15-70).
+
+    With ``app=None`` returns the dependency-free :class:`ServingApp`;
+    otherwise ``app`` must be a FastAPI instance.
+    """
+    core = ServingApp(
+        model,
+        remote=remote,
+        app_version=app_version,
+        model_version=model_version,
+        batch=batch,
+        **batcher_kwargs,
+    )
+    if app is None:
+        return core
+
+    try:
+        from fastapi import FastAPI, HTTPException  # gated optional import
+        from fastapi.responses import HTMLResponse
+    except ImportError as exc:
+        raise ImportError(
+            "fastapi is not installed. Pass app=None (or use "
+            "unionml_tpu.serving.create_app) for the dependency-free HTTP "
+            "server, or install fastapi+uvicorn."
+        ) from exc
+
+    if not isinstance(app, FastAPI):
+        raise TypeError(f"app must be a FastAPI instance, got {type(app)}")
+
+    @app.on_event("startup")
+    async def setup_model():  # reference: fastapi.py:22-34
+        core.setup_model()
+
+    @app.get("/", response_class=HTMLResponse)
+    def root():  # reference: fastapi.py:36-48
+        return core.root()
+
+    @app.post("/predict")
+    async def predict(payload: dict):  # reference: fastapi.py:50-64
+        try:
+            return core.predict(payload)
+        except (ValueError, KeyError, TypeError) as exc:
+            raise HTTPException(status_code=422, detail=str(exc))
+
+    @app.get("/health")
+    async def health():  # reference: fastapi.py:66-70
+        return core.health()
+
+    app.state.unionml_tpu = core
+    return app

@@ -1,0 +1,211 @@
+"""Dependency-free HTTP serving transport (stdlib only).
+
+Same endpoint surface as the reference's FastAPI app
+(reference: unionml/fastapi.py:15-70):
+
+- ``GET /`` — HTML landing page,
+- ``POST /predict`` — body ``{"inputs": {reader kwargs}}`` or
+  ``{"features": ...}``; features flow through
+  ``dataset.get_features`` then the (optionally micro-batched) predictor,
+- ``GET /health`` — ``{"status": "ok", "model_loaded": bool}``.
+
+Startup model loading mirrors fastapi.py:22-34: ``UNIONML_MODEL_PATH``
+env first, then the remote registry when ``remote=True``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+import numpy as np
+
+from unionml_tpu._logging import logger
+
+LANDING_HTML = """<html><head><title>unionml-tpu</title></head>
+<body><h1>unionml-tpu serving: {name}</h1>
+<p>POST /predict with {{"inputs": ...}} or {{"features": ...}}</p>
+<p>GET /health</p></body></html>"""
+
+
+def _to_jsonable(obj: Any) -> Any:
+    if isinstance(obj, (bool, int, float, str, type(None))):
+        return obj
+    if hasattr(obj, "tolist"):  # numpy / jax arrays and scalars
+        return np.asarray(obj).tolist()
+    if hasattr(obj, "to_dict"):  # DataFrame
+        return obj.to_dict(orient="records")
+    if isinstance(obj, (list, tuple)):
+        return [_to_jsonable(o) for o in obj]
+    if isinstance(obj, dict):
+        return {str(k): _to_jsonable(v) for k, v in obj.items()}
+    try:
+        return np.asarray(obj).tolist()
+    except Exception:
+        return str(obj)
+
+
+class ServingApp:
+    """Holds the model + batcher; dispatches routes for any transport."""
+
+    def __init__(
+        self,
+        model,
+        *,
+        remote: bool = False,
+        app_version: Optional[str] = None,
+        model_version: str = "latest",
+        batch: bool = False,
+        model_path_env: str = "UNIONML_MODEL_PATH",
+        **batcher_kwargs,
+    ):
+        self.model = model
+        self.remote = remote
+        self.app_version = app_version
+        self.model_version = model_version
+        self.model_path_env = model_path_env
+        self.batch = batch
+        self._batcher = None
+        self._batcher_kwargs = batcher_kwargs
+        self._server: Optional[ThreadingHTTPServer] = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def setup_model(self):
+        """Load the artifact (reference: fastapi.py:22-34)."""
+        model_path = os.getenv(self.model_path_env)
+        if model_path is not None and model_path != "":
+            self.model.load(model_path)
+        elif self.remote:
+            from unionml_tpu.remote import load_latest_artifact
+
+            load_latest_artifact(
+                self.model, app_version=self.app_version, model_version=self.model_version
+            )
+        if self.model.artifact is None:
+            raise RuntimeError(
+                f"Model artifact unavailable: set {self.model_path_env} or serve "
+                "with remote=True against a deployed app."
+            )
+        if self.batch:
+            from unionml_tpu.serving.batcher import MicroBatcher
+
+            predictor = self.model._predictor
+            model_object = self.model.artifact.model_object
+            if self.model._predict_step_options.get("jit"):
+                from unionml_tpu.execution import jit_predictor
+
+                predictor = jit_predictor(predictor)
+            self._batcher = MicroBatcher(
+                lambda feats: predictor(model_object, feats), **self._batcher_kwargs
+            )
+
+    # -- route handlers ---------------------------------------------------
+
+    def root(self) -> str:
+        return LANDING_HTML.format(name=self.model.name)
+
+    def health(self) -> dict:
+        return {"status": "ok", "model_loaded": self.model.artifact is not None}
+
+    def predict(self, payload: dict) -> Any:
+        if self.model.artifact is None:
+            self.setup_model()
+        inputs = payload.get("inputs")
+        features = payload.get("features")
+        if (inputs is None) == (features is None):
+            raise ValueError("provide exactly one of 'inputs' or 'features'")
+        if inputs is not None:
+            return _to_jsonable(self.model.predict(**inputs))
+        loaded = self.model.dataset.get_features(features)
+        if self._batcher is not None:
+            return _to_jsonable(self._batcher.submit(loaded))
+        return _to_jsonable(
+            self.model.predict_from_features_workflow()(
+                model_object=self.model.artifact.model_object, features=loaded
+            )
+        )
+
+    # -- stdlib HTTP transport --------------------------------------------
+
+    def _make_handler(self):
+        app = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                logger.info(f"http: {fmt % args}")
+
+            def _send(self, code: int, body: Any, content_type="application/json"):
+                data = (
+                    body.encode() if isinstance(body, str) else json.dumps(body).encode()
+                )
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/":
+                    self._send(200, app.root(), content_type="text/html")
+                elif self.path == "/health":
+                    self._send(200, app.health())
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._send(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    try:
+                        payload = json.loads(self.rfile.read(length) or b"{}")
+                    except json.JSONDecodeError as exc:
+                        self._send(422, {"error": f"request body must be JSON: {exc}"})
+                        return
+                    self._send(200, app.predict(payload))
+                except (ValueError, KeyError, TypeError) as exc:
+                    self._send(422, {"error": str(exc)})
+                except Exception as exc:  # unexpected: surface as 500
+                    logger.info(f"predict error: {exc!r}")
+                    self._send(500, {"error": str(exc)})
+
+        return Handler
+
+    def serve(self, host: str = "127.0.0.1", port: int = 8000, *, blocking: bool = True):
+        """Start the HTTP server; ``blocking=False`` runs it on a thread and
+        returns the bound ``(host, port)``."""
+        self.setup_model()
+        self._server = ThreadingHTTPServer((host, port), self._make_handler())
+        bound = self._server.server_address
+        logger.info(f"serving {self.model.name} on http://{bound[0]}:{bound[1]}")
+        if blocking:
+            try:
+                self._server.serve_forever()
+            except KeyboardInterrupt:
+                pass
+            finally:
+                self._server.server_close()
+        else:
+            thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+            thread.start()
+        return bound
+
+    def shutdown(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._batcher is not None:
+            self._batcher.close()
+            self._batcher = None
+
+
+def create_app(model, **kwargs) -> ServingApp:
+    """Build a :class:`ServingApp` for ``model`` (the dependency-free analog
+    of mounting routes on a FastAPI app)."""
+    return ServingApp(model, **kwargs)

@@ -1,0 +1,46 @@
+"""{{app_name}}: a unionml-tpu app (sklearn digits quickstart).
+
+Template parity: reference templates/basic/{{cookiecutter.app_name}}/app.py.
+Train locally with ``python app.py``, serve with
+``unionml-tpu serve app:model --model-path model.joblib``.
+"""
+
+import pandas as pd
+from sklearn.linear_model import LogisticRegression
+
+from unionml_tpu import Dataset, Model
+
+dataset = Dataset(name="{{app_name}}_dataset", test_size=0.2, shuffle=True, targets=["target"])
+model = Model(name="{{app_name}}", init=LogisticRegression, dataset=dataset)
+
+
+@dataset.reader
+def reader() -> pd.DataFrame:
+    from sklearn.datasets import load_digits
+
+    return load_digits(as_frame=True).frame
+
+
+@model.trainer
+def trainer(
+    estimator: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame
+) -> LogisticRegression:
+    return estimator.fit(features, target.squeeze())
+
+
+@model.predictor
+def predictor(estimator: LogisticRegression, features: pd.DataFrame) -> list:
+    return [float(x) for x in estimator.predict(features)]
+
+
+@model.evaluator
+def evaluator(
+    estimator: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame
+) -> float:
+    return float(estimator.score(features, target.squeeze()))
+
+
+if __name__ == "__main__":
+    estimator, metrics = model.train(hyperparameters={"max_iter": 5000})
+    print(f"metrics: {metrics}")
+    model.save("model.joblib")

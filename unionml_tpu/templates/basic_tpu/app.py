@@ -1,0 +1,101 @@
+"""{{app_name}}: a TPU-native unionml-tpu app (flax MLP on MNIST-style digits).
+
+The trainer is a jittable per-batch step compiled over a device mesh —
+the north-star path (no reference counterpart; the reference's templates
+are CPU sklearn/torch apps).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from flax.training import train_state
+
+from unionml_tpu import Dataset, Model
+from unionml_tpu.parallel import ShardingConfig
+
+dataset = Dataset(name="{{app_name}}_dataset", test_size=0.2)
+model = Model(name="{{app_name}}", dataset=dataset)
+
+
+@dataset.reader
+def reader() -> dict:
+    from sklearn.datasets import load_digits
+
+    digits = load_digits()
+    return {
+        "features": digits.data.astype(np.float32) / 16.0,
+        "targets": digits.target.astype(np.int32),
+    }
+
+
+@dataset.splitter
+def splitter(data: dict, test_size: float, shuffle: bool, random_state: int):
+    n = len(data["features"])
+    idx = np.arange(n)
+    if shuffle:
+        np.random.default_rng(random_state).shuffle(idx)
+    k = int(n * (1 - test_size))
+    tr, te = idx[:k], idx[k:]
+    return (
+        {"features": data["features"][tr], "targets": data["targets"][tr]},
+        {"features": data["features"][te], "targets": data["targets"][te]},
+    )
+
+
+@dataset.parser
+def parser(data: dict, features, targets):
+    return (data["features"], data["targets"])
+
+
+class MLP(nn.Module):
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(10)(x)
+
+
+@model.init
+def init(hyperparameters: dict) -> train_state.TrainState:
+    module = MLP(hidden=hyperparameters.get("hidden", 128))
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 64)))["params"]
+    return train_state.TrainState.create(
+        apply_fn=module.apply,
+        params=params,
+        tx=optax.adam(hyperparameters.get("learning_rate", 1e-3)),
+    )
+
+
+@model.train_step(sharding=ShardingConfig(data=-1))
+def train_step(state, batch):
+    x, y = batch
+
+    def loss_fn(params):
+        logits = state.apply_fn({"params": params}, x)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    return state.apply_gradients(grads=grads), {"loss": loss}
+
+
+@model.predictor(jit=True)
+def predictor(state: train_state.TrainState, features: np.ndarray) -> jnp.ndarray:
+    return jnp.argmax(state.apply_fn({"params": state.params}, features), axis=-1)
+
+
+@model.evaluator
+def evaluator(state: train_state.TrainState, features: np.ndarray, targets: np.ndarray) -> float:
+    logits = state.apply_fn({"params": state.params}, features)
+    return float((jnp.argmax(logits, axis=-1) == targets).mean())
+
+
+if __name__ == "__main__":
+    state, metrics = model.train(
+        hyperparameters={"hidden": 128, "learning_rate": 1e-3},
+        trainer_kwargs={"num_epochs": 10, "batch_size": 128},
+    )
+    print(f"metrics: {metrics}")
+    model.save("model.utpu")
