@@ -1,0 +1,21 @@
+"""TPU compute ops: attention family, MoE dispatch, fused kernels.
+
+No reference counterpart (the reference is an orchestration framework; its
+FLOPs live in sklearn/torch — SURVEY.md §2). These ops are the hot-path
+kernels of the TPU-native model zoo:
+
+- :mod:`unionml_tpu.ops.attention` — XLA multi-head attention (GQA-aware)
+  + memory-efficient blockwise attention (online softmax over KV blocks).
+- :mod:`unionml_tpu.ops.flash_attention` — Pallas TPU flash-attention
+  kernel (VMEM-tiled, MXU-shaped, causal block skipping).
+- :mod:`unionml_tpu.ops.ring_attention` — sequence-parallel attention via
+  shard_map + ppermute KV rotation over ICI.
+- :mod:`unionml_tpu.ops.ulysses` — all-to-all head<->sequence reshuffle
+  sequence parallelism.
+- :mod:`unionml_tpu.ops.moe` — mixture-of-experts routing + expert-parallel
+  dispatch.
+"""
+
+from unionml_tpu.ops.attention import attention, blockwise_attention, mha_reference
+
+__all__ = ["attention", "blockwise_attention", "mha_reference"]

@@ -1,0 +1,209 @@
+"""Multi-head attention: XLA reference + memory-efficient blockwise form.
+
+Convention (all attention ops in this package): tensors are
+``[batch, seq, heads, head_dim]`` ("BSHD"). GQA is supported everywhere —
+``k``/``v`` may have fewer heads than ``q`` as long as the count divides.
+
+- :func:`mha_reference` materializes the full [S, S] score matrix; XLA
+  fuses the softmax chain well, and on TPU this is the fastest choice for
+  short/medium sequences that fit HBM.
+- :func:`blockwise_attention` never materializes scores: a ``lax.scan``
+  over KV blocks with an **online softmax** (running max + normalizer),
+  trading FLOPs for O(S·block) memory — the long-context building block
+  that ring attention reuses per-shard.
+- :func:`attention` dispatches between implementations.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(k: jnp.ndarray, num_q_heads: int) -> jnp.ndarray:
+    """GQA: repeat kv heads to match q heads."""
+    num_kv_heads = k.shape[2]
+    if num_kv_heads == num_q_heads:
+        return k
+    if num_q_heads % num_kv_heads:
+        raise ValueError(f"q heads {num_q_heads} must be a multiple of kv heads {num_kv_heads}")
+    return jnp.repeat(k, num_q_heads // num_kv_heads, axis=2)
+
+
+def _causal_mask(q_len: int, kv_len: int, q_offset: int = 0, kv_offset: int = 0) -> jnp.ndarray:
+    """[q_len, kv_len] bool mask, True where attention is allowed."""
+    q_pos = q_offset + jnp.arange(q_len)[:, None]
+    kv_pos = kv_offset + jnp.arange(kv_len)[None, :]
+    return q_pos >= kv_pos
+
+
+def mha_reference(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    bias: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Full-score multi-head attention ([B,S,H,D] in/out).
+
+    ``bias`` broadcasts against [B, H, Sq, Skv]; ``segment_ids`` ([B, S])
+    restricts attention within equal segments (packed sequences).
+    """
+    *_, num_q_heads, head_dim = q.shape
+    k = _repeat_kv(k, num_q_heads)
+    v = _repeat_kv(v, num_q_heads)
+    scale = scale if scale is not None else head_dim**-0.5
+
+    # [B,H,Sq,Skv] scores on the MXU in fp32 for numerical stability
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        scores = scores + bias
+    if causal:
+        mask = _causal_mask(q.shape[1], k.shape[1], kv_offset=k.shape[1] - q.shape[1])
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        scores = jnp.where(jnp.swapaxes(seg_mask, -1, -2), scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def blockwise_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    block_size: int = 512,
+    scale: Optional[float] = None,
+    q_offset: int = 0,
+    kv_offset: int = 0,
+) -> jnp.ndarray:
+    """Online-softmax attention scanned over KV blocks ([B,S,H,D] in/out).
+
+    Memory is O(Sq·block_size) instead of O(Sq·Skv). ``q_offset`` /
+    ``kv_offset`` give the global positions of the local q/kv shards so
+    ring attention can reuse this per rotation step with correct causal
+    masking.
+    """
+    out, _, _ = _blockwise_accumulate(
+        q, k, v, causal=causal, block_size=block_size, scale=scale,
+        q_offset=q_offset, kv_offset=kv_offset,
+    )
+    return out.astype(q.dtype)
+
+
+def _blockwise_accumulate(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool,
+    block_size: int,
+    scale: Optional[float],
+    q_offset: int = 0,
+    kv_offset: int = 0,
+    acc: Optional[tuple] = None,
+):
+    """Scan KV blocks, returning ``(out, running_max, normalizer)``.
+
+    ``acc = (out_unnormalized, m, l)`` lets callers (ring attention) chain
+    accumulation across KV shards and normalize once at the end.
+    """
+    batch, q_len, num_q_heads, head_dim = q.shape
+    kv_len = k.shape[1]
+    k = _repeat_kv(k, num_q_heads)
+    v = _repeat_kv(v, num_q_heads)
+    scale = scale if scale is not None else head_dim**-0.5
+
+    block_size = min(block_size, kv_len)
+    num_blocks = -(-kv_len // block_size)
+    pad = num_blocks * block_size - kv_len
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+
+    # [num_blocks, B, block, H, D] for the scan carry-free xs
+    k_blocks = k.reshape(batch, num_blocks, block_size, num_q_heads, head_dim).swapaxes(0, 1)
+    v_blocks = v.reshape(batch, num_blocks, block_size, num_q_heads, head_dim).swapaxes(0, 1)
+
+    q_pos = q_offset + jnp.arange(q_len)
+    qf = q.astype(jnp.float32)
+
+    if acc is None:
+        out0 = jnp.zeros((batch, q_len, num_q_heads, head_dim), jnp.float32)
+        m0 = jnp.full((batch, q_len, num_q_heads), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((batch, q_len, num_q_heads), jnp.float32)
+    else:
+        out0, m0, l0 = acc
+
+    def body(carry, inputs):
+        out_acc, m_acc, l_acc = carry
+        blk_idx, k_blk, v_blk = inputs
+        kv_pos = kv_offset + blk_idx * block_size + jnp.arange(block_size)
+
+        # [B,H,Q,Bk] block scores in fp32
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * scale
+        valid = kv_pos < (kv_offset + kv_len)
+        mask = jnp.broadcast_to(valid[None, :], (q_len, block_size))
+        if causal:
+            mask = mask & (q_pos[:, None] >= kv_pos[None, :])
+        s = jnp.where(mask[None, None], s, NEG_INF)
+
+        m_blk = jnp.max(s, axis=-1)                      # [B,H,Q]
+        m_new = jnp.maximum(m_acc, m_blk.transpose(0, 2, 1))  # [B,Q,H]
+        # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
+        m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - m_safe.transpose(0, 2, 1)[..., None])
+        p = jnp.where(mask[None, None], p, 0.0)
+        corr = jnp.exp(jnp.where(m_acc == NEG_INF, NEG_INF, m_acc - m_safe))
+        l_new = l_acc * corr + jnp.sum(p, axis=-1).transpose(0, 2, 1)
+        out_new = out_acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        return (out_new, m_new, l_new), None
+
+    (out, m, l), _ = jax.lax.scan(
+        body, (out0, m0, l0), (jnp.arange(num_blocks), k_blocks, v_blocks)
+    )
+    if acc is not None:
+        return out, m, l
+    return out / jnp.maximum(l, 1e-30)[..., None], m, l
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    impl: str = "xla",
+    block_size: int = 512,
+    **kwargs,
+) -> jnp.ndarray:
+    """Dispatch between attention implementations.
+
+    impl: ``"xla"`` (full scores, fastest for short seqs), ``"blockwise"``
+    (O(S·block) memory), ``"flash"`` (Pallas TPU kernel).
+    """
+    if impl == "xla":
+        return mha_reference(q, k, v, causal=causal, **kwargs)
+    if impl == "blockwise":
+        return blockwise_attention(q, k, v, causal=causal, block_size=block_size, **kwargs)
+    if impl == "flash":
+        from unionml_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, **kwargs)
+    raise ValueError(f"unknown attention impl {impl!r}; use xla|blockwise|flash")

@@ -1,0 +1,186 @@
+"""Pallas TPU flash-attention kernel.
+
+VMEM-tiled attention with online softmax: the grid walks
+``(batch*heads, q_blocks, kv_blocks)`` with the KV dimension innermost —
+TPU grids execute sequentially, so fp32 accumulators in VMEM scratch carry
+across KV iterations (running max / normalizer / weighted sum), and the
+normalized output is written once on the last KV block. Causal q/kv block
+pairs that are fully masked are predicated out with ``pl.when`` (no MXU
+work issued).
+
+Block shapes default to 128×128 (MXU-shaped); scores accumulate in fp32
+(``preferred_element_type``) regardless of input dtype, so bf16 inputs are
+safe. Backward is a recompute VJP against the blockwise reference — exact
+gradients, no stored score matrix.
+
+On non-TPU backends (CPU tests) the kernel runs in interpreter mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale, causal,
+            block_q, block_kv, num_kv_blocks, q_len, kv_len):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = qi * block_q
+    kv_start = ki * block_kv
+    # causal: skip kv blocks entirely in the future of this q block
+    run = jnp.logical_or(
+        jnp.logical_not(causal), kv_start <= q_start + block_q - 1
+    )
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)          # [BKV, D]
+        v = v_ref[0].astype(jnp.float32)          # [BKV, D]
+        # zero padded kv rows: OOB block reads are undefined (NaN in
+        # interpret mode) and 0 * NaN would contaminate the p @ v matmul
+        kv_valid = (kv_start + jax.lax.broadcasted_iota(jnp.int32, (block_kv, 1), 0)) < kv_len
+        v = jnp.where(kv_valid, v, 0.0)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                  # [BQ, BKV]
+
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+        kv_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+        # mask padded q rows (ragged last block) and padded kv columns
+        mask = jnp.logical_and(q_pos < q_len, kv_pos < kv_len)
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= kv_pos)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:]                          # [BQ, 1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_safe))
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:] = m_new
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd_bhsd(q, k, v, *, causal, scale, block_q, block_kv, interpret):
+    """q,k,v: [BH, S, D] (kv heads already repeated)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, q_len, head_dim = q.shape
+    kv_len = k.shape[1]
+    block_q = min(block_q, q_len)
+    block_kv = min(block_kv, kv_len)
+    num_q_blocks = pl.cdiv(q_len, block_q)
+    num_kv_blocks = pl.cdiv(kv_len, block_kv)
+
+    kernel = functools.partial(
+        _kernel,
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_kv=block_kv,
+        num_kv_blocks=num_kv_blocks,
+        q_len=q_len,
+        kv_len=kv_len,
+    )
+    grid = (bh, num_q_blocks, num_kv_blocks)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_kv, head_dim), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_kv, head_dim), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, q_len, head_dim), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_kv):
+    interpret = jax.devices()[0].platform != "tpu"
+    num_q_heads = q.shape[2]
+    from unionml_tpu.ops.attention import _repeat_kv
+
+    k_r = _repeat_kv(k, num_q_heads)
+    v_r = _repeat_kv(v, num_q_heads)
+
+    def to_bhsd(x):
+        b, s, h, d = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    out = _flash_fwd_bhsd(
+        to_bhsd(q), to_bhsd(k_r), to_bhsd(v_r),
+        causal=causal, scale=scale, block_q=block_q, block_kv=block_kv,
+        interpret=interpret,
+    )
+    b, s, h, d = q.shape
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_kv):
+    return _flash(q, k, v, causal, scale, block_q, block_kv), (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_kv, residuals, g):
+    # recompute VJP against the blockwise reference: exact gradients with
+    # O(S·block) memory, no stored score matrix
+    from unionml_tpu.ops.attention import blockwise_attention
+
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, causal=causal, scale=scale, block_size=block_kv
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_kv: int = 128,
+) -> jnp.ndarray:
+    """Flash attention over [B,S,H,D] tensors (GQA-aware, differentiable)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash(q, k, v, causal, scale, block_q, block_kv)

@@ -1,0 +1,92 @@
+"""Mixture-of-experts: top-k routing + expert-parallel dispatch.
+
+Expert parallelism (SURVEY.md §2.4 TPU additions): the expert dimension of
+the MLP weights is sharded over the mesh's ``expert`` axis. The dense
+einsum dispatch below keeps every tensor static-shaped (no gather/scatter
+with data-dependent shapes — XLA-friendly), and under pjit the one-hot
+combine einsums compile to ``all_to_all``-style collectives on the expert
+axis. Aux losses follow the standard load-balancing recipe (mean gate
+fraction x mean routing fraction per expert).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def top_k_routing(
+    gate_logits: jnp.ndarray, num_selected: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Softmax-normalized top-k routing.
+
+    gate_logits: [tokens, experts]. Returns (weights [T, k],
+    indices [T, k], aux_loss scalar).
+    """
+    num_experts = gate_logits.shape[-1]
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    weights, indices = jax.lax.top_k(probs, num_selected)
+    weights = weights / jnp.maximum(weights.sum(-1, keepdims=True), 1e-9)
+
+    # load-balancing aux loss (Switch-style)
+    routing_fraction = jnp.mean(
+        jax.nn.one_hot(indices[..., 0], num_experts, dtype=jnp.float32), axis=0
+    )
+    gate_fraction = jnp.mean(probs, axis=0)
+    aux_loss = num_experts * jnp.sum(routing_fraction * gate_fraction)
+    return weights.astype(gate_logits.dtype), indices, aux_loss
+
+
+class MoEMlp(nn.Module):
+    """Expert-parallel SwiGLU MLP block.
+
+    Weight shapes carry a leading expert dim — shard it with a
+    ``PartitionRule(r"moe/.*", ("expert", ...))`` to get expert parallelism
+    on the mesh.
+    """
+
+    num_experts: int
+    num_selected: int
+    hidden_dim: int
+    model_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """x: [batch, seq, model_dim] -> (out, aux_loss)."""
+        b, s, d = x.shape
+        tokens = x.reshape(b * s, d)
+
+        gate_logits = nn.Dense(self.num_experts, use_bias=False, dtype=self.dtype,
+                               name="router")(tokens)
+        weights, indices, aux_loss = top_k_routing(gate_logits, self.num_selected)
+
+        w_gate = self.param(
+            "w_gate", nn.initializers.lecun_normal(),
+            (self.num_experts, d, self.hidden_dim), self.dtype,
+        )
+        w_up = self.param(
+            "w_up", nn.initializers.lecun_normal(),
+            (self.num_experts, d, self.hidden_dim), self.dtype,
+        )
+        w_down = self.param(
+            "w_down", nn.initializers.lecun_normal(),
+            (self.num_experts, self.hidden_dim, d), self.dtype,
+        )
+
+        # dense one-hot dispatch: static shapes, collectives inserted by
+        # GSPMD when the expert dim is sharded
+        dispatch = jax.nn.one_hot(indices, self.num_experts, dtype=self.dtype)
+        # [T, k, E] x [T, d] -> per-expert token batches [E, T, d] weighted later
+        combine = jnp.einsum("tke,tk->te", dispatch, weights.astype(self.dtype))
+
+        mask = (combine > 0).astype(self.dtype)
+        expert_in = jnp.einsum("te,td->etd", mask, tokens.astype(self.dtype))
+        gated = jax.nn.silu(jnp.einsum("etd,edh->eth", expert_in, w_gate))
+        up = jnp.einsum("etd,edh->eth", expert_in, w_up)
+        expert_out = jnp.einsum("eth,ehd->etd", gated * up, w_down)
+        out = jnp.einsum("etd,te->td", expert_out, combine)
+        return out.reshape(b, s, d).astype(self.dtype), aux_loss

@@ -1,0 +1,112 @@
+"""Ring attention: sequence-parallel attention over the ICI ring.
+
+Long-context strategy (SURVEY.md §5.7): q/k/v are sharded along the
+sequence axis of the mesh; each device holds a [B, S/n, H, D] shard. The
+algorithm rotates the K/V shards around the ring with ``lax.ppermute``
+(ICI neighbor exchange) for n steps; every device accumulates blockwise
+online-softmax partial results for its local queries against each visiting
+K/V shard, normalizing once after the last step. Communication overlaps
+compute because ppermute of step i+1's shard is issued while step i's
+blockwise accumulation runs (XLA schedules the overlap; the per-step
+compute is itself a lax.scan over KV blocks).
+
+Causal masking uses **global** positions: the visiting shard at step s on
+device r originates from device (r - s) mod n, so its kv offset is known
+statically per step.
+
+The public entry :func:`ring_attention` wraps the per-shard body in
+``shard_map`` over the mesh's sequence axis; :func:`ring_attention_sharded`
+is the raw collective body for use inside an existing shard_map/pjit
+(e.g. the Llama trainer).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from unionml_tpu.ops.attention import NEG_INF, _blockwise_accumulate, _repeat_kv
+
+
+def ring_attention_sharded(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis: str = "sequence",
+    causal: bool = False,
+    block_size: int = 512,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Per-shard ring attention body (call inside shard_map).
+
+    ``q, k, v``: local shards [B, S_local, H, D]; returns the local output
+    shard. Requires every device's shard to have equal length.
+    """
+    n = lax.axis_size(axis)
+    my_idx = lax.axis_index(axis)
+    batch, s_local, num_q_heads, head_dim = q.shape
+    k = _repeat_kv(k, num_q_heads)
+    v = _repeat_kv(v, num_q_heads)
+    scale_ = scale if scale is not None else head_dim**-0.5
+
+    q_offset = my_idx * s_local
+
+    out0 = jnp.zeros((batch, s_local, num_q_heads, head_dim), jnp.float32)
+    m0 = jnp.full((batch, s_local, num_q_heads), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((batch, s_local, num_q_heads), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, s):
+        out, m, l, k_cur, v_cur = carry
+        # the shard visiting at step s came from device (my_idx - s) mod n
+        kv_offset = ((my_idx - s) % n) * s_local
+        # rotate while computing: XLA overlaps the ppermute with the scan
+        k_nxt = lax.ppermute(k_cur, axis, perm)
+        v_nxt = lax.ppermute(v_cur, axis, perm)
+        out, m, l = _blockwise_accumulate(
+            q, k_cur, v_cur,
+            causal=causal, block_size=block_size, scale=scale_,
+            q_offset=q_offset, kv_offset=kv_offset,
+            acc=(out, m, l),
+        )
+        return (out, m, l, k_nxt, v_nxt), None
+
+    (out, m, l, _, _), _ = lax.scan(
+        step, (out0, m0, l0, k, v), jnp.arange(n)
+    )
+    return (out / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh,
+    *,
+    axis: str = "sequence",
+    causal: bool = False,
+    block_size: int = 512,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Ring attention over globally-shaped [B,S,H,D] tensors.
+
+    Shards the sequence axis over ``mesh[axis]``, runs the ring, and
+    returns the globally-shaped output (sharded the same way).
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis, None, None)
+    body = functools.partial(
+        ring_attention_sharded, axis=axis, causal=causal,
+        block_size=block_size, scale=scale,
+    )
+    return shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False,
+    )(q, k, v)
