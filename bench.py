@@ -1,0 +1,88 @@
+"""Headline benchmark: ViT-B/16 trainer samples/sec/chip (BASELINE.json).
+
+The reference publishes no performance numbers (BASELINE.md), so this
+establishes the framework's own baseline: full training step
+(fwd + bwd + adamw) on the flagship ViT-B/16 config, bf16 compute, one
+chip. Prints ONE JSON line. ``vs_baseline`` is measured/baseline against
+the recorded number in BASELINE.md §measured (1.0 when none exists yet).
+
+Env knobs: UNIONML_TPU_BENCH_PRESET=tiny for a CPU smoke run;
+UNIONML_TPU_BENCH_BATCH to override the per-chip batch size.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+# Recorded result of a previous round on the target hardware (v5e-8, one
+# chip). Update when a round improves it; vs_baseline is computed against
+# this so the driver sees round-over-round progress.
+RECORDED_BASELINE_SAMPLES_PER_SEC = None  # none yet — round 1 establishes it
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # pre-registered TPU plugins can override the env var; config wins
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu.models import ViT, ViTConfig, classification_step, create_train_state
+
+    backend = jax.default_backend()
+    preset = os.environ.get(
+        "UNIONML_TPU_BENCH_PRESET", "tiny" if backend == "cpu" else "vit_b16"
+    )
+    if preset == "tiny":
+        cfg = ViTConfig.tiny(image_size=32, num_classes=10)
+        batch = int(os.environ.get("UNIONML_TPU_BENCH_BATCH", 32))
+        steps, warmup = 10, 3
+    else:
+        cfg = ViTConfig.base16(num_classes=1000)
+        batch = int(os.environ.get("UNIONML_TPU_BENCH_BATCH", 64))
+        steps, warmup = 20, 5
+
+    module = ViT(cfg)
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(
+        rng.normal(size=(batch, cfg.image_size, cfg.image_size, 3)), jnp.bfloat16
+    )
+    labels = jnp.asarray(rng.integers(0, cfg.num_classes, size=(batch,)), jnp.int32)
+
+    state = create_train_state(module, images[:1], learning_rate=1e-3)
+    step = jax.jit(classification_step(module), donate_argnums=0)
+
+    for _ in range(warmup):
+        state, metrics = step(state, (images, labels))
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, (images, labels))
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = batch * steps / dt
+    vs = (
+        samples_per_sec / RECORDED_BASELINE_SAMPLES_PER_SEC
+        if RECORDED_BASELINE_SAMPLES_PER_SEC
+        else 1.0
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"{preset}_train_samples_per_sec_per_chip",
+                "value": round(samples_per_sec, 2),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,169 @@
+"""Model zoo tests: forward shapes, train-step convergence, KV-cache decode
+equivalence, and sharded (8-device CPU mesh) training — the framework-matrix
+role of the reference's sklearn/pytorch/keras parametrization
+(reference: tests/integration/ app dirs; SURVEY.md §4.3(c))."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from unionml_tpu.models import (
+    BertClassifier,
+    BertConfig,
+    Llama,
+    LlamaConfig,
+    LLAMA_PARTITION_RULES,
+    Mlp,
+    MlpConfig,
+    ViT,
+    ViTConfig,
+    VIT_PARTITION_RULES,
+    classification_step,
+    create_train_state,
+    init_cache,
+    lm_step,
+    make_evaluator,
+    make_predictor,
+)
+from unionml_tpu.parallel import ShardingConfig
+
+
+def test_mlp_forward_and_training_converges():
+    cfg = MlpConfig(num_classes=2, hidden_dims=(32,))
+    module = Mlp(cfg)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    state = create_train_state(module, x[:2], learning_rate=1e-2)
+    step = jax.jit(classification_step(module))
+    for _ in range(100):
+        state, metrics = step(state, (x, y))
+    assert float(metrics["accuracy"]) > 0.9
+    evaluator = make_evaluator(module)
+    assert evaluator(state, x, y) > 0.9
+    preds = make_predictor(module)(state, x)
+    assert preds.shape == (64,)
+
+
+def test_vit_tiny_forward_shape():
+    cfg = ViTConfig.tiny(image_size=16, num_classes=3)
+    module = ViT(cfg)
+    x = jnp.zeros((2, 16, 16, 3))
+    params = module.init(jax.random.PRNGKey(0), x)["params"]
+    logits = module.apply({"params": params}, x)
+    assert logits.shape == (2, 3)
+    assert logits.dtype == jnp.float32
+
+
+def test_vit_base16_config_matches_paper():
+    cfg = ViTConfig.base16()
+    assert (cfg.hidden_dim, cfg.num_layers, cfg.num_heads, cfg.mlp_dim) == (
+        768, 12, 12, 3072,
+    )
+
+
+def test_bert_tiny_classifier_forward_with_mask():
+    cfg = BertConfig.tiny(vocab_size=100, num_classes=4)
+    module = BertClassifier(cfg)
+    ids = jnp.ones((2, 10), jnp.int32)
+    mask = jnp.array([[1] * 10, [1] * 5 + [0] * 5])
+    params = module.init(jax.random.PRNGKey(0), ids, attention_mask=mask)["params"]
+    logits = module.apply({"params": params}, ids, attention_mask=mask)
+    assert logits.shape == (2, 4)
+    # padding must not influence the [CLS] logits: same ids, padded vs not
+    short = module.apply({"params": params}, ids[:, :5], attention_mask=mask[:, :5])
+    np.testing.assert_allclose(logits[1], short[1], rtol=2e-2, atol=2e-2)
+
+
+def test_llama_tiny_lm_step_reduces_loss():
+    cfg = LlamaConfig.tiny(vocab_size=64)
+    module = Llama(cfg)
+    rng = np.random.default_rng(0)
+    tokens = np.asarray(rng.integers(0, 64, size=(8, 16)), np.int32)
+    state = create_train_state(module, jnp.asarray(tokens[:1]), learning_rate=1e-2)
+    step = jax.jit(lm_step(module))
+    losses = []
+    for _ in range(30):
+        state, metrics = step(state, jnp.asarray(tokens))
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_llama_kv_cache_decode_matches_full_forward():
+    """Cached token-by-token decode must equal the full-sequence forward."""
+    cfg = LlamaConfig.tiny(vocab_size=32)
+    module = Llama(cfg)
+    tokens = jnp.asarray([[3, 7, 11, 2, 9, 17, 4, 1]], jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), tokens)["params"]
+    full = module.apply({"params": params}, tokens)
+
+    cache = init_cache(cfg, batch=1, max_len=16, dtype=jnp.float32)
+
+    @jax.jit
+    def decode(params, cache, tok, idx):
+        return module.apply(
+            {"params": params}, tok, cache=cache, cache_index=idx
+        )
+
+    outs = []
+    for i in range(tokens.shape[1]):
+        logits, cache = decode(params, cache, tokens[:, i : i + 1], jnp.int32(i))
+        outs.append(logits[:, 0])
+    stepwise = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(stepwise), rtol=2e-2, atol=2e-2)
+
+
+def test_llama_prefill_with_cache_matches_full_forward():
+    cfg = LlamaConfig.tiny(vocab_size=32)
+    module = Llama(cfg)
+    tokens = jnp.asarray([[5, 2, 9, 13]], jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), tokens)["params"]
+    full = module.apply({"params": params}, tokens)
+    cache = init_cache(cfg, batch=1, max_len=8, dtype=jnp.float32)
+    prefill, cache = jax.jit(
+        lambda p, c, t: module.apply({"params": p}, t, cache=c, cache_index=jnp.int32(0))
+    )(params, cache, tokens)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(prefill), rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize(
+    "sharding",
+    [
+        ShardingConfig(data=-1),
+        ShardingConfig(data=2, fsdp=2, tensor=2, rules=VIT_PARTITION_RULES),
+    ],
+    ids=["dp8", "dp2_fsdp2_tp2"],
+)
+def test_vit_sharded_train_step(sharding):
+    """ViT train step under DP and 3D (dp×fsdp×tp) meshes on 8 CPU devices."""
+    from unionml_tpu.parallel import compile_step
+
+    cfg = ViTConfig.tiny(image_size=16, num_classes=4)
+    module = ViT(cfg)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(16, 16, 16, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, size=(16,)), jnp.int32)
+    state = create_train_state(module, x[:2], learning_rate=1e-3)
+    step, state = compile_step(classification_step(module), state, sharding=sharding)
+    for _ in range(3):
+        state, metrics = step(state, (x, y))
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_llama_tp_sharded_lm_step():
+    """Llama LM step with tensor-parallel param rules over tensor=4."""
+    from unionml_tpu.parallel import compile_step
+
+    cfg = LlamaConfig.tiny(vocab_size=64)
+    module = Llama(cfg)
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, 64, size=(8, 16)), jnp.int32)
+    sharding = ShardingConfig(data=-1, tensor=2, rules=LLAMA_PARTITION_RULES)
+    state = create_train_state(module, tokens[:1], learning_rate=1e-3)
+    step, state = compile_step(lm_step(module), state, sharding=sharding)
+    state, metrics = step(state, tokens)
+    assert np.isfinite(float(metrics["loss"]))
+    # params actually sharded over the tensor axis
+    k = state.params["block_0"]["attn"]["q"]["kernel"]
+    assert len(k.sharding.device_set) >= 2

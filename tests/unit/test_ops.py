@@ -142,3 +142,42 @@ def test_moe_differentiable():
     leaves = jax.tree_util.tree_leaves(grads)
     assert all(np.all(np.isfinite(np.asarray(l))) for l in leaves)
     assert any(np.any(np.asarray(l) != 0) for l in leaves)
+
+
+def test_causal_decode_alignment_bottom_right():
+    """q_len < kv_len causal (KV-cache decode): queries are the LAST q_len
+    positions. Regression: the mask offset was applied to kv instead of q,
+    masking everything for the final query row."""
+    q, k, v = make_qkv(seq=16)
+    full = mha_reference(q, k, v, causal=True)
+    # last 4 queries against the full KV prefix must match the full result
+    tail = mha_reference(q[:, -4:], k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(full[:, -4:]), np.asarray(tail), rtol=1e-5, atol=1e-5)
+    # single-token decode: must attend to ALL kv (not be fully masked)
+    one = mha_reference(q[:, -1:], k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(full[:, -1:]), np.asarray(one), rtol=1e-5, atol=1e-5)
+    # blockwise agrees with the same convention
+    bw = blockwise_attention(q[:, -4:], k, v, causal=True, block_size=8)
+    np.testing.assert_allclose(np.asarray(tail), np.asarray(bw), rtol=1e-4, atol=1e-4)
+
+
+def test_partition_rule_tuple_entries_and_fallbacks():
+    """Tuple spec entries shard one dim over multiple axes; axes missing
+    from the mesh or not dividing the dim are dropped, not erroring."""
+    from unionml_tpu.parallel import PartitionRule, ShardingConfig
+
+    cfg = ShardingConfig(
+        data=2, fsdp=2, tensor=2,
+        rules=(
+            PartitionRule(r"big/kernel", (("fsdp", "tensor"), None)),
+            PartitionRule(r"odd/kernel", (None, "tensor")),
+            PartitionRule(r"gone/kernel", ("expert", None)),
+        ),
+    )
+    big = np.zeros((8, 4))
+    spec = cfg.param_pspec("big/kernel", big)
+    assert spec == jax.sharding.PartitionSpec(("fsdp", "tensor"), None)
+    odd = np.zeros((4, 3))  # 3 not divisible by tensor=2 → dropped
+    assert cfg.param_pspec("odd/kernel", odd) == jax.sharding.PartitionSpec(None, None)
+    gone = np.zeros((4, 4))  # expert axis not in mesh → dropped
+    assert cfg.param_pspec("gone/kernel", gone) == jax.sharding.PartitionSpec(None, None)

@@ -25,7 +25,9 @@ from __future__ import annotations
 import json
 from dataclasses import field, make_dataclass
 from enum import Enum
-from inspect import Parameter, signature
+from inspect import Parameter
+
+from unionml_tpu.type_guards import signature
 from pathlib import Path
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple, Type, Union, get_args
 
@@ -422,11 +424,32 @@ class Dataset(TrackedInstance):
         (reference: dataset.py:455-459)."""
         return data
 
+    @staticmethod
+    def _is_xy_pair(data) -> bool:
+        """True for an ``(X, y)`` tuple of equal-length array-likes (the
+        array-first reader contract) — vs. a plain 2-element sequence."""
+        return (
+            isinstance(data, (tuple, list))
+            and len(data) == 2
+            and any(hasattr(el, "shape") or hasattr(el, "iloc") for el in data)
+            and all(hasattr(el, "__len__") for el in data)
+            and len(data[0]) == len(data[1])
+        )
+
     def _default_splitter(self, data, test_size: float, shuffle: bool, random_state: int):
-        """Split DataFrames, arrays, or sequences into (train, test)
-        (reference sklearn-based splitter: dataset.py:461-470; rewritten
-        with a numpy RNG so the core has no sklearn dependency)."""
-        n = len(data)
+        """Split DataFrames, arrays, (X, y) pairs, or sequences into
+        (train, test) (reference sklearn-based splitter: dataset.py:461-470;
+        rewritten with a numpy RNG so the core has no sklearn dependency)."""
+
+        def take(d, idx):
+            if hasattr(d, "iloc"):  # pandas
+                return d.iloc[idx]
+            if hasattr(d, "shape"):  # numpy/jax array
+                return d[idx]
+            return [d[int(i)] for i in idx]
+
+        xy_pair = self._is_xy_pair(data)
+        n = len(data[0]) if xy_pair else len(data)
         indices = np.arange(n)
         if shuffle:
             rng = np.random.default_rng(random_state)
@@ -434,14 +457,12 @@ class Dataset(TrackedInstance):
         n_test = int(np.floor(n * test_size))
         test_idx, train_idx = indices[:n_test], indices[n_test:]
 
-        if hasattr(data, "iloc"):  # pandas
-            return data.iloc[train_idx], data.iloc[test_idx]
-        if isinstance(data, np.ndarray):
-            return data[train_idx], data[test_idx]
-        # generic sequence (List[Dict], List[float], ...)
-        train = [data[int(i)] for i in train_idx]
-        test = [data[int(i)] for i in test_idx]
-        return train, test
+        if xy_pair:  # split X and y along rows with shared indices
+            return (
+                tuple(take(el, train_idx) for el in data),
+                tuple(take(el, test_idx) for el in data),
+            )
+        return take(data, train_idx), take(data, test_idx)
 
     def _default_parser(self, data, features: Optional[List[str]], targets: List[str]):
         """Split one data split into (features, targets)

@@ -27,7 +27,9 @@ import inspect
 import os
 from collections import OrderedDict
 from dataclasses import asdict, field, is_dataclass, make_dataclass
-from inspect import Parameter, signature
+from inspect import Parameter
+
+from unionml_tpu.type_guards import signature
 from typing import IO, Any, Callable, Dict, List, NamedTuple, Optional, Tuple, Type, Union
 
 from unionml_tpu import type_guards

@@ -1,0 +1,41 @@
+"""TPU-native model zoo (BASELINE.json configs #2-#5).
+
+The reference has no model zoo — users bring sklearn/torch/keras objects
+(reference: unionml/model.py:931-988 detects the framework only to pick a
+serializer). The TPU-native framework ships flax modules whose forward and
+train steps are jit/pjit programs, each family paired with tensor-parallel
+partition rules for :class:`unionml_tpu.parallel.ShardingConfig`.
+"""
+
+from unionml_tpu.models.bert import (
+    BERT_PARTITION_RULES,
+    BertClassifier,
+    BertConfig,
+    BertEncoder,
+    BertMlm,
+)
+from unionml_tpu.models.llama import (
+    LLAMA_PARTITION_RULES,
+    Llama,
+    LlamaConfig,
+    init_cache,
+)
+from unionml_tpu.models.mlp import Mlp, MlpConfig
+from unionml_tpu.models.train import (
+    TrainState,
+    classification_step,
+    create_train_state,
+    lm_step,
+    make_evaluator,
+    make_predictor,
+)
+from unionml_tpu.models.vit import VIT_PARTITION_RULES, ViT, ViTConfig
+
+__all__ = [
+    "Mlp", "MlpConfig",
+    "ViT", "ViTConfig", "VIT_PARTITION_RULES",
+    "BertEncoder", "BertClassifier", "BertMlm", "BertConfig", "BERT_PARTITION_RULES",
+    "Llama", "LlamaConfig", "init_cache", "LLAMA_PARTITION_RULES",
+    "TrainState", "create_train_state", "classification_step", "lm_step",
+    "make_evaluator", "make_predictor",
+]

@@ -1,0 +1,141 @@
+"""BERT-style encoder — the remote fine-tune config (BASELINE.json
+config #4, "BERT-base fine-tune via remote backend on TPU VM slice").
+
+Encoder with learned positions, GELU MLP, post-LN blocks; heads for
+sequence classification (fine-tune) and masked-LM (pretrain parity).
+Padding is handled with an attention bias built from the input mask —
+static shapes throughout so XLA compiles one program per bucket.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from unionml_tpu.models.layers import MlpBlock
+from unionml_tpu.ops.attention import mha_reference
+from unionml_tpu.parallel.sharding import PartitionRule
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_len: int = 512
+    num_types: int = 2
+    hidden_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    num_classes: int = 2  # classification head width
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def base(num_classes: int = 2) -> "BertConfig":
+        return BertConfig(num_classes=num_classes)
+
+    @staticmethod
+    def tiny(vocab_size: int = 1024, num_classes: int = 2) -> "BertConfig":
+        return BertConfig(
+            vocab_size=vocab_size, max_len=128, hidden_dim=64,
+            num_layers=2, num_heads=4, mlp_dim=128, num_classes=num_classes,
+        )
+
+
+class BertBlock(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, bias: Optional[jnp.ndarray]) -> jnp.ndarray:
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        head_dim = cfg.hidden_dim // cfg.num_heads
+        dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
+            features=feats, axis=-1, dtype=dtype, name=name
+        )
+        q = dense((cfg.num_heads, head_dim), "attn_q")(x)
+        k = dense((cfg.num_heads, head_dim), "attn_k")(x)
+        v = dense((cfg.num_heads, head_dim), "attn_v")(x)
+        attn = mha_reference(q, k, v, bias=bias)
+        attn = nn.DenseGeneral(
+            features=cfg.hidden_dim, axis=(-2, -1), dtype=dtype, name="attn_o"
+        )(attn)
+        x = nn.LayerNorm(dtype=dtype, name="ln1")(x + attn)
+        h = MlpBlock(hidden_dim=cfg.mlp_dim, dtype=dtype, name="mlp")(x)
+        return nn.LayerNorm(dtype=dtype, name="ln2")(x + h)
+
+
+class BertEncoder(nn.Module):
+    config: BertConfig = field(default_factory=BertConfig)
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jnp.ndarray,
+        *,
+        attention_mask: Optional[jnp.ndarray] = None,
+        token_type_ids: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        seq = input_ids.shape[1]
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_dim, dtype=dtype, name="tok_embed")
+        x = embed(input_ids)
+        x = x + nn.Embed(cfg.max_len, cfg.hidden_dim, dtype=dtype, name="pos_embed")(
+            jnp.arange(seq)[None, :]
+        )
+        if token_type_ids is not None:
+            x = x + nn.Embed(cfg.num_types, cfg.hidden_dim, dtype=dtype, name="type_embed")(
+                token_type_ids
+            )
+        x = nn.LayerNorm(dtype=dtype, name="ln_embed")(x)
+        bias = None
+        if attention_mask is not None:
+            bias = jnp.where(attention_mask[:, None, None, :].astype(bool), 0.0, -1e30)
+        for i in range(cfg.num_layers):
+            x = BertBlock(cfg, name=f"block_{i}")(x, bias)
+        return x
+
+
+class BertClassifier(nn.Module):
+    """[CLS]-pooled sequence classification (the fine-tune config)."""
+
+    config: BertConfig = field(default_factory=BertConfig)
+
+    @nn.compact
+    def __call__(self, input_ids, *, attention_mask=None, token_type_ids=None):
+        x = BertEncoder(self.config, name="encoder")(
+            input_ids, attention_mask=attention_mask, token_type_ids=token_type_ids
+        )
+        pooled = nn.tanh(nn.Dense(self.config.hidden_dim, dtype=jnp.float32, name="pooler")(
+            x[:, 0].astype(jnp.float32)
+        ))
+        return nn.Dense(self.config.num_classes, dtype=jnp.float32, name="head")(pooled)
+
+
+class BertMlm(nn.Module):
+    """Masked-LM head over the encoder (pretraining parity)."""
+
+    config: BertConfig = field(default_factory=BertConfig)
+
+    @nn.compact
+    def __call__(self, input_ids, *, attention_mask=None):
+        cfg = self.config
+        x = BertEncoder(cfg, name="encoder")(input_ids, attention_mask=attention_mask)
+        x = nn.gelu(nn.Dense(cfg.hidden_dim, dtype=jnp.float32, name="mlm_dense")(
+            x.astype(jnp.float32)
+        ), approximate=True)
+        x = nn.LayerNorm(name="mlm_ln")(x)
+        return nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="mlm_head")(x)
+
+
+BERT_PARTITION_RULES = (
+    PartitionRule(r"attn_(q|k|v)/kernel", (None, "tensor", None)),
+    PartitionRule(r"attn_o/kernel", ("tensor", None, None)),
+    PartitionRule(r"mlp/up/kernel", (None, "tensor")),
+    PartitionRule(r"mlp/down/kernel", ("tensor", None)),
+    PartitionRule(r"tok_embed/embedding", (None, "tensor")),
+    PartitionRule(r"mlm_head/kernel", (None, "tensor")),
+)

@@ -1,0 +1,214 @@
+"""Shared TPU-first building blocks for the model zoo.
+
+No reference counterpart — the reference delegates modeling to
+sklearn/torch/keras user code (reference: unionml/model.py:931-988 only
+touches models to serialize them). Here the framework ships its own
+flax.linen model family (BASELINE.json configs: MNIST-MLP, ViT-B/16,
+BERT-base, Llama-3-8B) so trainer/predictor bodies are jit/pjit-native.
+
+Design notes (TPU):
+- All matmul-bearing layers keep a ``dtype`` (compute, default bfloat16)
+  separate from ``param_dtype`` (float32 master weights) so the MXU runs
+  bf16 while optimizer state stays fp32.
+- Attention dispatches to the op family in :mod:`unionml_tpu.ops` —
+  ``xla`` (fused reference), ``blockwise`` (online-softmax memory saver),
+  ``flash`` (Pallas kernel), ``ring``/``ulysses`` (sequence-parallel,
+  require a mesh axis).
+- Kernel axes are named via ``nn.with_logical_partitioning``-free plain
+  params; tensor-parallel layouts come from path-regex
+  :class:`~unionml_tpu.parallel.sharding.PartitionRule`s instead, keeping
+  modules decoupled from the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from unionml_tpu.ops.attention import attention as xla_attention
+from unionml_tpu.ops.attention import blockwise_attention
+
+Dtype = Any
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square norm (Llama-style, no mean subtraction)."""
+
+    eps: float = 1e-5
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (normed * scale).astype(self.dtype)
+
+
+def rotary_embedding(
+    x: jnp.ndarray, positions: jnp.ndarray, *, theta: float = 10_000.0
+) -> jnp.ndarray:
+    """Apply rotary position embedding to ``x`` of shape (..., seq, heads, head_dim).
+
+    ``positions``: integer array broadcastable to (..., seq). Llama-3 uses
+    ``theta=500_000`` for long-context; classic RoPE uses 10_000.
+    """
+    head_dim = x.shape[-1]
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (..., seq, half)
+    cos = jnp.cos(angles)[..., None, :]  # (..., seq, 1, half)
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _run_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    impl: str,
+    causal: bool,
+    sequence_axis: Optional[str],
+) -> jnp.ndarray:
+    """Dispatch (batch, seq, heads, head_dim) tensors to an attention op."""
+    if impl == "xla":
+        return xla_attention(q, k, v, causal=causal)
+    if impl == "blockwise":
+        return blockwise_attention(q, k, v, causal=causal)
+    if impl == "flash":
+        from unionml_tpu.ops.flash_attention import flash_attention
+
+        interpret = jax.default_backend() == "cpu"
+        return flash_attention(q, k, v, causal=causal, interpret=interpret)
+    if impl == "ring":
+        from unionml_tpu.ops.ring_attention import ring_attention_sharded
+
+        assert sequence_axis, "ring attention needs a sequence mesh axis"
+        return ring_attention_sharded(q, k, v, axis=sequence_axis, causal=causal)
+    if impl == "ulysses":
+        from unionml_tpu.ops.ulysses import ulysses_attention_sharded
+
+        assert sequence_axis, "ulysses attention needs a sequence mesh axis"
+        return ulysses_attention_sharded(q, k, v, axis=sequence_axis, causal=causal)
+    raise ValueError(f"unknown attention impl {impl!r}")
+
+
+class Attention(nn.Module):
+    """Multi-head attention with grouped-query support and optional KV cache.
+
+    Param layout: q/k/v/o projections as single dense kernels whose head
+    axis is foldable for tensor parallelism (rules match ``attn/(q|k|v)``
+    paths and shard the output features over the ``tensor`` axis; ``attn/o``
+    shards input features, so TP needs exactly one psum per block — the
+    Megatron layout realized by GSPMD instead of hand-written collectives).
+    """
+
+    num_heads: int
+    num_kv_heads: Optional[int] = None  # GQA; None → MHA
+    head_dim: Optional[int] = None
+    rope: bool = False
+    rope_theta: float = 10_000.0
+    causal: bool = False
+    attn_impl: str = "xla"
+    sequence_axis: Optional[str] = None
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        *,
+        positions: Optional[jnp.ndarray] = None,
+        cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+        cache_index: Optional[jnp.ndarray] = None,
+    ):
+        """Returns ``out`` or ``(out, new_cache)`` when a cache is given.
+
+        ``cache``: (k, v) of shape (batch, max_len, kv_heads, head_dim);
+        ``cache_index``: scalar int — current fill position (decode step).
+        """
+        batch, seq, features = x.shape
+        kv_heads = self.num_kv_heads or self.num_heads
+        head_dim = self.head_dim or features // self.num_heads
+        dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
+            features=feats,
+            axis=-1,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name=name,
+        )
+        q = dense((self.num_heads, head_dim), "q")(x)
+        k = dense((kv_heads, head_dim), "k")(x)
+        v = dense((kv_heads, head_dim), "v")(x)
+
+        if positions is None:
+            base = cache_index if cache_index is not None else 0
+            positions = base + jnp.arange(seq)[None, :]
+        if self.rope:
+            q = rotary_embedding(q, positions, theta=self.rope_theta)
+            k = rotary_embedding(k, positions, theta=self.rope_theta)
+
+        new_cache = None
+        if cache is not None:
+            ck, cv = cache
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
+            new_cache = (ck, cv)
+            # attend over the filled prefix only: kv slot j is visible to
+            # query i iff j <= cache_index + i (covers decode seq=1 and
+            # cached prefill seq>1; unwritten slots are masked out)
+            kv_pos = jnp.arange(ck.shape[1])[None, :]
+            q_pos = cache_index + jnp.arange(seq)[:, None]
+            bias = jnp.where(kv_pos <= q_pos, 0.0, -1e30)[None, None]
+            out = xla_attention(
+                q, ck.astype(self.dtype), cv.astype(self.dtype), bias=bias
+            )
+        else:
+            out = _run_attention(
+                q, k, v,
+                impl=self.attn_impl,
+                causal=self.causal,
+                sequence_axis=self.sequence_axis,
+            )
+        out = nn.DenseGeneral(
+            features=features,
+            axis=(-2, -1),
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="o",
+        )(out)
+        if cache is not None:
+            return out, new_cache
+        return out
+
+
+class MlpBlock(nn.Module):
+    """Transformer MLP: GELU (ViT/BERT) or SwiGLU (Llama)."""
+
+    hidden_dim: int
+    gated: bool = False  # True → SwiGLU
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        features = x.shape[-1]
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, use_bias=not self.gated, dtype=self.dtype,
+            param_dtype=self.param_dtype, name=name,
+        )
+        if self.gated:
+            gate = nn.silu(dense(self.hidden_dim, "gate")(x))
+            up = dense(self.hidden_dim, "up")(x)
+            return dense(features, "down")(gate * up)
+        h = nn.gelu(dense(self.hidden_dim, "up")(x), approximate=True)
+        return dense(features, "down")(h)

@@ -1,0 +1,144 @@
+"""Llama-3-style decoder — the serving flagship (BASELINE.json config #5,
+"Llama-3-8B FastAPI predictor serving (on-device batching on TPU)").
+
+Architecture: RMSNorm, rotary embeddings (theta=500k), grouped-query
+attention, SwiGLU MLP, untied LM head. Two execution modes:
+
+- **full-sequence** (training / prefill): causal attention via the op
+  family (xla / blockwise / flash Pallas / ring / ulysses — config knob);
+- **cached decode**: a functional KV cache (pytree of per-layer (k, v)
+  buffers, static max_len) threaded through ``__call__`` so the serving
+  batcher jit-compiles ONE decode program with a dynamic fill index — no
+  recompilation per token (SURVEY.md §7 hard part (e): bucketed shapes).
+
+TP partition rules shard heads (q/k/v out-features, o in-features) and
+SwiGLU hidden over the ``tensor`` axis; the embedding and LM head shard
+vocab. FSDP fallback covers everything else.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from unionml_tpu.models.layers import Attention, MlpBlock, RMSNorm
+from unionml_tpu.parallel.sharding import PartitionRule
+
+Cache = Tuple[Tuple[jnp.ndarray, jnp.ndarray], ...]  # per-layer (k, v)
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    hidden_dim: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    mlp_dim: int = 14_336
+    rope_theta: float = 500_000.0
+    max_len: int = 8192
+    attn_impl: str = "xla"
+    sequence_axis: Optional[str] = None
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(vocab_size: int = 512) -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=vocab_size, hidden_dim=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, mlp_dim=128, max_len=256, rope_theta=10_000.0,
+        )
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_dim // self.num_heads
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, *, positions=None, cache=None, cache_index=None):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        attn = Attention(
+            num_heads=cfg.num_heads,
+            num_kv_heads=cfg.num_kv_heads,
+            head_dim=cfg.head_dim,
+            rope=True,
+            rope_theta=cfg.rope_theta,
+            causal=True,
+            attn_impl=cfg.attn_impl,
+            sequence_axis=cfg.sequence_axis,
+            dtype=dtype,
+            name="attn",
+        )
+        h = RMSNorm(dtype=dtype, name="attn_norm")(x)
+        if cache is not None:
+            a, new_cache = attn(h, positions=positions, cache=cache, cache_index=cache_index)
+        else:
+            a, new_cache = attn(h, positions=positions), None
+        x = x + a
+        h = RMSNorm(dtype=dtype, name="mlp_norm")(x)
+        x = x + MlpBlock(hidden_dim=cfg.mlp_dim, gated=True, dtype=dtype, name="mlp")(h)
+        return x, new_cache
+
+
+class Llama(nn.Module):
+    config: LlamaConfig = field(default_factory=LlamaConfig)
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jnp.ndarray,
+        *,
+        positions: Optional[jnp.ndarray] = None,
+        cache: Optional[Cache] = None,
+        cache_index: Optional[jnp.ndarray] = None,
+    ):
+        """logits [B,S,V]; with ``cache`` returns (logits, new_cache)."""
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_dim, dtype=dtype, name="embed")(tokens)
+        if positions is None and cache_index is not None:
+            positions = cache_index + jnp.arange(tokens.shape[1])[None, :]
+        new_cache = []
+        for i in range(cfg.num_layers):
+            layer_cache = cache[i] if cache is not None else None
+            x, c = LlamaBlock(cfg, name=f"block_{i}")(
+                x, positions=positions, cache=layer_cache, cache_index=cache_index
+            )
+            new_cache.append(c)
+        x = RMSNorm(dtype=dtype, name="final_norm")(x)
+        logits = nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"
+        )(x.astype(jnp.float32))
+        if cache is not None:
+            return logits, tuple(new_cache)
+        return logits
+
+
+def init_cache(
+    config: LlamaConfig, batch: int, max_len: Optional[int] = None, dtype: Any = jnp.bfloat16
+) -> Cache:
+    """Zero-filled KV cache: per-layer (k, v) of [B, max_len, kv_heads, head_dim]."""
+    max_len = max_len or config.max_len
+    shape = (batch, max_len, config.num_kv_heads, config.head_dim)
+    zeros = jnp.zeros(shape, dtype)
+    return tuple((zeros, zeros) for _ in range(config.num_layers))
+
+
+LLAMA_PARTITION_RULES = (
+    PartitionRule(r"attn/(q|k|v)/kernel", (None, "tensor", None)),
+    PartitionRule(r"attn/o/kernel", ("tensor", None, None)),
+    PartitionRule(r"mlp/(gate|up)/kernel", (None, "tensor")),
+    PartitionRule(r"mlp/down/kernel", ("tensor", None)),
+    PartitionRule(r"embed/embedding", ("tensor", None)),
+    PartitionRule(r"lm_head/kernel", (None, "tensor")),
+)

@@ -1,0 +1,41 @@
+"""MLP classifier — the MNIST single-chip config (BASELINE.json config #2).
+
+Replaces the reference's "bring your own sklearn/torch model" for the
+minimum end-to-end slice (SURVEY.md §7): a flax module whose train step is
+one fused jit program on a single chip.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@dataclass(frozen=True)
+class MlpConfig:
+    num_classes: int = 10
+    hidden_dims: Sequence[int] = (256, 256)
+    dropout: float = 0.0
+    dtype: str = "bfloat16"
+
+
+class Mlp(nn.Module):
+    config: MlpConfig = field(default_factory=MlpConfig)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        x = x.reshape((x.shape[0], -1)).astype(dtype)
+        for i, dim in enumerate(cfg.hidden_dims):
+            x = nn.Dense(dim, dtype=dtype, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+            if cfg.dropout and train:
+                x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+MLP_PARTITION_RULES = ()  # small enough to replicate; FSDP fallback applies

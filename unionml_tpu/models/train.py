@@ -1,0 +1,129 @@
+"""Train-state and step-function factories for the model zoo.
+
+The reference leaves training loops to user code (SURVEY.md §3.1: "the hot
+loop lives entirely in the user trainer body"). Here the framework supplies
+jit-ready ``step(state, batch) -> (state, metrics)`` functions matching the
+:meth:`unionml_tpu.model.Model.train_step` contract, so a zoo model trains
+with three lines of app code. Loss math runs in fp32 (bf16 params upcast at
+the loss) and gradients are computed by a single ``jax.value_and_grad``
+program — XLA fuses the whole step into one executable per shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from flax.training import train_state
+
+
+class TrainState(train_state.TrainState):
+    """flax TrainState (params + optax state + apply_fn + step counter)."""
+
+
+def create_train_state(
+    module: nn.Module,
+    example_input: Any,
+    *,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    learning_rate: float = 1e-3,
+    weight_decay: float = 0.0,
+    seed: int = 0,
+    init_kwargs: Optional[dict] = None,
+) -> TrainState:
+    """Initialize parameters from an example batch and wrap with optax.
+
+    Default optimizer is adamw — the optimizer state duplicates the param
+    pytree twice, so under FSDP the same partition rules shard it too
+    (ShardingConfig.state_shardings walks the whole TrainState).
+    """
+    params = module.init(
+        jax.random.PRNGKey(seed), example_input, **(init_kwargs or {})
+    )["params"]
+    tx = optimizer or optax.adamw(learning_rate, weight_decay=weight_decay)
+    return TrainState.create(apply_fn=module.apply, params=params, tx=tx)
+
+
+def _accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+
+
+def classification_step(module: nn.Module) -> Callable:
+    """softmax-CE step for (features, int_labels) batches (MLP/ViT/BERT-cls)."""
+
+    def step(state: TrainState, batch: Tuple[Any, Any]):
+        features, labels = batch
+
+        def loss_fn(params):
+            logits = state.apply_fn({"params": params}, features)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), labels
+            ).mean()
+            return loss, logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        state = state.apply_gradients(grads=grads)
+        return state, {"loss": loss, "accuracy": _accuracy(logits, labels)}
+
+    return step
+
+
+def lm_step(module: nn.Module, *, ignore_id: int = -100) -> Callable:
+    """Next-token LM step: batch is token ids [B, S]; loss over shifted pairs.
+
+    Also accepts ``(tokens, labels)`` for masked-LM/fine-tune batches where
+    labels carry ``ignore_id`` at unsupervised positions.
+    """
+
+    def step(state: TrainState, batch):
+        if isinstance(batch, tuple):
+            tokens, labels = batch
+            inputs, targets = tokens, labels
+        else:
+            inputs, targets = batch[:, :-1], batch[:, 1:]
+
+        def loss_fn(params):
+            logits = state.apply_fn({"params": params}, inputs).astype(jnp.float32)
+            mask = (targets != ignore_id).astype(jnp.float32)
+            safe = jnp.where(targets == ignore_id, 0, targets)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
+            loss = (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+            return loss, logits
+
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        state = state.apply_gradients(grads=grads)
+        return state, {"loss": loss, "perplexity": jnp.exp(loss)}
+
+    return step
+
+
+def make_evaluator(module: nn.Module) -> Callable:
+    """Build an @model.evaluator-compatible fn: (state, features, labels) -> acc."""
+
+    @jax.jit
+    def _acc(params, features, labels):
+        logits = module.apply({"params": params}, features)
+        return _accuracy(logits, labels)
+
+    def evaluator(state: Any, features: Any, labels: Any) -> float:
+        params = state.params if hasattr(state, "params") else state
+        return float(_acc(params, jnp.asarray(features), jnp.asarray(labels)))
+
+    return evaluator
+
+
+def make_predictor(module: nn.Module) -> Callable:
+    """Build an @model.predictor-compatible fn: argmax class prediction."""
+
+    @jax.jit
+    def _predict(params, features):
+        return jnp.argmax(module.apply({"params": params}, features), axis=-1)
+
+    def predictor(state: Any, features: Any) -> Any:
+        params = state.params if hasattr(state, "params") else state
+        return _predict(params, jnp.asarray(features))
+
+    return predictor

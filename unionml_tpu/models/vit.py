@@ -1,0 +1,102 @@
+"""ViT — the data-parallel training flagship (BASELINE.json config #3,
+"ViT-B/16 image classifier (pjit data-parallel over v5e-8 mesh)").
+
+TPU-first choices: patchify is one strided conv (a big MXU matmul after
+im2col — XLA lowers it directly), the encoder body is a `lax.scan`-free
+stack of identical blocks (XLA caches the compiled block), compute in
+bf16 with fp32 LayerNorm statistics, and the TP partition rules below give
+the Megatron 2-collectives-per-block layout via GSPMD.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from unionml_tpu.models.layers import Attention, MlpBlock
+from unionml_tpu.parallel.sharding import PartitionRule
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    hidden_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    attn_impl: str = "xla"
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def base16(num_classes: int = 1000) -> "ViTConfig":
+        return ViTConfig(num_classes=num_classes)
+
+    @staticmethod
+    def tiny(image_size: int = 32, num_classes: int = 10) -> "ViTConfig":
+        return ViTConfig(
+            image_size=image_size, patch_size=8, num_classes=num_classes,
+            hidden_dim=64, num_layers=2, num_heads=4, mlp_dim=128,
+        )
+
+
+class ViTBlock(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        ln = lambda name: nn.LayerNorm(dtype=dtype, name=name)  # noqa: E731
+        x = x + Attention(
+            num_heads=cfg.num_heads, attn_impl=cfg.attn_impl, dtype=dtype, name="attn"
+        )(ln("ln1")(x))
+        x = x + MlpBlock(hidden_dim=cfg.mlp_dim, dtype=dtype, name="mlp")(ln("ln2")(x))
+        return x
+
+
+class ViT(nn.Module):
+    config: ViTConfig = field(default_factory=ViTConfig)
+
+    @nn.compact
+    def __call__(self, images: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        p = cfg.patch_size
+        # patchify: one conv == one big MXU matmul
+        x = nn.Conv(
+            cfg.hidden_dim, kernel_size=(p, p), strides=(p, p),
+            padding="VALID", dtype=dtype, name="patch_embed",
+        )(images.astype(dtype))
+        batch = x.shape[0]
+        x = x.reshape((batch, -1, cfg.hidden_dim))
+        cls = self.param(
+            "cls", nn.initializers.zeros, (1, 1, cfg.hidden_dim), jnp.float32
+        ).astype(dtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls, (batch, 1, cfg.hidden_dim)), x], axis=1)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, x.shape[1], cfg.hidden_dim),
+            jnp.float32,
+        )
+        x = x + pos.astype(dtype)
+        for i in range(cfg.num_layers):
+            x = ViTBlock(cfg, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=dtype, name="ln_final")(x)
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(x[:, 0])
+
+
+# Megatron-style TP: qkv/up split output features over `tensor`,
+# o/down split input features → one psum after attn, one after mlp.
+VIT_PARTITION_RULES = (
+    PartitionRule(r"attn/(q|k|v)/kernel", (None, "tensor", None)),
+    PartitionRule(r"attn/o/kernel", ("tensor", None, None)),
+    PartitionRule(r"mlp/up/kernel", (None, "tensor")),
+    PartitionRule(r"mlp/down/kernel", ("tensor", None)),
+    PartitionRule(r"patch_embed/kernel", (None, None, None, "tensor")),
+)

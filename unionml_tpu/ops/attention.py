@@ -67,7 +67,9 @@ def mha_reference(
     if bias is not None:
         scores = scores + bias
     if causal:
-        mask = _causal_mask(q.shape[1], k.shape[1], kv_offset=k.shape[1] - q.shape[1])
+        # bottom-right alignment: with q_len < kv_len the queries are the
+        # LAST q_len positions (KV-cache decode), so offset q, not kv
+        mask = _causal_mask(q.shape[1], k.shape[1], q_offset=k.shape[1] - q.shape[1])
         scores = jnp.where(mask[None, None], scores, NEG_INF)
     if segment_ids is not None:
         seg_mask = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
@@ -94,8 +96,12 @@ def blockwise_attention(
     Memory is O(Sq·block_size) instead of O(Sq·Skv). ``q_offset`` /
     ``kv_offset`` give the global positions of the local q/kv shards so
     ring attention can reuse this per rotation step with correct causal
-    masking.
+    masking. With default (zero) offsets and ``q_len != kv_len``, causal
+    masking is bottom-right aligned (queries are the last ``q_len``
+    positions — the KV-cache decode convention, matching mha_reference).
     """
+    if causal and q_offset == 0 and kv_offset == 0:
+        q_offset = k.shape[1] - q.shape[1]
     out, _, _ = _blockwise_accumulate(
         q, k, v, causal=causal, block_size=block_size, scale=scale,
         q_offset=q_offset, kv_offset=kv_offset,

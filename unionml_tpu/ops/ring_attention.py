@@ -50,8 +50,8 @@ def ring_attention_sharded(
     n = lax.axis_size(axis)
     my_idx = lax.axis_index(axis)
     batch, s_local, num_q_heads, head_dim = q.shape
-    k = _repeat_kv(k, num_q_heads)
-    v = _repeat_kv(v, num_q_heads)
+    # NOTE: GQA kv shards rotate un-repeated — _blockwise_accumulate expands
+    # kv heads locally, so ppermute moves kv_heads/q_heads of the naive bytes
     scale_ = scale if scale is not None else head_dim**-0.5
 
     q_offset = my_idx * s_local

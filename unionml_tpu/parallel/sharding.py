@@ -113,10 +113,29 @@ class ShardingConfig:
     def param_pspec(self, path: str, leaf) -> Any:
         from jax.sharding import PartitionSpec as P
 
+        shape = getattr(leaf, "shape", ())
+        mesh_axes = dict(self.mesh().shape)
+        def sanitize(entry, dim_size):
+            # drop axes absent from the mesh (e.g. tensor=1 configs) or
+            # that the dim can't divide (e.g. GQA kv heads < tensor);
+            # tuple entries shard one dim over several axes jointly
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            kept, divisor = [], 1
+            for a in axes:
+                if a in mesh_axes and dim_size % (divisor * mesh_axes[a]) == 0:
+                    kept.append(a)
+                    divisor *= mesh_axes[a]
+            if not kept:
+                return None
+            return tuple(kept) if isinstance(entry, tuple) else kept[0]
+
         for rule in self.rules:
             if rule.matches(path):
-                return P(*rule.spec)
-        shape = getattr(leaf, "shape", ())
+                spec = [
+                    sanitize(entry, shape[i]) if entry is not None and i < len(shape) else None
+                    for i, entry in enumerate(rule.spec)
+                ]
+                return P(*spec)
         if self.fsdp > 1 and shape:
             # FSDP fallback: shard the largest divisible axis
             candidates = [

@@ -30,7 +30,9 @@ import hashlib
 import os
 import pickle
 from dataclasses import dataclass, field
-from inspect import Parameter, Signature, signature
+from inspect import Parameter, Signature
+
+from unionml_tpu.type_guards import signature
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 

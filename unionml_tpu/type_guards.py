@@ -16,8 +16,22 @@ from __future__ import annotations
 
 import inspect
 import typing
-from inspect import Parameter, signature
+from inspect import Parameter
 from typing import Any, Callable, Dict, Iterable, Mapping, Type
+
+
+def signature(fn: Callable) -> inspect.Signature:
+    """``inspect.signature`` resolving PEP 563 string annotations.
+
+    User app modules often use ``from __future__ import annotations``;
+    guards must compare real types, not their string forms. Falls back to
+    unresolved strings when a name can't be evaluated (the permissive
+    ``_is_compatible`` then treats only exact matches as compatible).
+    """
+    try:
+        return inspect.signature(fn, eval_str=True)
+    except (NameError, TypeError, ValueError):
+        return inspect.signature(fn)
 
 # canonical keyword interfaces (reference: type_guards.py:12-22)
 SPLITTER_KWARGS = {"test_size": float, "shuffle": bool, "random_state": int}
