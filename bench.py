@@ -16,10 +16,11 @@ import json
 import os
 import time
 
-# Recorded result of a previous round on the target hardware (v5e-8, one
-# chip). Update when a round improves it; vs_baseline is computed against
-# this so the driver sees round-over-round progress.
-RECORDED_BASELINE_SAMPLES_PER_SEC = None  # none yet — round 1 establishes it
+# Recorded result of a previous round on the target hardware (one TPU
+# v5e chip via tunnel). Update when a round improves it; vs_baseline is
+# computed against this so the driver sees round-over-round progress.
+# Round 1: ViT-B/16 batch=64 bf16, xla attention → 982 samples/sec/chip.
+RECORDED_BASELINE_SAMPLES_PER_SEC = 982.0
 
 
 def main() -> None:
@@ -56,14 +57,17 @@ def main() -> None:
     state = create_train_state(module, images[:1], learning_rate=1e-3)
     step = jax.jit(classification_step(module), donate_argnums=0)
 
+    # NOTE: timing ends with a host readback of a value data-dependent on
+    # the last step (which chains through every donated state) —
+    # jax.block_until_ready alone does not block on tunneled TPU backends
     for _ in range(warmup):
         state, metrics = step(state, (images, labels))
-    jax.block_until_ready(state)
+    float(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, (images, labels))
-    jax.block_until_ready(state)
+    assert float(metrics["loss"]) > 0
     dt = time.perf_counter() - t0
 
     samples_per_sec = batch * steps / dt

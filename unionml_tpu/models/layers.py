@@ -84,8 +84,7 @@ def _run_attention(
     if impl == "flash":
         from unionml_tpu.ops.flash_attention import flash_attention
 
-        interpret = jax.default_backend() == "cpu"
-        return flash_attention(q, k, v, causal=causal, interpret=interpret)
+        return flash_attention(q, k, v, causal=causal)
     if impl == "ring":
         from unionml_tpu.ops.ring_attention import ring_attention_sharded
 
