@@ -67,13 +67,18 @@ def main() -> None:
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, (images, labels))
-    assert float(metrics["loss"]) > 0
+    # read back a post-update param element: data-dependent on the final
+    # step's bwd+adamw, which chains through every prior donated state
+    _ = float(jax.tree_util.tree_leaves(state.params)[0].ravel()[0])
     dt = time.perf_counter() - t0
 
     samples_per_sec = batch * steps / dt
+    # the recorded baseline is a TPU ViT-B number; comparing any other
+    # preset/backend against it would be meaningless
+    comparable = preset == "vit_b16" and backend == "tpu"
     vs = (
         samples_per_sec / RECORDED_BASELINE_SAMPLES_PER_SEC
-        if RECORDED_BASELINE_SAMPLES_PER_SEC
+        if RECORDED_BASELINE_SAMPLES_PER_SEC and comparable
         else 1.0
     )
     print(

@@ -1,0 +1,89 @@
+"""Pipeline parallelism: SPMD GPipe schedule must be numerically identical
+to serial stage application, for forward AND gradients (SURVEY.md §4.3:
+equivalence testing on the CPU-simulated mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from unionml_tpu.parallel import make_mesh, pipeline_apply, stack_stage_params
+
+N_STAGES = 4
+DIM = 8
+
+
+def stage_fn(params, x):
+    w, b = params["w"], params["b"]
+    return jnp.tanh(x @ w + b)
+
+
+def make_stages(seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), N_STAGES)
+    return [
+        {
+            "w": jax.random.normal(k, (DIM, DIM)) / np.sqrt(DIM),
+            "b": jnp.zeros((DIM,)),
+        }
+        for k in ks
+    ]
+
+
+def serial_apply(stages, x):
+    for p in stages:
+        x = stage_fn(p, x)
+    return x
+
+
+@pytest.mark.parametrize("num_microbatches", [4, 8])
+def test_pipeline_matches_serial_forward(num_microbatches):
+    mesh = make_mesh({"pipeline": N_STAGES, "data": -1})
+    stages = make_stages()
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, DIM))
+
+    out = jax.jit(
+        lambda p, x: pipeline_apply(
+            stage_fn, p, x, mesh=mesh, num_microbatches=num_microbatches
+        )
+    )(stacked, x)
+    expected = serial_apply(stages, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_gradients_match_serial():
+    mesh = make_mesh({"pipeline": N_STAGES, "data": -1})
+    stages = make_stages(seed=2)
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, DIM))
+    y = jax.random.normal(jax.random.PRNGKey(4), (8, DIM))
+
+    def pipe_loss(p):
+        out = pipeline_apply(stage_fn, p, x, mesh=mesh, num_microbatches=4)
+        return jnp.mean((out - y) ** 2)
+
+    def serial_loss(p):
+        out = x
+        for s in range(N_STAGES):
+            out = stage_fn(jax.tree_util.tree_map(lambda a: a[s], p), out)
+        return jnp.mean((out - y) ** 2)
+
+    g_pipe = jax.jit(jax.grad(pipe_loss))(stacked)
+    g_serial = jax.grad(serial_loss)(stacked)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        g_pipe,
+        g_serial,
+    )
+
+
+def test_pipeline_rejects_bad_microbatching():
+    mesh = make_mesh({"pipeline": N_STAGES, "data": -1})
+    stacked = stack_stage_params(make_stages())
+    x = jnp.zeros((16, DIM))
+    with pytest.raises(ValueError, match="not divisible"):
+        pipeline_apply(stage_fn, stacked, x, mesh=mesh, num_microbatches=5)
+    with pytest.raises(ValueError, match="bubble"):
+        pipeline_apply(stage_fn, stacked, x, mesh=mesh, num_microbatches=2)

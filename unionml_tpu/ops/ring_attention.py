@@ -98,7 +98,7 @@ def ring_attention(
     Shards the sequence axis over ``mesh[axis]``, runs the ring, and
     returns the globally-shaped output (sharded the same way).
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, axis, None, None)
@@ -108,5 +108,5 @@ def ring_attention(
     )
     return shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False,
+        check_vma=False,
     )(q, k, v)

@@ -77,7 +77,7 @@ def ulysses_attention(
     block_size: int = 512,
 ) -> jnp.ndarray:
     """Ulysses attention over globally-shaped [B,S,H,D] tensors."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, axis, None, None)
@@ -87,5 +87,5 @@ def ulysses_attention(
     )
     return shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False,
+        check_vma=False,
     )(q, k, v)

@@ -15,6 +15,12 @@ first-class replacement: strategies compose as axes of one
 """
 
 from unionml_tpu.parallel.mesh import make_mesh, mesh_devices, multihost_initialize
+from unionml_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_partition_rules,
+    pipeline_spmd,
+    stack_stage_params,
+)
 from unionml_tpu.parallel.sharding import (
     PartitionRule,
     ShardingConfig,
@@ -28,6 +34,10 @@ __all__ = [
     "make_mesh",
     "mesh_devices",
     "multihost_initialize",
+    "pipeline_apply",
+    "pipeline_spmd",
+    "stack_stage_params",
+    "pipeline_partition_rules",
     "PartitionRule",
     "ShardingConfig",
     "compile_step",
