@@ -72,6 +72,85 @@ def test_flash_gradients_match_reference():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+def test_fused_matches_reference(causal):
+    from unionml_tpu.ops.fused_attention import fused_attention
+
+    q, k, v = make_qkv(seq=72, dim=32)  # ragged: 72 not tile-aligned
+    ref = mha_reference(q, k, v, causal=causal)
+    out = fused_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_fused_gradients_match_reference_gqa():
+    from unionml_tpu.ops.fused_attention import fused_attention
+
+    q, k, v = make_qkv(seq=72, q_heads=4, kv_heads=2, dim=32)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    def loss_fused(q, k, v):
+        return jnp.sum(fused_attention(q, k, v, causal=True) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_fused = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fused):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
+
+
+def test_fused_rejects_long_sequences():
+    from unionml_tpu.ops.fused_attention import fused_attention
+
+    q, k, v = make_qkv(batch=1, seq=2048, q_heads=1, dim=8)
+    with pytest.raises(ValueError, match="short sequences"):
+        fused_attention(q, k, v)
+
+
+def test_fused_rejects_unequal_lengths():
+    from unionml_tpu.ops.fused_attention import fused_attention
+
+    q, k, v = make_qkv(seq=32, dim=16)
+    with pytest.raises(ValueError, match="q_len == kv_len"):
+        fused_attention(q[:, :16], k, v)
+
+
+def test_flash_gradients_gqa_cross_length():
+    # KV prefix longer than q (decode-style): GQA group-sum must reshape
+    # with kv_len, not q_len
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (1, 16, 4, 8))
+    k = jax.random.normal(ks[1], (1, 32, 2, 8))
+    v = jax.random.normal(ks[2], (1, 32, 2, 8))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=False, block_q=16, block_kv=16) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=False) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_flash):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
+
+
+def test_flash_gradients_gqa_ragged():
+    # GQA (group-summed dk/dv) + ragged tail blocks in the Pallas backward
+    q, k, v = make_qkv(seq=72, q_heads=4, kv_heads=2, dim=32)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=False) ** 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=False, block_q=32, block_kv=32) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_flash):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_matches_reference(causal):
     mesh = make_mesh({"sequence": 8})
     q, k, v = make_qkv(seq=64)

@@ -85,6 +85,10 @@ def _run_attention(
         from unionml_tpu.ops.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal)
+    if impl == "fused":
+        from unionml_tpu.ops.fused_attention import fused_attention
+
+        return fused_attention(q, k, v, causal=causal)
     if impl == "ring":
         from unionml_tpu.ops.ring_attention import ring_attention_sharded
 

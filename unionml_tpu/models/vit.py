@@ -33,8 +33,10 @@ class ViTConfig:
     dtype: str = "bfloat16"
 
     @staticmethod
-    def base16(num_classes: int = 1000) -> "ViTConfig":
-        return ViTConfig(num_classes=num_classes)
+    def base16(num_classes: int = 1000, attn_impl: str = "fused") -> "ViTConfig":
+        # "fused" = Pallas one-program-per-batch attention: at S=197 it
+        # beats XLA attention ~1.6x fwd+bwd on v5e (see ops/fused_attention)
+        return ViTConfig(num_classes=num_classes, attn_impl=attn_impl)
 
     @staticmethod
     def tiny(image_size: int = 32, num_classes: int = 10) -> "ViTConfig":

@@ -201,8 +201,9 @@ def attention(
 ) -> jnp.ndarray:
     """Dispatch between attention implementations.
 
-    impl: ``"xla"`` (full scores, fastest for short seqs), ``"blockwise"``
-    (O(S·block) memory), ``"flash"`` (Pallas TPU kernel).
+    impl: ``"xla"`` (full scores), ``"blockwise"`` (O(S·block) memory),
+    ``"flash"`` (Pallas TPU kernel, long sequences), ``"fused"`` (Pallas
+    one-program-per-batch kernel, fastest for short sequences).
     """
     if impl == "xla":
         return mha_reference(q, k, v, causal=causal, **kwargs)
@@ -212,4 +213,8 @@ def attention(
         from unionml_tpu.ops.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal, **kwargs)
-    raise ValueError(f"unknown attention impl {impl!r}; use xla|blockwise|flash")
+    if impl == "fused":
+        from unionml_tpu.ops.fused_attention import fused_attention
+
+        return fused_attention(q, k, v, causal=causal, **kwargs)
+    raise ValueError(f"unknown attention impl {impl!r}; use xla|blockwise|flash|fused")
