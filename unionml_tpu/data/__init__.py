@@ -1,5 +1,8 @@
-"""Device data path: host batching, shard-aware placement, double buffering."""
+"""Device data path: native host batching, shard-aware placement, double
+buffering. Batch assembly (shuffle + gather) is C++ (``_native/``) with a
+determinism-equivalent numpy fallback."""
 
+from unionml_tpu.data.native import BatchLoader, epoch_permutation
 from unionml_tpu.data.pipeline import DeviceFeed, prefetch_to_device
 
-__all__ = ["DeviceFeed", "prefetch_to_device"]
+__all__ = ["BatchLoader", "DeviceFeed", "epoch_permutation", "prefetch_to_device"]

@@ -1,0 +1,246 @@
+"""ctypes bindings + Python fallback for the native host batch loader.
+
+The native side (``_native/hostloader.cpp``) assembles shuffled batches
+with worker threads into staging buffers; this module builds it on first
+use with ``g++`` (no pybind11 — plain C ABI via ctypes), wraps the staging
+pointers as numpy arrays without copying, and falls back to a pure-numpy
+implementation with the *identical* determinism contract when no C++
+toolchain is available.
+
+Shared determinism contract (tested in tests/unit/test_native_loader.py):
+the epoch permutation is ``argsort_u64(splitmix64(seed ^ (epoch+1)*PHI ^
+row))`` with ties broken by row index, so C++ and numpy produce the same
+batch stream and a run can resume from ``(epoch, step)`` on either.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("unionml_tpu")
+
+_PHI = np.uint64(0x9E3779B97F4A7C15)
+
+
+def splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 — bit-identical to the C++ kernel."""
+    with np.errstate(over="ignore"):
+        x = (x + _PHI).astype(np.uint64)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return x ^ (x >> np.uint64(31))
+
+
+def epoch_permutation(n_rows: int, seed: int, epoch: int, shuffle: bool = True) -> np.ndarray:
+    """The loader's deterministic permutation (numpy reference)."""
+    if not shuffle:
+        return np.arange(n_rows, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        base = np.uint64(seed) ^ (np.uint64(epoch + 1) * _PHI)
+    keys = splitmix64(base ^ np.arange(n_rows, dtype=np.uint64))
+    return np.argsort(keys, kind="stable").astype(np.uint64)
+
+
+# ------------------------------------------------------------------ build
+
+_SRC = Path(__file__).parent / "_native" / "hostloader.cpp"
+_LIB_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_FAILED = False
+
+
+def _lib_path() -> Path:
+    cache = os.environ.get("UNIONML_TPU_CACHE_DIR", "~/.cache/unionml_tpu")
+    d = Path(os.path.expanduser(cache)) / "native"
+    d.mkdir(parents=True, exist_ok=True)
+    return d / "libhostloader.so"
+
+
+def _build_library() -> Optional[ctypes.CDLL]:
+    so = _lib_path()
+    try:
+        if not so.exists() or so.stat().st_mtime < _SRC.stat().st_mtime:
+            # compile to a unique temp path then atomically rename, so
+            # concurrent builders (pytest workers, parallel trainers)
+            # never load a half-written .so
+            tmp = so.with_suffix(f".{os.getpid()}.tmp.so")
+            cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+                   "-o", str(tmp), str(_SRC)]
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)
+        lib = ctypes.CDLL(str(so))
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.info(f"native hostloader unavailable ({e}); using numpy fallback")
+        return None
+    u8pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))
+    lib.hl_new.restype = ctypes.c_void_p
+    lib.hl_new.argtypes = [u8pp, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+                           ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+                           ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.hl_num_batches.restype = ctypes.c_uint64
+    lib.hl_num_batches.argtypes = [ctypes.c_void_p]
+    lib.hl_start_epoch.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]
+    lib.hl_next.restype = ctypes.c_uint64
+    lib.hl_next.argtypes = [ctypes.c_void_p, u8pp, ctypes.POINTER(ctypes.c_void_p)]
+    lib.hl_release.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.hl_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def get_library() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_FAILED
+    with _LIB_LOCK:
+        if _LIB is None and not _LIB_FAILED:
+            _LIB = _build_library()
+            _LIB_FAILED = _LIB is None
+        return _LIB
+
+
+# ------------------------------------------------------------------ loaders
+
+
+class BatchLoader:
+    """Deterministic shuffled-batch stream over row-aligned numpy arrays.
+
+    Uses the native threaded loader when available, the numpy fallback
+    otherwise — both produce the identical batch stream. Arrays must share
+    the leading (row) dimension; each batch is a tuple of arrays in the
+    same order. Supports mid-epoch resume via ``epochs(start_epoch,
+    start_batch)`` (the elastic-training hook).
+    """
+
+    def __init__(
+        self,
+        arrays: Sequence[np.ndarray],
+        *,
+        batch_size: int,
+        seed: int = 0,
+        shuffle: bool = True,
+        drop_remainder: bool = False,
+        num_threads: int = 2,
+        queue_depth: int = 4,
+        use_native: Optional[bool] = None,
+        copy: bool = True,
+    ):
+        """``copy=False`` yields zero-copy views into recycled staging
+        buffers: each batch is only valid until the generator is advanced
+        (safe for consume-then-advance loops like ``prefetch_to_device``,
+        which ``device_put``s a batch before pulling the next one)."""
+        if not arrays:
+            raise ValueError("BatchLoader needs at least one array")
+        self.arrays = [np.ascontiguousarray(a) for a in arrays]
+        n = self.arrays[0].shape[0]
+        for a in self.arrays:
+            if a.shape[0] != n:
+                raise ValueError("all arrays must share the leading dimension")
+        self.n_rows = n
+        self.batch_size = batch_size
+        self.seed = seed
+        self.shuffle = shuffle
+        self.drop_remainder = drop_remainder
+        self.copy = copy
+        if drop_remainder:
+            self.num_batches = n // batch_size
+        else:
+            self.num_batches = (n + batch_size - 1) // batch_size
+
+        lib = get_library() if (use_native is None or use_native) else None
+        if use_native and lib is None:
+            raise RuntimeError("native hostloader requested but unavailable")
+        self._lib = lib
+        self._handle = None
+        if lib is not None:
+            n_arr = len(self.arrays)
+            ptrs = (ctypes.POINTER(ctypes.c_uint8) * n_arr)()
+            row_bytes = (ctypes.c_uint64 * n_arr)()
+            for i, a in enumerate(self.arrays):
+                ptrs[i] = a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+                row_bytes[i] = a.dtype.itemsize * int(np.prod(a.shape[1:], dtype=np.int64))
+            self._handle = lib.hl_new(
+                ptrs, row_bytes, n_arr, n, batch_size, seed,
+                int(shuffle), int(drop_remainder), num_threads, queue_depth,
+            )
+
+    # -- iteration -----------------------------------------------------
+
+    def epoch(self, epoch: int = 0, start_batch: int = 0) -> Iterator[Tuple[np.ndarray, ...]]:
+        """Yield the batches of one epoch, optionally resuming mid-epoch."""
+        if self._handle is not None:
+            yield from self._native_epoch(epoch, start_batch)
+        else:
+            yield from self._numpy_epoch(epoch, start_batch)
+
+    def epochs(
+        self, num_epochs: int, *, start_epoch: int = 0, start_batch: int = 0
+    ) -> Iterator[Tuple[int, int, Tuple[np.ndarray, ...]]]:
+        """Yield ``(epoch, batch_idx, batch)`` across epochs with resume."""
+        for e in range(start_epoch, num_epochs):
+            sb = start_batch if e == start_epoch else 0
+            for i, batch in enumerate(self.epoch(e, sb)):
+                yield e, sb + i, batch
+
+    def _native_epoch(self, epoch: int, start_batch: int):
+        lib, h = self._lib, self._handle
+        lib.hl_start_epoch(h, epoch, start_batch)
+        n_arr = len(self.arrays)
+        out_ptrs = (ctypes.POINTER(ctypes.c_uint8) * n_arr)()
+        token = ctypes.c_void_p()
+        pending = None  # token of the batch currently lent out (copy=False)
+        try:
+            while True:
+                rows = lib.hl_next(h, out_ptrs, ctypes.byref(token))
+                if pending is not None:
+                    # the consumer advanced the generator, so the previous
+                    # zero-copy batch is done — recycle its staging buffer
+                    lib.hl_release(h, pending)
+                    pending = None
+                if rows == 0:
+                    return
+                out = []
+                for i, a in enumerate(self.arrays):
+                    shape = (rows,) + a.shape[1:]
+                    nbytes = int(rows) * a.dtype.itemsize * int(
+                        np.prod(a.shape[1:], dtype=np.int64)
+                    )
+                    buf = ctypes.cast(
+                        out_ptrs[i], ctypes.POINTER(ctypes.c_uint8 * nbytes)
+                    ).contents
+                    view = np.frombuffer(buf, dtype=a.dtype).reshape(shape)
+                    out.append(view.copy() if self.copy else view)
+                if self.copy:
+                    lib.hl_release(h, token)
+                else:
+                    pending = ctypes.c_void_p(token.value)
+                yield tuple(out)
+        finally:
+            # re-check the live handle: close() may have freed the loader
+            # while this generator was suspended (abandoned mid-epoch)
+            if pending is not None and self._handle is not None:
+                lib.hl_release(self._handle, pending)
+
+    def _numpy_epoch(self, epoch: int, start_batch: int):
+        perm = epoch_permutation(self.n_rows, self.seed, epoch, self.shuffle)
+        for b in range(start_batch, self.num_batches):
+            idx = perm[b * self.batch_size:(b + 1) * self.batch_size]
+            yield tuple(a[idx] for a in self.arrays)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.hl_free(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass

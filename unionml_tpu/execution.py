@@ -113,7 +113,36 @@ def run_step_trainer(
 
     from unionml_tpu.data.pipeline import prefetch_to_device
 
+    def _is_plain_array(x: Any) -> bool:
+        return not isinstance(x, (dict, list, tuple)) and hasattr(x, "__array__")
+
     def host_batches():
+        # fast path: plain (features[, targets]) arrays go through the
+        # native threaded batch loader. copy=True: device_put only
+        # ENQUEUES the host→HBM transfer (PJRT may read the host buffer
+        # after returning), so zero-copy staging buffers must not be
+        # recycled under an in-flight DMA
+        if (
+            _is_plain_array(features)
+            and (not has_targets or _is_plain_array(targets))
+            and n >= batch_size
+        ):
+            from unionml_tpu.data.native import BatchLoader
+
+            arrays = [np.asarray(features)]
+            if has_targets:
+                arrays.append(np.asarray(targets))
+            loader = BatchLoader(
+                arrays, batch_size=batch_size, seed=seed, shuffle=True,
+                drop_remainder=True, copy=True,
+            )
+            try:
+                for epoch in range(num_epochs):
+                    for batch in loader.epoch(epoch):
+                        yield batch if has_targets else batch[0]
+            finally:
+                loader.close()
+            return
         for epoch in range(num_epochs):
             for idx in batch_indices(n, batch_size, shuffle=True, seed=seed + epoch):
                 xb = _slice_batch(features, idx)

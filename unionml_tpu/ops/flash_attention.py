@@ -371,8 +371,7 @@ def _flash_bwd(causal, scale, block_q, block_kv, residuals, g):
     return dq, dk, dv
 
 
-_flash.defvjp(lambda q, k, v, c, s, bq, bkv: _flash_fwd_res(q, k, v, c, s, bq, bkv),
-              _flash_bwd)
+_flash.defvjp(_flash_fwd_res, _flash_bwd)
 
 
 def flash_attention(
