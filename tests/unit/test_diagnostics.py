@@ -1,0 +1,155 @@
+"""Diagnostics (profiling/NaN/sharding checks) and elastic resume tests —
+the aux-subsystem obligations of SURVEY.md §5.1-5.4."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu.diagnostics import (
+    StepTimer,
+    assert_finite,
+    assert_sharding,
+    describe_sharding,
+    nan_guard,
+)
+
+
+def test_step_timer_windows_and_summary():
+    t = StepTimer(window=5)
+    for _ in range(12):
+        t.tick(32)
+    s = t.summary()
+    assert s["steps"] == 12 and s["examples"] == 12 * 32
+    assert len(t.rates) == 2  # two full windows
+    assert s["samples_per_sec_median"] > 0
+
+
+def test_assert_finite_names_the_leaf():
+    good = {"a": jnp.ones((3,)), "b": {"c": jnp.zeros((2, 2))}}
+    assert_finite(good, name="state")  # no raise
+    bad = {"a": jnp.ones((3,)), "b": {"c": jnp.array([1.0, np.nan])}}
+    with pytest.raises(FloatingPointError, match=r"state.*\['b'\]\['c'\].*1 non-finite"):
+        assert_finite(bad, name="state")
+    ints = {"i": jnp.arange(3)}  # integer leaves are skipped
+    assert_finite(ints)
+
+
+def test_nan_guard_toggles_debug_nans():
+    assert not jax.config.jax_debug_nans
+    with nan_guard():
+        assert jax.config.jax_debug_nans
+        with pytest.raises(FloatingPointError):
+            jax.jit(lambda x: 0.0 / x)(jnp.zeros(()))
+    assert not jax.config.jax_debug_nans
+
+
+def test_describe_and_assert_sharding_on_mesh():
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from unionml_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": 8})
+    x = jax.device_put(jnp.ones((16, 4)), NamedSharding(mesh, PartitionSpec("data", None)))
+    tree = {"batch": x, "host": np.ones(3)}
+    desc = describe_sharding(tree)
+    assert "data" in desc["['batch']"]
+    assert desc["['host']"] == "<host>"
+
+    assert_sharding(tree, {"batch": PartitionSpec("data", None)})
+    with pytest.raises(AssertionError, match="realized sharding"):
+        assert_sharding(tree, {"batch": PartitionSpec(None, "data")})
+    with pytest.raises(AssertionError, match="no leaves matched"):
+        assert_sharding(tree, {"nonexistent": PartitionSpec()})
+
+
+# ------------------------------------------------------------- elastic
+
+
+def _make_problem():
+    import optax
+    from flax import linen as nn
+
+    from unionml_tpu.models import create_train_state
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(2)(x)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 4)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    module = Tiny()
+    state = create_train_state(module, jnp.zeros((1, 4)), optimizer=optax.adam(0.01))
+
+    def step(state, batch):
+        xb, yb = batch
+
+        def loss_fn(params):
+            logits = state.apply_fn({"params": params}, xb)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads=grads), {"loss": loss}
+
+    return step, state, x, y
+
+
+def test_elastic_resume_reaches_identical_state(tmp_path):
+    from unionml_tpu.elastic import Preemption, run_elastic_trainer
+
+    step, state0, x, y = _make_problem()
+
+    # uninterrupted run: 2 epochs x 4 batches = 8 steps
+    ref_state, ref_steps = run_elastic_trainer(
+        step_fn=step, state=state0, arrays=[x, y],
+        checkpoint_dir=str(tmp_path / "ref"), num_epochs=2, batch_size=32,
+        seed=3, checkpoint_every=3,
+    )
+    assert ref_steps == 8
+
+    # faulted run: dies after step 5 (past the step-3 checkpoint)
+    step2, state1, _, _ = _make_problem()
+
+    def bomb(global_step):
+        if global_step == 5:
+            raise Preemption("simulated preemption")
+
+    with pytest.raises(Preemption):
+        run_elastic_trainer(
+            step_fn=step2, state=state1, arrays=[x, y],
+            checkpoint_dir=str(tmp_path / "run"), num_epochs=2, batch_size=32,
+            seed=3, checkpoint_every=3, fault_hook=bomb,
+        )
+
+    # restart: resumes from step 3, replays 4..8
+    step3, state2, _, _ = _make_problem()
+    out_state, out_steps = run_elastic_trainer(
+        step_fn=step3, state=state2, arrays=[x, y],
+        checkpoint_dir=str(tmp_path / "run"), num_epochs=2, batch_size=32,
+        seed=3, checkpoint_every=3,
+    )
+    assert out_steps == 8
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref_state.params),
+        jax.tree_util.tree_leaves(out_state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_elastic_fresh_run_no_checkpoint(tmp_path):
+    from unionml_tpu.elastic import run_elastic_trainer
+
+    step, state, x, y = _make_problem()
+    out, steps = run_elastic_trainer(
+        step_fn=step, state=state, arrays=[x, y],
+        checkpoint_dir=str(tmp_path / "fresh"), num_epochs=1, batch_size=64,
+        checkpoint_every=100,
+    )
+    assert steps == 2
+    # final checkpoint written even though checkpoint_every wasn't hit
+    from unionml_tpu.checkpoint.sharded import CheckpointManager
+
+    assert CheckpointManager(str(tmp_path / "fresh")).latest_step() == 2

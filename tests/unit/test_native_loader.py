@@ -96,6 +96,23 @@ def test_zero_copy_mode_valid_until_advance():
     loader.close()
 
 
+def test_concurrent_epoch_iterators_rejected():
+    x, y = make_data(n=64)
+    loader = BatchLoader([x, y], batch_size=16, seed=0)
+    it1 = loader.epoch(0)
+    next(it1)
+    it2 = loader.epoch(1)
+    next(it2)  # starting a second stream invalidates the first
+    with pytest.raises(RuntimeError, match="concurrent epoch"):
+        next(it1)
+    # the new stream keeps working and sequential use stays fine
+    next(it2)
+    it2.close()
+    full = collect(loader, epoch=0)
+    assert len(full) == 4
+    loader.close()
+
+
 def test_drop_remainder_and_short_batches():
     x, y = make_data(n=50)
     keep = BatchLoader([x, y], batch_size=16, seed=0)

@@ -152,6 +152,7 @@ class BatchLoader:
         else:
             self.num_batches = (n + batch_size - 1) // batch_size
 
+        self._active_iter: Optional[object] = None
         lib = get_library() if (use_native is None or use_native) else None
         if use_native and lib is None:
             raise RuntimeError("native hostloader requested but unavailable")
@@ -172,11 +173,37 @@ class BatchLoader:
     # -- iteration -----------------------------------------------------
 
     def epoch(self, epoch: int = 0, start_batch: int = 0) -> Iterator[Tuple[np.ndarray, ...]]:
-        """Yield the batches of one epoch, optionally resuming mid-epoch."""
-        if self._handle is not None:
-            yield from self._native_epoch(epoch, start_batch)
-        else:
-            yield from self._numpy_epoch(epoch, start_batch)
+        """Yield the batches of one epoch, optionally resuming mid-epoch.
+
+        Only one epoch iterator may be live per loader: the native side
+        holds a single (permutation, queue) state per handle, so a second
+        iterator would corrupt the first. Enforced uniformly (the numpy
+        fallback could interleave, but the contract is "identical streams
+        on either implementation"). Use separate loaders to interleave.
+        """
+        gen = (
+            self._native_epoch(epoch, start_batch)
+            if self._handle is not None
+            else self._numpy_epoch(epoch, start_batch)
+        )
+        token = object()
+        self._active_iter = token
+        try:
+            while True:
+                if self._active_iter is not token:
+                    raise RuntimeError(
+                        "concurrent epoch() iterators on one BatchLoader are "
+                        "not supported — create a separate loader per stream"
+                    )
+                try:
+                    item = next(gen)
+                except StopIteration:
+                    return
+                yield item
+        finally:
+            if self._active_iter is token:
+                self._active_iter = None
+            gen.close()
 
     def epochs(
         self, num_epochs: int, *, start_epoch: int = 0, start_batch: int = 0

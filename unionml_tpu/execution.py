@@ -16,6 +16,7 @@ NamedSharding in/out specs — and driven by a host batching loop that:
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
@@ -85,6 +86,7 @@ def run_step_trainer(
     seed: int = 0,
     sharding: Any = None,
     donate_state: bool = True,
+    profile_dir: Optional[str] = None,
 ) -> Any:
     """Synthesized trainer loop around a jittable per-batch step.
 
@@ -148,13 +150,28 @@ def run_step_trainer(
                 xb = _slice_batch(features, idx)
                 yield (xb, _slice_batch(targets, idx)) if has_targets else xb
 
+    from unionml_tpu.diagnostics import StepTimer, trace
+
+    timer = StepTimer()
     steps = 0
     metrics = None
-    for batch in prefetch_to_device(host_batches(), sharding=sharding):
-        state, metrics = step(state, batch)
-        steps += 1
+    ctx = trace(profile_dir) if profile_dir else contextlib.nullcontext()
+    with ctx:
+        for batch in prefetch_to_device(host_batches(), sharding=sharding):
+            state, metrics = step(state, batch)
+            if timer.closes_window():
+                # force a readback data-dependent on this step so the
+                # window measures compute, not async dispatch (step() only
+                # enqueues work; see BASELINE.md on tunnel timing)
+                leaves = jax.tree_util.tree_leaves(metrics)
+                if leaves:
+                    np.asarray(leaves[0])
+            timer.tick(batch_size)
+            steps += 1
     if steps:
         jax.block_until_ready(state)
         last = jax.tree_util.tree_map(lambda x: np.asarray(x).item() if np.ndim(x) == 0 else x, metrics)
-        logger.info(f"step trainer: {steps} steps, final metrics: {last}")
+        rate = timer.summary().get("samples_per_sec_median")
+        suffix = f", ~{rate:.0f} samples/sec" if rate else ""
+        logger.info(f"step trainer: {steps} steps, final metrics: {last}{suffix}")
     return state
