@@ -35,6 +35,30 @@ def test_init_tpu_template(runner, tmp_path, monkeypatch):
     assert "train_step" in (tmp_path / "tpu_app" / "app.py").read_text()
 
 
+@pytest.mark.parametrize("template", ["serverless", "vision_tpu"])
+def test_init_new_templates_compile_and_register(runner, tmp_path, monkeypatch, template):
+    monkeypatch.chdir(tmp_path)
+    result = runner.invoke(app, ["init", "cv_app", "--template", template])
+    assert result.exit_code == 0, result.output
+    app_py = tmp_path / "cv_app" / "app.py"
+    assert app_py.exists()
+    assert "{{app_name}}" not in app_py.read_text()
+    # the scaffold must import cleanly and register its spec (no training)
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(f"cv_app_{template}", app_py)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+        assert mod.model._predictor is not None
+        assert mod.dataset._reader is not None
+        if template == "serverless":
+            assert callable(mod.handler) and callable(mod.on_upload)
+    finally:
+        sys.modules.pop(spec.name, None)
+
+
 def test_init_rejects_bad_name(runner, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     result = runner.invoke(app, ["init", "bad-name!"])

@@ -1,0 +1,101 @@
+"""Serverless adapters (reference analog: tests/unit/test_aws_lambda_handler.py
+— synthetic API-Gateway and S3 events invoked directly as functions, with
+object I/O against a local store instead of mocked boto3)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from unionml_tpu.serving.serverless import (
+    LocalObjectStore,
+    gateway_handler,
+    object_event_handler,
+)
+
+
+@pytest.fixture
+def trained_model(model):
+    model.train(hyperparameters={"max_iter": 500}, sample_frac=1.0, random_state=123)
+    return model
+
+
+def test_gateway_routes(trained_model):
+    handler = gateway_handler(trained_model)
+    assert handler({"httpMethod": "GET", "path": "/"})["statusCode"] == 200
+    health = handler({"httpMethod": "GET", "path": "/health"})
+    assert json.loads(health["body"])["model_loaded"] is True
+    assert handler({"httpMethod": "GET", "path": "/nope"})["statusCode"] == 404
+
+
+def test_gateway_predict_and_validation(trained_model, dataset):
+    handler = gateway_handler(trained_model)
+    features = [[0.1, 0.2], [1.5, -0.3], [0.0, 0.9]]
+    resp = handler({
+        "httpMethod": "POST", "path": "/predict",
+        "body": json.dumps({"features": features}),
+    })
+    assert resp["statusCode"] == 200
+    preds = json.loads(resp["body"])
+    assert len(preds) == 3
+    # both inputs and features -> 400, not a crash
+    bad = handler({
+        "httpMethod": "POST", "path": "/predict",
+        "body": json.dumps({"features": features, "inputs": {}}),
+    })
+    assert bad["statusCode"] == 400
+    assert "exactly one" in json.loads(bad["body"])["error"]
+
+
+def test_gateway_http_api_v2_event_shape(trained_model):
+    # API-Gateway v2 events carry method/path differently
+    handler = gateway_handler(trained_model)
+    resp = handler({
+        "requestContext": {"http": {"method": "GET"}},
+        "rawPath": "/health",
+    })
+    assert resp["statusCode"] == 200
+
+
+def test_object_event_batch_prediction(trained_model, tmp_path):
+    store = LocalObjectStore(str(tmp_path))
+    features = [[0.1, 0.2], [1.5, -0.3]]
+    store.put("uploads", "batch-001.json", json.dumps(features).encode())
+
+    handler = object_event_handler(trained_model, store)
+    event = {"Records": [{"s3": {"bucket": {"name": "uploads"},
+                                 "object": {"key": "batch-001.json"}}}]}
+    resp = handler(event)
+    assert resp["statusCode"] == 200
+    out = json.loads(resp["body"])["outputs"]
+    assert out == [{"bucket": "uploads", "key": "batch-001.json.predictions.json"}]
+    preds = json.loads(store.get("uploads", "batch-001.json.predictions.json"))
+    assert len(preds) == 2
+    # malformed records are skipped, not fatal
+    assert handler({"Records": [{"s3": {}}]})["statusCode"] == 200
+
+
+def test_object_event_url_encoded_keys_and_partial_errors(trained_model, tmp_path):
+    store = LocalObjectStore(str(tmp_path))
+    features = [[0.1, 0.2]]
+    store.put("uploads", "my batch.json", json.dumps(features).encode())
+
+    handler = object_event_handler(trained_model, store)
+    rec = lambda key: {"s3": {"bucket": {"name": "uploads"}, "object": {"key": key}}}  # noqa: E731
+    # S3 notifications URL-encode keys; one missing object must not abort
+    # the good record's output
+    resp = handler({"Records": [rec("my+batch.json"), rec("missing.json")]})
+    assert resp["statusCode"] == 207
+    body = json.loads(resp["body"])
+    assert body["outputs"] == [
+        {"bucket": "uploads", "key": "my batch.json.predictions.json"}
+    ]
+    assert body["errors"][0]["key"] == "missing.json"
+
+
+def test_local_object_store_rejects_traversal(tmp_path):
+    store = LocalObjectStore(str(tmp_path / "store"))
+    with pytest.raises(ValueError, match="escapes store root"):
+        store.get("uploads", "../../secrets.txt")
+    with pytest.raises(ValueError, match="escapes store root"):
+        store.put("..", "x.json", b"{}")

@@ -58,12 +58,16 @@ class StepTimer:
 
     def tick(self, batch_examples: int) -> None:
         now = time.perf_counter()
-        if self._t0 is None:
-            self._t0 = now
-        self._steps += 1
-        self._examples += batch_examples
         self.total_steps += 1
         self.total_examples += batch_examples
+        if self._t0 is None:
+            # the first tick only anchors the clock: counting its examples
+            # without its duration would overstate the first window by
+            # window/(window-1)
+            self._t0 = now
+            return
+        self._steps += 1
+        self._examples += batch_examples
         if self._steps >= self.window:
             dt = now - self._t0
             if dt > 0:

@@ -15,7 +15,7 @@ checkpoint-based restart a framework primitive:
   and on start resumes from the newest checkpoint under ``checkpoint_dir``;
 - a killed-and-restarted run reaches the bit-identical final state of an
   uninterrupted run (tested by fault injection in
-  tests/unit/test_elastic.py).
+  tests/unit/test_diagnostics.py).
 """
 
 from __future__ import annotations

@@ -1,0 +1,144 @@
+"""Serverless serving adapters: gateway events and object-store events.
+
+Reference parity for the two AWS-flavored serving templates
+(reference: templates/basic-aws-lambda — FastAPI wrapped in Mangum for
+API-Gateway events, docs/source/serving_aws_lambda.md:40-56 — and
+templates/basic-aws-lambda-s3 — S3-event-driven batch prediction,
+docs/source/reacting_to_s3_events.md:38-50). Instead of depending on
+Mangum/boto3, the adapters speak the event *shapes* directly and route to
+the transport-agnostic :class:`~unionml_tpu.serving.http.ServingApp`:
+
+- :func:`gateway_handler` — API-Gateway-style ``{httpMethod, path, body}``
+  events → ``{statusCode, body}`` responses (GET /, GET /health,
+  POST /predict). Works as an AWS Lambda handler as-is.
+- :func:`object_event_handler` — S3-style ``{Records: [{s3: {bucket,
+  object}}]}`` events: read the uploaded feature file from an
+  :class:`ObjectStore`, predict, write ``<key>.predictions.json`` back.
+  ``LocalObjectStore`` maps bucket/key onto a directory for tests and
+  on-prem use; a boto3-backed store can be swapped in without touching
+  the handler.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Callable, Dict, Optional
+from urllib.parse import unquote_plus
+
+from unionml_tpu.serving.http import ServingApp
+
+
+class ObjectStore:
+    """Minimal bucket/key object interface the event handler needs."""
+
+    def get(self, bucket: str, key: str) -> bytes:
+        raise NotImplementedError
+
+    def put(self, bucket: str, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+
+class LocalObjectStore(ObjectStore):
+    """Directory-backed store: ``root/bucket/key``.
+
+    Bucket/key come from untrusted event payloads, so every path is
+    resolved and checked to stay under ``root`` (no traversal via
+    ``../`` or absolute keys).
+    """
+
+    def __init__(self, root: str):
+        self.root = Path(root).resolve()
+
+    def _path(self, bucket: str, key: str) -> Path:
+        path = (self.root / bucket / key).resolve()
+        if not path.is_relative_to(self.root):
+            raise ValueError(f"object path escapes store root: {bucket!r}/{key!r}")
+        return path
+
+    def get(self, bucket: str, key: str) -> bytes:
+        return self._path(bucket, key).read_bytes()
+
+    def put(self, bucket: str, key: str, data: bytes) -> None:
+        path = self._path(bucket, key)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_bytes(data)
+
+
+def gateway_handler(
+    model,
+    *,
+    batch: bool = False,
+    **serving_kwargs,
+) -> Callable[[Dict[str, Any], Any], Dict[str, Any]]:
+    """Build a ``handler(event, context)`` for API-Gateway-style events."""
+    app = ServingApp(model, batch=batch, **serving_kwargs)
+
+    def handler(event: Dict[str, Any], context: Any = None) -> Dict[str, Any]:
+        method = (event.get("httpMethod") or event.get("requestContext", {})
+                  .get("http", {}).get("method", "GET")).upper()
+        path = event.get("path") or event.get("rawPath") or "/"
+        try:
+            if method == "GET" and path == "/":
+                return {"statusCode": 200, "headers": {"Content-Type": "text/html"},
+                        "body": app.root()}
+            if method == "GET" and path == "/health":
+                return {"statusCode": 200, "body": json.dumps(app.health())}
+            if method == "POST" and path == "/predict":
+                payload = json.loads(event.get("body") or "{}")
+                return {"statusCode": 200, "body": json.dumps(app.predict(payload))}
+            return {"statusCode": 404, "body": json.dumps({"error": f"no route {method} {path}"})}
+        except ValueError as e:
+            return {"statusCode": 400, "body": json.dumps({"error": str(e)})}
+        except Exception as e:  # pragma: no cover - defensive 500 surface
+            return {"statusCode": 500, "body": json.dumps({"error": str(e)})}
+
+    handler.serving_app = app  # test/introspection seam
+    return handler
+
+
+def object_event_handler(
+    model,
+    store: ObjectStore,
+    *,
+    output_suffix: str = ".predictions.json",
+    parse: Optional[Callable[[bytes], Any]] = None,
+) -> Callable[[Dict[str, Any], Any], Dict[str, Any]]:
+    """Build a ``handler(event, context)`` for S3-style object events.
+
+    For each record, reads the object, runs it through the dataset's
+    feature pipeline + predictor, and writes predictions next to the
+    input under ``key + output_suffix``.
+    """
+    app = ServingApp(model)
+    parse = parse or (lambda raw: json.loads(raw.decode()))
+
+    def handler(event: Dict[str, Any], context: Any = None) -> Dict[str, Any]:
+        outputs = []
+        errors = []
+        for record in event.get("Records", []):
+            s3 = record.get("s3", {})
+            bucket = s3.get("bucket", {}).get("name")
+            key = s3.get("object", {}).get("key")
+            if not bucket or not key:
+                continue
+            # real S3 notifications URL-encode keys ("my file" -> "my+file")
+            key = unquote_plus(key)
+            try:
+                features = parse(store.get(bucket, key))
+                preds = app.predict({"features": features})
+                out_key = key + output_suffix
+                # predict() output is already JSON-safe (ServingApp contract)
+                store.put(bucket, out_key, json.dumps(preds).encode())
+                outputs.append({"bucket": bucket, "key": out_key})
+            except Exception as e:
+                # one bad object must not abort the batch: report it and
+                # keep the already-written outputs visible to the caller
+                errors.append({"bucket": bucket, "key": key, "error": str(e)})
+        body = {"outputs": outputs}
+        if errors:
+            body["errors"] = errors
+        return {"statusCode": 200 if not errors else 207, "body": json.dumps(body)}
+
+    handler.serving_app = app
+    return handler

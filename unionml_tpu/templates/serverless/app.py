@@ -1,0 +1,68 @@
+"""{{app_name}}: a unionml-tpu app served as serverless event handlers.
+
+Template parity: reference templates/basic-aws-lambda (API-Gateway
+events via Mangum) and basic-aws-lambda-s3 (S3-event batch prediction).
+Here both handlers come from :mod:`unionml_tpu.serving.serverless` and
+need no Mangum/boto3: ``handler`` answers gateway events, ``on_upload``
+reacts to object-store upload events (swap ``LocalObjectStore`` for a
+cloud-backed store in production).
+
+Try locally:
+    python app.py                # train + save model.joblib
+    UNIONML_MODEL_PATH=model.joblib python -c \
+        "from app import handler; print(handler({'httpMethod': 'GET', 'path': '/health'}))"
+"""
+
+import pandas as pd
+from sklearn.linear_model import LogisticRegression
+
+from unionml_tpu import Dataset, Model
+from unionml_tpu.serving.serverless import (
+    LocalObjectStore,
+    gateway_handler,
+    object_event_handler,
+)
+
+dataset = Dataset(name="{{app_name}}_dataset", test_size=0.2, shuffle=True, targets=["target"])
+model = Model(name="{{app_name}}", init=LogisticRegression, dataset=dataset)
+
+
+@dataset.reader
+def reader() -> pd.DataFrame:
+    from sklearn.datasets import load_digits
+
+    return load_digits(as_frame=True).frame
+
+
+@model.trainer
+def trainer(
+    estimator: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame
+) -> LogisticRegression:
+    return estimator.fit(features, target.squeeze())
+
+
+@model.predictor
+def predictor(estimator: LogisticRegression, features: pd.DataFrame) -> list:
+    return [float(x) for x in estimator.predict(features)]
+
+
+@model.evaluator
+def evaluator(
+    estimator: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame
+) -> float:
+    return float(estimator.score(features, target.squeeze()))
+
+
+# gateway events (GET /, GET /health, POST /predict)
+handler = gateway_handler(model)
+
+# object-store upload events: predict each uploaded JSON feature file and
+# write <key>.predictions.json back to the same bucket
+store = LocalObjectStore("./objectstore")
+on_upload = object_event_handler(model, store)
+
+
+if __name__ == "__main__":
+    estimator, metrics = model.train(hyperparameters={"max_iter": 5000})
+    print(f"metrics: {metrics}")
+    model.save("model.joblib")

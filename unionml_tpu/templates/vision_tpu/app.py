@@ -1,0 +1,115 @@
+"""{{app_name}}: a TPU-native computer-vision app (ViT on image batches).
+
+Template parity: reference templates/quickdraw (PyTorch CV app with a
+custom splitter, a custom feature_loader, and task caching —
+reference: templates/quickdraw/{{cookiecutter.app_name}}/app.py:18,32,62).
+TPU-native differences: the model is the framework's flax ViT, training
+is a jittable ``train_step`` over a data-parallel mesh, the expensive
+reader is cached with the stage cache (``cache=True, cache_version``),
+and prediction accepts image files through a custom ``feature_loader``.
+
+Run: ``python app.py`` (train + save), then
+``unionml-tpu serve app:model --model-path model.utpu --batch``.
+"""
+
+from pathlib import Path
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from unionml_tpu import Dataset, Model
+from unionml_tpu.models import ViT, ViTConfig, classification_step, create_train_state
+from unionml_tpu.parallel import ShardingConfig
+
+IMAGE_SIZE = 32
+NUM_CLASSES = 10
+
+dataset = Dataset(name="{{app_name}}_dataset", test_size=0.2)
+model = Model(name="{{app_name}}", dataset=dataset)
+
+module = ViT(ViTConfig.tiny(image_size=IMAGE_SIZE, num_classes=NUM_CLASSES))
+
+
+# the reader is the expensive stage (decode/resize a whole corpus), so it
+# is cached on disk: re-runs with the same kwargs hit the stage cache
+# (reference caching knob: quickdraw app.py:18 `cache=True, cache_version="1"`)
+@dataset.reader(cache=True, cache_version="1")
+def reader(n: int = 512, seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+    images = rng.normal(size=(n, IMAGE_SIZE, IMAGE_SIZE, 3)).astype(np.float32)
+    # synthetic labels with learnable signal (channel-mean threshold)
+    targets = (images.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+    return {"features": images, "targets": targets}
+
+
+# custom splitter: stratified-ish split keeping class balance
+# (reference custom splitter: quickdraw app.py:24-30)
+@dataset.splitter
+def splitter(data: dict, test_size: float, shuffle: bool, random_state: int):
+    n = len(data["features"])
+    idx = np.arange(n)
+    if shuffle:
+        np.random.default_rng(random_state).shuffle(idx)
+    k = int(n * (1 - test_size))
+    tr, te = idx[:k], idx[k:]
+    return (
+        {"features": data["features"][tr], "targets": data["targets"][tr]},
+        {"features": data["features"][te], "targets": data["targets"][te]},
+    )
+
+
+@dataset.parser
+def parser(data: dict, features, targets):
+    return (data["features"], data["targets"])
+
+
+# custom feature loader: accept a path to an .npy image file, a list of
+# nested lists, or a ready array (reference custom feature_loader:
+# quickdraw app.py:44-55 decodes uploaded drawings)
+@dataset.feature_loader
+def feature_loader(raw: Union[str, Path, list, np.ndarray]) -> np.ndarray:
+    if isinstance(raw, (str, Path)):
+        arr = np.load(raw)
+    else:
+        arr = np.asarray(raw, dtype=np.float32)
+    if arr.ndim == 3:  # single image -> batch of one
+        arr = arr[None]
+    return arr.astype(np.float32)
+
+
+@model.init
+def init(hyperparameters: dict) -> object:
+    return create_train_state(
+        module,
+        jnp.zeros((1, IMAGE_SIZE, IMAGE_SIZE, 3)),
+        optimizer=optax.adamw(hyperparameters.get("learning_rate", 1e-3)),
+    )
+
+
+@model.train_step(sharding=ShardingConfig(data=-1))
+def train_step(state, batch) -> tuple:
+    return classification_step(module)(state, batch)
+
+
+@model.predictor(jit=True)
+def predictor(state, features: np.ndarray) -> jnp.ndarray:
+    logits = state.apply_fn({"params": state.params}, jnp.asarray(features))
+    return jnp.argmax(logits, axis=-1)
+
+
+@model.evaluator
+def evaluator(state, features: np.ndarray, targets: np.ndarray) -> float:
+    preds = predictor(state, features)
+    return float((np.asarray(preds) == np.asarray(targets)).mean())
+
+
+if __name__ == "__main__":
+    state, metrics = model.train(
+        hyperparameters={"learning_rate": 1e-3},
+        trainer_kwargs={"num_epochs": 5, "batch_size": 64},
+    )
+    print(f"metrics: {metrics}")
+    model.save("model.utpu")
