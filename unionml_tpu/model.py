@@ -258,7 +258,11 @@ class Model(TrackedInstance):
                     return (dtype, dtype)
             except ImportError:
                 pass
-            return (dtype,)
+            # the default parser ALWAYS yields two outputs — (features,
+            # targets-or-None) — so the guard must demand two data args or
+            # the runtime call `trainer(model, *parsed)` breaks
+            # (reference parity: dataset.py:472-487 returns [features, targets])
+            return (dtype, Any)
         return ds.parser_return_types
 
     def trainer(self, fn: Optional[Callable] = None, **train_task_kwargs):

@@ -20,6 +20,7 @@ from unionml_tpu.models.llama import (
     LlamaConfig,
     init_cache,
 )
+from unionml_tpu.models.generate import make_generator, make_lm_predictor
 from unionml_tpu.models.mlp import Mlp, MlpConfig
 from unionml_tpu.models.train import (
     TrainState,
@@ -38,4 +39,5 @@ __all__ = [
     "Llama", "LlamaConfig", "init_cache", "LLAMA_PARTITION_RULES",
     "TrainState", "create_train_state", "classification_step", "lm_step",
     "make_evaluator", "make_predictor",
+    "make_generator", "make_lm_predictor",
 ]

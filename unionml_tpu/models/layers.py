@@ -131,11 +131,14 @@ class Attention(nn.Module):
         positions: Optional[jnp.ndarray] = None,
         cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
         cache_index: Optional[jnp.ndarray] = None,
+        kv_mask: Optional[jnp.ndarray] = None,
     ):
         """Returns ``out`` or ``(out, new_cache)`` when a cache is given.
 
         ``cache``: (k, v) of shape (batch, max_len, kv_heads, head_dim);
-        ``cache_index``: scalar int — current fill position (decode step).
+        ``cache_index``: scalar int — current fill position (decode step);
+        ``kv_mask``: optional bool (batch, max_len) — False slots are
+        never attended to (left-padded prompts in generation).
         """
         batch, seq, features = x.shape
         kv_heads = self.num_kv_heads or self.num_heads
@@ -170,7 +173,13 @@ class Attention(nn.Module):
             # cached prefill seq>1; unwritten slots are masked out)
             kv_pos = jnp.arange(ck.shape[1])[None, :]
             q_pos = cache_index + jnp.arange(seq)[:, None]
-            bias = jnp.where(kv_pos <= q_pos, 0.0, -1e30)[None, None]
+            visible = kv_pos <= q_pos                       # (seq, max_len)
+            if kv_mask is not None:
+                # (batch, 1, seq, max_len): padded slots stay invisible
+                visible = visible[None] & kv_mask[:, None, :]
+                bias = jnp.where(visible, 0.0, -1e30)[:, None]
+            else:
+                bias = jnp.where(visible, 0.0, -1e30)[None, None]
             out = xla_attention(
                 q, ck.astype(self.dtype), cv.astype(self.dtype), bias=bias
             )

@@ -64,7 +64,7 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, *, positions=None, cache=None, cache_index=None):
+    def __call__(self, x, *, positions=None, cache=None, cache_index=None, kv_mask=None):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         attn = Attention(
@@ -81,7 +81,10 @@ class LlamaBlock(nn.Module):
         )
         h = RMSNorm(dtype=dtype, name="attn_norm")(x)
         if cache is not None:
-            a, new_cache = attn(h, positions=positions, cache=cache, cache_index=cache_index)
+            a, new_cache = attn(
+                h, positions=positions, cache=cache, cache_index=cache_index,
+                kv_mask=kv_mask,
+            )
         else:
             a, new_cache = attn(h, positions=positions), None
         x = x + a
@@ -101,8 +104,13 @@ class Llama(nn.Module):
         positions: Optional[jnp.ndarray] = None,
         cache: Optional[Cache] = None,
         cache_index: Optional[jnp.ndarray] = None,
+        kv_mask: Optional[jnp.ndarray] = None,
     ):
-        """logits [B,S,V]; with ``cache`` returns (logits, new_cache)."""
+        """logits [B,S,V]; with ``cache`` returns (logits, new_cache).
+
+        ``kv_mask``: bool (batch, max_len) — False cache slots are never
+        attended to (left-padded prompts in generation).
+        """
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         x = nn.Embed(cfg.vocab_size, cfg.hidden_dim, dtype=dtype, name="embed")(tokens)
@@ -112,7 +120,8 @@ class Llama(nn.Module):
         for i in range(cfg.num_layers):
             layer_cache = cache[i] if cache is not None else None
             x, c = LlamaBlock(cfg, name=f"block_{i}")(
-                x, positions=positions, cache=layer_cache, cache_index=cache_index
+                x, positions=positions, cache=layer_cache, cache_index=cache_index,
+                kv_mask=kv_mask,
             )
             new_cache.append(c)
         x = RMSNorm(dtype=dtype, name="final_norm")(x)
