@@ -1,0 +1,22 @@
+# App image for remote deployment (reference analog: the root Dockerfile
+# template users build FROM). On TPU VMs, install the TPU jax wheel at
+# build time; the default target is CPU so the image also works as the
+# sandbox/CI base.
+FROM python:3.12-slim
+
+# g++ for the native host batch loader (compiled on first use)
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ git && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY . /app
+
+ARG JAX_VARIANT=""
+# TPU VMs: --build-arg JAX_VARIANT="[tpu]" -f ... (pulls libtpu)
+RUN pip install --no-cache-dir "jax${JAX_VARIANT}" && \
+    pip install --no-cache-dir -e ".[tabular,fastapi]"
+
+ENV UNIONML_MODEL_PATH=""
+EXPOSE 8000
+ENTRYPOINT ["unionml-tpu"]
+CMD ["--help"]

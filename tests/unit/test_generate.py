@@ -95,6 +95,38 @@ def test_sampling_is_deterministic_per_key_and_varies_across_keys(tiny_llama):
     assert not np.array_equal(a, c)
 
 
+def test_sampling_without_key_rejected_and_mask_without_cache_rejected(tiny_llama):
+    module, params = tiny_llama
+    gen = make_generator(module, max_new_tokens=2, max_len=16, temperature=0.7)
+    with pytest.raises(ValueError, match="PRNG key"):
+        gen(params, jnp.zeros((1, 4), jnp.int32))
+    with pytest.raises(ValueError, match="kv_mask requires a KV cache"):
+        module.apply(
+            {"params": params}, jnp.zeros((1, 4), jnp.int32),
+            kv_mask=jnp.ones((1, 4), bool),
+        )
+
+
+def test_lm_predictor_batch_bucketing(tiny_llama):
+    module, params = tiny_llama
+
+    class S:
+        params = None
+
+    s = S()
+    s.params = params
+    predictor = make_lm_predictor(
+        module, max_new_tokens=2, max_len=64, bucket_lens=(16, 8)  # unsorted on purpose
+    )
+    # 3 prompts pad to a batch of 4 internally; results per row still exact
+    out = predictor(s, [[1, 2], [3, 4, 5], [6]])
+    assert len(out) == 3
+    gen = make_generator(module, max_new_tokens=2, max_len=64)
+    ref = np.asarray(gen(params, jnp.asarray([[0, 0, 0, 0, 0, 0, 1, 2]], jnp.int32),
+                         None, jnp.asarray([[False] * 6 + [True] * 2])))
+    np.testing.assert_array_equal(np.asarray(out[0]), ref[0])
+
+
 def test_generation_rejects_cache_overflow(tiny_llama):
     module, params = tiny_llama
     gen = make_generator(module, max_new_tokens=8, max_len=12)

@@ -81,7 +81,14 @@ def make_generator(
                 f"exceeds the KV cache length {total_len}; raise max_len"
             )
         if key is None:
-            key = jax.random.PRNGKey(0)
+            if temperature != 0.0:
+                # a silent fixed-key default would return byte-identical
+                # "samples" on every call
+                raise ValueError(
+                    "temperature sampling needs an explicit PRNG key: "
+                    "generate(params, tokens, key)"
+                )
+            key = jax.random.PRNGKey(0)  # greedy: key is never consumed
         if prompt_mask is None:
             prompt_mask = jnp.ones((batch, prompt_len), bool)
         pad_counts = prompt_len - prompt_mask.sum(axis=1).astype(jnp.int32)  # [B]
@@ -155,7 +162,7 @@ def make_lm_predictor(
 
     total_len = max_len or module.config.max_len
     # only buckets that leave room for generation in the KV cache
-    usable = tuple(b for b in bucket_lens if b + max_new_tokens <= total_len)
+    usable = tuple(sorted(b for b in bucket_lens if b + max_new_tokens <= total_len))
     if not usable:
         raise ValueError(
             f"no bucket in {bucket_lens} leaves room for {max_new_tokens} new "
@@ -176,14 +183,19 @@ def make_lm_predictor(
             rows = [arr] if arr.ndim == 1 else list(arr)
         longest = max(len(r) for r in rows)
         bucket = next((b for b in usable if b >= longest), usable[-1])
-        batch = np.full((len(rows), bucket), pad_id, np.int32)
-        mask = np.zeros((len(rows), bucket), bool)
-        for i, r in enumerate(rows):
+        # bucket the BATCH dimension too (next power of two): otherwise
+        # every distinct batch size compiles a fresh executable
+        n = len(rows)
+        n_padded = 1 << (n - 1).bit_length()
+        batch = np.full((n_padded, bucket), pad_id, np.int32)
+        mask = np.zeros((n_padded, bucket), bool)
+        for i in range(n_padded):
+            r = rows[min(i, n - 1)]               # pad rows replicate the last
             r = r[-bucket:]                       # left-truncate long prompts
             batch[i, bucket - len(r):] = r        # right-align (left-pad)
             mask[i, bucket - len(r):] = True
         key_state["key"], sub = jax.random.split(key_state["key"])
         out = generator(params, jnp.asarray(batch), sub, jnp.asarray(mask))
-        return np.asarray(out).tolist()
+        return np.asarray(out)[:n].tolist()
 
     return predictor

@@ -86,6 +86,14 @@ class LlamaBlock(nn.Module):
                 kv_mask=kv_mask,
             )
         else:
+            if kv_mask is not None:
+                # the non-cache attention path has no mask plumbing; silently
+                # ignoring the mask would attend padded tokens
+                raise ValueError(
+                    "kv_mask requires a KV cache (generation path); for "
+                    "cache-free padded batches use segment_ids/bias on the "
+                    "xla attention op instead"
+                )
             a, new_cache = attn(h, positions=positions), None
         x = x + a
         h = RMSNorm(dtype=dtype, name="mlp_norm")(x)
