@@ -9,14 +9,17 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
     g++ git && rm -rf /var/lib/apt/lists/*
 
 WORKDIR /app
-COPY . /app
 
 ARG JAX_VARIANT=""
-# TPU VMs: --build-arg JAX_VARIANT="[tpu]" -f ... (pulls libtpu)
-RUN pip install --no-cache-dir "jax${JAX_VARIANT}" && \
-    pip install --no-cache-dir -e ".[tabular,fastapi]"
+# dependency layer first so source edits don't re-download wheels
+# TPU VMs: --build-arg JAX_VARIANT="[tpu]" (pulls libtpu)
+COPY pyproject.toml README.md /app/
+RUN pip install --no-cache-dir "jax${JAX_VARIANT}" pandas scikit-learn fastapi \
+    flax optax orbax-checkpoint click numpy
 
-ENV UNIONML_MODEL_PATH=""
+COPY . /app
+RUN pip install --no-cache-dir --no-deps -e .
+
 EXPOSE 8000
 ENTRYPOINT ["unionml-tpu"]
 CMD ["--help"]

@@ -683,7 +683,8 @@ class Model(TrackedInstance):
 
     def load_from_env(self, env_var: str = "UNIONML_MODEL_PATH", *args, **kwargs):
         model_path = os.getenv(env_var)
-        if model_path is None:
+        # empty string counts as unset (containers often export VAR="")
+        if not model_path:
             raise ValueError(f"env var for model path {env_var} doesn't exist.")
         return self.load(model_path, *args, **kwargs)
 

@@ -24,6 +24,7 @@ from unionml_tpu.models.generate import make_generator, make_lm_predictor
 from unionml_tpu.models.mlp import Mlp, MlpConfig
 from unionml_tpu.models.train import (
     TrainState,
+    adamw,
     classification_step,
     create_train_state,
     lm_step,
@@ -39,5 +40,5 @@ __all__ = [
     "Llama", "LlamaConfig", "init_cache", "LLAMA_PARTITION_RULES",
     "TrainState", "create_train_state", "classification_step", "lm_step",
     "make_evaluator", "make_predictor",
-    "make_generator", "make_lm_predictor",
+    "make_generator", "make_lm_predictor", "adamw",
 ]

@@ -24,6 +24,23 @@ class TrainState(train_state.TrainState):
     """flax TrainState (params + optax state + apply_fn + step counter)."""
 
 
+def adamw(learning_rate: float, *, weight_decay: float = 0.0,
+          b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
+    """AdamW as an explicit optax chain.
+
+    Mathematically identical to ``optax.adamw``, but ``optax.adamw``
+    triggers a ~4x whole-step slowdown under buffer donation on TPU
+    (measured on v5e, BERT-base 110M params: 83.5 ms/step vs 20.3 ms for
+    this chain — see BASELINE.md); the explicit composition compiles
+    clean under donated state.
+    """
+    steps = [optax.scale_by_adam(b1=b1, b2=b2, eps=eps)]
+    if weight_decay:
+        steps.append(optax.add_decayed_weights(weight_decay))
+    steps.append(optax.scale(-learning_rate))
+    return optax.chain(*steps)
+
+
 def create_train_state(
     module: nn.Module,
     example_input: Any,
@@ -36,14 +53,15 @@ def create_train_state(
 ) -> TrainState:
     """Initialize parameters from an example batch and wrap with optax.
 
-    Default optimizer is adamw — the optimizer state duplicates the param
-    pytree twice, so under FSDP the same partition rules shard it too
-    (ShardingConfig.state_shardings walks the whole TrainState).
+    Default optimizer is :func:`adamw` (the donation-safe chain) — the
+    optimizer state duplicates the param pytree twice, so under FSDP the
+    same partition rules shard it too (ShardingConfig.state_shardings
+    walks the whole TrainState).
     """
     params = module.init(
         jax.random.PRNGKey(seed), example_input, **(init_kwargs or {})
     )["params"]
-    tx = optimizer or optax.adamw(learning_rate, weight_decay=weight_decay)
+    tx = optimizer or adamw(learning_rate, weight_decay=weight_decay)
     return TrainState.create(apply_fn=module.apply, params=params, tx=tx)
 
 

@@ -18,8 +18,6 @@ from typing import Union
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
-
 from unionml_tpu import Dataset, Model
 from unionml_tpu.models import ViT, ViTConfig, classification_step, create_train_state
 from unionml_tpu.parallel import ShardingConfig
@@ -85,7 +83,7 @@ def init(hyperparameters: dict) -> object:
     return create_train_state(
         module,
         jnp.zeros((1, IMAGE_SIZE, IMAGE_SIZE, 3)),
-        optimizer=optax.adamw(hyperparameters.get("learning_rate", 1e-3)),
+        learning_rate=hyperparameters.get("learning_rate", 1e-3),
     )
 
 
