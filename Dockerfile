@@ -9,16 +9,15 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
     g++ git && rm -rf /var/lib/apt/lists/*
 
 WORKDIR /app
+COPY . /app
 
 ARG JAX_VARIANT=""
-# dependency layer first so source edits don't re-download wheels
-# TPU VMs: --build-arg JAX_VARIANT="[tpu]" (pulls libtpu)
-COPY pyproject.toml README.md /app/
-RUN pip install --no-cache-dir "jax${JAX_VARIANT}" pandas scikit-learn fastapi \
-    flax optax orbax-checkpoint click numpy
-
-COPY . /app
-RUN pip install --no-cache-dir --no-deps -e .
+# TPU VMs: --build-arg JAX_VARIANT="[tpu]" (pulls libtpu). Dependencies
+# come from pyproject extras so the image can never drift from the
+# package metadata (a hand-maintained list here silently dropped uvicorn
+# once — correctness beats layer caching).
+RUN pip install --no-cache-dir "jax${JAX_VARIANT}" && \
+    pip install --no-cache-dir -e ".[tabular,fastapi]"
 
 EXPOSE 8000
 ENTRYPOINT ["unionml-tpu"]

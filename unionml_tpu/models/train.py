@@ -37,7 +37,8 @@ def adamw(learning_rate: float, *, weight_decay: float = 0.0,
     steps = [optax.scale_by_adam(b1=b1, b2=b2, eps=eps)]
     if weight_decay:
         steps.append(optax.add_decayed_weights(weight_decay))
-    steps.append(optax.scale(-learning_rate))
+    # scale_by_learning_rate accepts floats AND schedules, like optax.adamw
+    steps.append(optax.scale_by_learning_rate(learning_rate))
     return optax.chain(*steps)
 
 

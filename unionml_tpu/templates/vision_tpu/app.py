@@ -84,6 +84,7 @@ def init(hyperparameters: dict) -> object:
         module,
         jnp.zeros((1, IMAGE_SIZE, IMAGE_SIZE, 3)),
         learning_rate=hyperparameters.get("learning_rate", 1e-3),
+        weight_decay=hyperparameters.get("weight_decay", 1e-4),
     )
 
 
