@@ -1,0 +1,122 @@
+"""Weight-only int8 serving quantization: the quantized model must load
+converted fp weights and generate nearly the same tokens."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu.models import Llama, LlamaConfig
+from unionml_tpu.models.generate import make_generator
+from unionml_tpu.models.quantization import (
+    QuantizedDenseGeneral,
+    quantize_params,
+)
+
+LLAMA_QUANT_PATTERNS = (r"attn/(q|k|v|o)$", r"mlp/(gate|up|down)$", r"lm_head$")
+
+
+def test_quantized_dense_matches_fp_geometry():
+    # qkv geometry: axis=-1, tuple features
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 16))
+    qd = QuantizedDenseGeneral(features=(4, 8), axis=-1, dtype=jnp.float32)
+    params = qd.init(jax.random.PRNGKey(1), x)
+    assert params["params"]["kernel_q"].shape == (16, 32)
+    assert qd.apply(params, x).shape == (2, 5, 4, 8)
+    # o geometry: contract (-2, -1)
+    y = jax.random.normal(jax.random.PRNGKey(2), (2, 5, 4, 8))
+    od = QuantizedDenseGeneral(features=16, axis=(-2, -1), dtype=jnp.float32)
+    oparams = od.init(jax.random.PRNGKey(3), y)
+    assert oparams["params"]["kernel_q"].shape == (32, 16)
+    assert od.apply(oparams, y).shape == (2, 5, 16)
+
+
+def test_quantize_params_structure_matches_quantized_module():
+    cfg = LlamaConfig.tiny(vocab_size=97)
+    fp = Llama(cfg)
+    qm = Llama(LlamaConfig(**{**cfg.__dict__, "quantized": True}))
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    fp_params = fp.init(jax.random.PRNGKey(0), tokens)["params"]
+    q_template = qm.init(jax.random.PRNGKey(0), tokens)["params"]
+    converted = quantize_params(fp_params, LLAMA_QUANT_PATTERNS)
+    a = jax.tree_util.tree_structure(q_template)
+    b = jax.tree_util.tree_structure(converted)
+    assert a == b, f"{a}\n!=\n{b}"
+    # shapes and dtypes line up leaf-for-leaf
+    for (pa, la), (pb, lb) in zip(
+        jax.tree_util.tree_flatten_with_path(q_template)[0],
+        jax.tree_util.tree_flatten_with_path(converted)[0],
+    ):
+        assert la.shape == lb.shape, (pa, la.shape, lb.shape)
+
+
+def test_quantized_generation_close_to_fp():
+    cfg = LlamaConfig.tiny(vocab_size=97)
+    fp = Llama(cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(1, 97, size=(2, 6)), jnp.int32
+    )
+    fp_params = fp.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    q_params = quantize_params(fp_params, LLAMA_QUANT_PATTERNS)
+    qm = Llama(LlamaConfig(**{**cfg.__dict__, "quantized": True}))
+
+    # logits agree closely (int8 per-channel weight-only error)
+    lf = fp.apply({"params": fp_params}, tokens)
+    lq = qm.apply({"params": q_params}, tokens)
+    denom = float(jnp.max(jnp.abs(lf))) or 1.0
+    rel = float(jnp.max(jnp.abs(lf - lq))) / denom
+    assert rel < 0.06, f"relative logit error {rel}"
+    # greedy top-1 agreement on most positions
+    agree = float(jnp.mean(
+        (jnp.argmax(lf, -1) == jnp.argmax(lq, -1)).astype(jnp.float32)
+    ))
+    assert agree >= 0.75, f"top-1 agreement {agree}"
+
+    # generation runs end to end through the quantized path
+    gen = make_generator(qm, max_new_tokens=4, max_len=32)
+    out = np.asarray(gen(q_params, tokens))
+    assert out.shape == (2, 4)
+
+
+def test_quantized_generation_under_tensor_parallel():
+    """The 8B serving config needs TP + int8 together: quantized params
+    shard under LLAMA_QUANT_PARTITION_RULES and generation matches the
+    unsharded quantized run."""
+    from unionml_tpu.models import LLAMA_QUANT_PARTITION_RULES
+    from unionml_tpu.parallel import ShardingConfig, shard_pytree
+
+    cfg = LlamaConfig.tiny(vocab_size=97)
+    fp = Llama(cfg)
+    fp_params = fp.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    q_params = quantize_params(fp_params, LLAMA_QUANT_PATTERNS)
+    qm = Llama(LlamaConfig(**{**cfg.__dict__, "quantized": True}))
+
+    prompt = jnp.asarray([[7, 3, 9, 2]], jnp.int32)
+    gen = make_generator(qm, max_new_tokens=4, max_len=32)
+    ref = np.asarray(gen(q_params, prompt))
+
+    scfg = ShardingConfig(data=-1, tensor=2, rules=LLAMA_QUANT_PARTITION_RULES)
+    sharded = shard_pytree(q_params, scfg)
+    specs = [
+        (jax.tree_util.keystr(p), tuple(l.sharding.spec))
+        for p, l in jax.tree_util.tree_flatten_with_path(sharded)[0]
+    ]
+    # kernels AND their scales carry the tensor axis
+    assert any("kernel_q" in p and "tensor" in str(s) for p, s in specs)
+    assert any("scale" in p and "tensor" in str(s) for p, s in specs)
+    got = np.asarray(gen(sharded, prompt))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_quantization_halves_param_bytes():
+    cfg = LlamaConfig.tiny(vocab_size=97)
+    fp = Llama(cfg)
+    fp_params = fp.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    q_params = quantize_params(fp_params, LLAMA_QUANT_PATTERNS)
+
+    def nbytes(t):
+        return sum(np.asarray(l).nbytes for l in jax.tree_util.tree_leaves(t))
+
+    # matmul weights dominate: int8 + small scales ≈ 1/4 of fp32 storage
+    assert nbytes(q_params) < 0.45 * nbytes(fp_params)

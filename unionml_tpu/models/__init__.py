@@ -16,12 +16,14 @@ from unionml_tpu.models.bert import (
 )
 from unionml_tpu.models.llama import (
     LLAMA_PARTITION_RULES,
+    LLAMA_QUANT_PARTITION_RULES,
     Llama,
     LlamaConfig,
     init_cache,
 )
 from unionml_tpu.models.generate import make_generator, make_lm_predictor
 from unionml_tpu.models.mlp import Mlp, MlpConfig
+from unionml_tpu.models.quantization import QuantizedDenseGeneral, quantize_params
 from unionml_tpu.models.train import (
     TrainState,
     adamw,
@@ -38,7 +40,9 @@ __all__ = [
     "ViT", "ViTConfig", "VIT_PARTITION_RULES",
     "BertEncoder", "BertClassifier", "BertMlm", "BertConfig", "BERT_PARTITION_RULES",
     "Llama", "LlamaConfig", "init_cache", "LLAMA_PARTITION_RULES",
+    "LLAMA_QUANT_PARTITION_RULES",
     "TrainState", "create_train_state", "classification_step", "lm_step",
     "make_evaluator", "make_predictor",
     "make_generator", "make_lm_predictor", "adamw",
+    "QuantizedDenseGeneral", "quantize_params",
 ]

@@ -132,10 +132,10 @@ class BertMlm(nn.Module):
 
 
 BERT_PARTITION_RULES = (
-    PartitionRule(r"attn_(q|k|v)/kernel", (None, "tensor", None)),
-    PartitionRule(r"attn_o/kernel", ("tensor", None, None)),
-    PartitionRule(r"mlp/up/kernel", (None, "tensor")),
-    PartitionRule(r"mlp/down/kernel", ("tensor", None)),
-    PartitionRule(r"tok_embed/embedding", (None, "tensor")),
-    PartitionRule(r"mlm_head/kernel", (None, "tensor")),
+    PartitionRule(r"attn_(q|k|v)/kernel$", (None, "tensor", None)),
+    PartitionRule(r"attn_o/kernel$", ("tensor", None, None)),
+    PartitionRule(r"mlp/up/kernel$", (None, "tensor")),
+    PartitionRule(r"mlp/down/kernel$", ("tensor", None)),
+    PartitionRule(r"tok_embed/embedding$", (None, "tensor")),
+    PartitionRule(r"mlm_head/kernel$", (None, "tensor")),
 )

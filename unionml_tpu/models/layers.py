@@ -34,6 +34,30 @@ from unionml_tpu.ops.attention import blockwise_attention
 Dtype = Any
 
 
+def make_dense(
+    *,
+    quantized: bool,
+    features,
+    name: str,
+    dtype: Dtype,
+    axis=-1,
+    param_dtype: Dtype = jnp.float32,
+    use_bias: bool = False,
+):
+    """Dense-projection factory shared by every matmul site that supports
+    the int8 weight-only serving path (Attention qkv/o, gated MLP,
+    lm_head): one place to extend quantized-layer construction."""
+    if quantized:
+        from unionml_tpu.models.quantization import QuantizedDenseGeneral
+
+        assert not use_bias, "quantized dense layers are bias-free"
+        return QuantizedDenseGeneral(features=features, axis=axis, dtype=dtype, name=name)
+    return nn.DenseGeneral(
+        features=features, axis=axis, use_bias=use_bias, dtype=dtype,
+        param_dtype=param_dtype, name=name,
+    )
+
+
 class RMSNorm(nn.Module):
     """Root-mean-square norm (Llama-style, no mean subtraction)."""
 
@@ -120,6 +144,7 @@ class Attention(nn.Module):
     causal: bool = False
     attn_impl: str = "xla"
     sequence_axis: Optional[str] = None
+    quantized: bool = False  # int8 weight-only projections (serving)
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
 
@@ -143,13 +168,9 @@ class Attention(nn.Module):
         batch, seq, features = x.shape
         kv_heads = self.num_kv_heads or self.num_heads
         head_dim = self.head_dim or features // self.num_heads
-        dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
-            features=feats,
-            axis=-1,
-            use_bias=False,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            name=name,
+        dense = lambda feats, name: make_dense(  # noqa: E731
+            quantized=self.quantized, features=feats, axis=-1,
+            dtype=self.dtype, param_dtype=self.param_dtype, name=name,
         )
         q = dense((self.num_heads, head_dim), "q")(x)
         k = dense((kv_heads, head_dim), "k")(x)
@@ -190,13 +211,9 @@ class Attention(nn.Module):
                 causal=self.causal,
                 sequence_axis=self.sequence_axis,
             )
-        out = nn.DenseGeneral(
-            features=features,
-            axis=(-2, -1),
-            use_bias=False,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            name="o",
+        out = make_dense(
+            quantized=self.quantized, features=features, axis=(-2, -1),
+            dtype=self.dtype, param_dtype=self.param_dtype, name="o",
         )(out)
         if cache is not None:
             return out, new_cache
@@ -208,15 +225,18 @@ class MlpBlock(nn.Module):
 
     hidden_dim: int
     gated: bool = False  # True → SwiGLU
+    quantized: bool = False  # int8 weight-only (bias-free gated form only)
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         features = x.shape[-1]
-        dense = lambda feats, name: nn.Dense(  # noqa: E731
-            feats, use_bias=not self.gated, dtype=self.dtype,
-            param_dtype=self.param_dtype, name=name,
+        if self.quantized:
+            assert self.gated, "quantized MlpBlock supports the bias-free gated form"
+        dense = lambda feats, name: make_dense(  # noqa: E731
+            quantized=self.quantized, features=feats, dtype=self.dtype,
+            param_dtype=self.param_dtype, use_bias=not self.gated, name=name,
         )
         if self.gated:
             gate = nn.silu(dense(self.hidden_dim, "gate")(x))

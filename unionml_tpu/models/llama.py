@@ -24,7 +24,7 @@ from typing import Any, Optional, Tuple
 import jax.numpy as jnp
 from flax import linen as nn
 
-from unionml_tpu.models.layers import Attention, MlpBlock, RMSNorm
+from unionml_tpu.models.layers import Attention, MlpBlock, RMSNorm, make_dense
 from unionml_tpu.parallel.sharding import PartitionRule
 
 Cache = Tuple[Tuple[jnp.ndarray, jnp.ndarray], ...]  # per-layer (k, v)
@@ -42,6 +42,7 @@ class LlamaConfig:
     max_len: int = 8192
     attn_impl: str = "xla"
     sequence_axis: Optional[str] = None
+    quantized: bool = False  # int8 weight-only matmuls (serving path)
     dtype: str = "bfloat16"
 
     @staticmethod
@@ -76,6 +77,7 @@ class LlamaBlock(nn.Module):
             causal=True,
             attn_impl=cfg.attn_impl,
             sequence_axis=cfg.sequence_axis,
+            quantized=cfg.quantized,
             dtype=dtype,
             name="attn",
         )
@@ -97,7 +99,10 @@ class LlamaBlock(nn.Module):
             a, new_cache = attn(h, positions=positions), None
         x = x + a
         h = RMSNorm(dtype=dtype, name="mlp_norm")(x)
-        x = x + MlpBlock(hidden_dim=cfg.mlp_dim, gated=True, dtype=dtype, name="mlp")(h)
+        x = x + MlpBlock(
+            hidden_dim=cfg.mlp_dim, gated=True, quantized=cfg.quantized,
+            dtype=dtype, name="mlp",
+        )(h)
         return x, new_cache
 
 
@@ -133,8 +138,9 @@ class Llama(nn.Module):
             )
             new_cache.append(c)
         x = RMSNorm(dtype=dtype, name="final_norm")(x)
-        logits = nn.Dense(
-            cfg.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"
+        logits = make_dense(
+            quantized=cfg.quantized, features=cfg.vocab_size,
+            dtype=jnp.float32, name="lm_head",
         )(x.astype(jnp.float32))
         if cache is not None:
             return logits, tuple(new_cache)
@@ -152,10 +158,26 @@ def init_cache(
 
 
 LLAMA_PARTITION_RULES = (
-    PartitionRule(r"attn/(q|k|v)/kernel", (None, "tensor", None)),
-    PartitionRule(r"attn/o/kernel", ("tensor", None, None)),
-    PartitionRule(r"mlp/(gate|up)/kernel", (None, "tensor")),
-    PartitionRule(r"mlp/down/kernel", ("tensor", None)),
-    PartitionRule(r"embed/embedding", ("tensor", None)),
-    PartitionRule(r"lm_head/kernel", (None, "tensor")),
+    # `$`-anchored so `kernel` never matches the quantized `kernel_q` params
+    PartitionRule(r"attn/(q|k|v)/kernel$", (None, "tensor", None)),
+    PartitionRule(r"attn/o/kernel$", ("tensor", None, None)),
+    PartitionRule(r"mlp/(gate|up)/kernel$", (None, "tensor")),
+    PartitionRule(r"mlp/down/kernel$", ("tensor", None)),
+    PartitionRule(r"embed/embedding$", ("tensor", None)),
+    PartitionRule(r"lm_head/kernel$", (None, "tensor")),
+)
+
+# int8 serving (LlamaConfig.quantized=True): kernels are 2D [K, N] with a
+# per-output-channel scale [N]. Megatron layout carries over: qkv/gate/up/
+# lm_head shard N (their scales shard with it); o/down shard K (their
+# scales are replicated since N is unsharded).
+LLAMA_QUANT_PARTITION_RULES = LLAMA_PARTITION_RULES + (
+    PartitionRule(r"attn/(q|k|v)/kernel_q$", (None, "tensor")),
+    PartitionRule(r"attn/(q|k|v)/scale$", ("tensor",)),
+    PartitionRule(r"attn/o/kernel_q$", ("tensor", None)),
+    PartitionRule(r"mlp/(gate|up)/kernel_q$", (None, "tensor")),
+    PartitionRule(r"mlp/(gate|up)/scale$", ("tensor",)),
+    PartitionRule(r"mlp/down/kernel_q$", ("tensor", None)),
+    PartitionRule(r"lm_head/kernel_q$", (None, "tensor")),
+    PartitionRule(r"lm_head/scale$", ("tensor",)),
 )

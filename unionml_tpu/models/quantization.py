@@ -1,0 +1,129 @@
+"""Weight-only int8 quantization for serving.
+
+No reference counterpart (the reference serves whatever sklearn/torch
+object was trained — reference: unionml/fastapi.py:50-64). On TPU,
+autoregressive decode is HBM-bandwidth-bound on *parameter reads* (every
+generated token streams the full weight set through the MXU), so storing
+matmul weights as int8 with per-output-channel fp scales roughly halves
+decode latency versus bf16: XLA fuses the int8→bf16 convert into the
+matmul, so HBM traffic is the int8 bytes. Quality: symmetric per-channel
+weight-only int8 is the standard "free lunch" point — activations stay
+bf16, no calibration data needed.
+
+Two pieces:
+
+- :class:`QuantizedDenseGeneral` — drop-in for the dense projections in
+  :mod:`unionml_tpu.models.layers` (same ``(axis, features)`` geometry),
+  storing ``kernel_q`` int8 ``[K, N]`` + ``scale`` fp32 ``[N]``.
+- :func:`quantize_params` — convert a trained fp param tree into the
+  quantized module's param structure (kernels reshaped to 2D, quantized
+  per output channel; everything else passed through).
+
+Llama opts in with ``LlamaConfig(quantized=True)`` — the same weights
+trained unquantized load after :func:`quantize_params`.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+class QuantizedDenseGeneral(nn.Module):
+    """Weight-only int8 dense layer matching DenseGeneral geometry.
+
+    ``axis``: input dims to contract (int or tuple, negative indices);
+    ``features``: output dims (int or tuple). The kernel is stored 2D
+    ``[K, N]`` int8 with a per-output-channel fp32 ``scale`` ``[N]``.
+    """
+
+    features: Union[int, Sequence[int]]
+    axis: Union[int, Sequence[int]] = -1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        axes = (self.axis,) if isinstance(self.axis, int) else tuple(self.axis)
+        axes = tuple(a % x.ndim for a in axes)
+        feats = (self.features,) if isinstance(self.features, int) else tuple(self.features)
+        k = int(np.prod([x.shape[a] for a in axes]))
+        n = int(np.prod(feats))
+
+        kernel_q = self.param(
+            "kernel_q", nn.initializers.zeros, (k, n), jnp.int8
+        )
+        scale = self.param("scale", nn.initializers.ones, (n,), jnp.float32)
+
+        batch_axes = tuple(i for i in range(x.ndim) if i not in axes)
+        xt = x.transpose(*batch_axes, *axes).reshape(
+            tuple(x.shape[i] for i in batch_axes) + (k,)
+        )
+        # int8 weights convert to the compute dtype inside the fused
+        # matmul: HBM reads stay int8
+        w = kernel_q.astype(self.dtype)
+        y = jax.lax.dot_general(
+            xt.astype(self.dtype), w,
+            (((xt.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        y = (y * scale).astype(self.dtype)
+        return y.reshape(y.shape[:-1] + feats)
+
+
+def _quantize_kernel_2d(w2d: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-output-channel int8: returns (kernel_q, scale)."""
+    w = jnp.asarray(w2d, jnp.float32)
+    absmax = jnp.max(jnp.abs(w), axis=0)                       # [N]
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def quantize_params(params: Any, patterns: Sequence[str] = (r".*",)) -> Any:
+    """Convert fp dense kernels to the quantized param structure.
+
+    Walks the tree; any dict holding a ``kernel`` whose path matches one
+    of ``patterns`` becomes ``{"kernel_q": int8 [K, N], "scale": [N]}``.
+    The K/N split follows the layer geometry in
+    :mod:`unionml_tpu.models.layers`: a projection named ``o`` contracts
+    its LEADING dims (``[heads, dim, out]`` → K=heads*dim, N=out); every
+    other projection contracts its single leading input dim
+    (``[in, ...features]`` → K=in, N=prod(features)). A module with a
+    differently-shaped multi-axis kernel needs its own conversion — this
+    name-based dispatch covers the shipped model zoo only.
+    Non-matching subtrees pass through unchanged.
+    """
+    compiled = [re.compile(p) for p in patterns]
+
+    def walk(path, tree):
+        if isinstance(tree, dict) and "kernel" in tree and isinstance(
+            tree["kernel"], (jnp.ndarray, np.ndarray)
+        ):
+            joined = "/".join(path)
+            if any(c.search(joined) for c in compiled):
+                w = jnp.asarray(tree["kernel"])
+                # DenseGeneral geometry: the "o" projection contracts its
+                # LEADING dims (heads, dim); every other projection
+                # contracts the single leading input dim
+                if path and path[-1] == "o":
+                    k = int(np.prod(w.shape[:-1]))
+                    w2d = w.reshape(k, w.shape[-1])
+                else:
+                    k = w.shape[0]
+                    w2d = w.reshape(k, -1)
+                q, scale = _quantize_kernel_2d(w2d)
+                out = {"kernel_q": q, "scale": scale}
+                for extra, v in tree.items():
+                    if extra != "kernel":
+                        out[extra] = v
+                return out
+        if isinstance(tree, dict):
+            return {k: walk(path + (k,), v) for k, v in tree.items()}
+        return tree
+
+    return walk((), params)

@@ -96,9 +96,9 @@ class ViT(nn.Module):
 # Megatron-style TP: qkv/up split output features over `tensor`,
 # o/down split input features → one psum after attn, one after mlp.
 VIT_PARTITION_RULES = (
-    PartitionRule(r"attn/(q|k|v)/kernel", (None, "tensor", None)),
-    PartitionRule(r"attn/o/kernel", ("tensor", None, None)),
-    PartitionRule(r"mlp/up/kernel", (None, "tensor")),
-    PartitionRule(r"mlp/down/kernel", ("tensor", None)),
-    PartitionRule(r"patch_embed/kernel", (None, None, None, "tensor")),
+    PartitionRule(r"attn/(q|k|v)/kernel$", (None, "tensor", None)),
+    PartitionRule(r"attn/o/kernel$", ("tensor", None, None)),
+    PartitionRule(r"mlp/up/kernel$", (None, "tensor")),
+    PartitionRule(r"mlp/down/kernel$", ("tensor", None)),
+    PartitionRule(r"patch_embed/kernel$", (None, None, None, "tensor")),
 )
