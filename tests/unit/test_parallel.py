@@ -32,6 +32,39 @@ def test_make_mesh_bad_sizes():
         make_mesh({"data": -1, "tensor": -1})
 
 
+def test_make_mesh_hybrid_dcn_axes():
+    """Multi-slice layout: the `data` axis spans 2 slices over DCN while
+    `tensor` stays inside a slice on ICI — a psum over `data` still
+    reduces correctly across the whole hybrid mesh."""
+    mesh = make_mesh({"data": 4, "tensor": 2}, dcn_axes={"data": 2})
+    assert mesh.shape == {"data": 4, "tensor": 2}
+
+    from jax import shard_map
+
+    x = jnp.arange(8.0)
+
+    def body(x):
+        return jax.lax.psum(x, "data")
+
+    out = shard_map(
+        body, mesh=mesh, in_specs=P(("data", "tensor")), out_specs=P(("data", "tensor"))
+    )(x)
+    # device (d, t) holds element d*2+t; psum over `data` gives, for fixed
+    # t, sum_d x[d*2+t] = 12 + 4t — wrong reduction groups would differ
+    np.testing.assert_allclose(
+        np.asarray(out), np.array([12.0, 16.0] * 4)
+    )
+
+
+def test_serve_gradio_gated_without_dependency():
+    from unionml_tpu import Dataset, Model
+
+    ds = Dataset(name="g_ds")
+    m = Model(name="g", dataset=ds)
+    with pytest.raises((ImportError, ValueError), match="gradio|artifact"):
+        m.serve_gradio()
+
+
 def test_sharding_config_dp():
     cfg = ShardingConfig(data=-1)
     assert cfg.mesh().shape == {"data": 8}

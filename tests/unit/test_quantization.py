@@ -10,11 +10,10 @@ import jax.numpy as jnp
 from unionml_tpu.models import Llama, LlamaConfig
 from unionml_tpu.models.generate import make_generator
 from unionml_tpu.models.quantization import (
+    LLAMA_QUANT_PATTERNS,
     QuantizedDenseGeneral,
     quantize_params,
 )
-
-LLAMA_QUANT_PATTERNS = (r"attn/(q|k|v|o)$", r"mlp/(gate|up|down)$", r"lm_head$")
 
 
 def test_quantized_dense_matches_fp_geometry():

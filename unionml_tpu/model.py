@@ -797,6 +797,29 @@ class Model(TrackedInstance):
             **batcher_kwargs,
         )
 
+    def serve_gradio(self, **interface_kwargs):
+        """Launchable Gradio interface over the predictor
+        (reference parity: the mnist tutorial's Gradio integration,
+        docs/source/tutorials/mnist.md:37). Optional dependency — raises
+        with install guidance when gradio is absent.
+        """
+        try:
+            import gradio
+        except ImportError as e:
+            raise ImportError(
+                "model.serve_gradio() needs the optional gradio dependency: "
+                "pip install gradio"
+            ) from e
+        if self.artifact is None:
+            raise ValueError("no model artifact loaded — train or load first")
+
+        def fn(features):
+            return self.predict(features=features)
+
+        interface_kwargs.setdefault("inputs", "json")
+        interface_kwargs.setdefault("outputs", "json")
+        return gradio.Interface(fn=fn, **interface_kwargs)
+
     # ------------------------------------------------------------------ #
     # remote lifecycle (reference: model.py:625-917)
     # ------------------------------------------------------------------ #

@@ -23,7 +23,7 @@ from unionml_tpu.models.llama import (
 )
 from unionml_tpu.models.generate import make_generator, make_lm_predictor
 from unionml_tpu.models.mlp import Mlp, MlpConfig
-from unionml_tpu.models.quantization import QuantizedDenseGeneral, quantize_params
+from unionml_tpu.models.quantization import LLAMA_QUANT_PATTERNS, QuantizedDenseGeneral, quantize_params
 from unionml_tpu.models.train import (
     TrainState,
     adamw,
@@ -44,5 +44,5 @@ __all__ = [
     "TrainState", "create_train_state", "classification_step", "lm_step",
     "make_evaluator", "make_predictor",
     "make_generator", "make_lm_predictor", "adamw",
-    "QuantizedDenseGeneral", "quantize_params",
+    "QuantizedDenseGeneral", "quantize_params", "LLAMA_QUANT_PATTERNS",
 ]

@@ -84,8 +84,16 @@ def _quantize_kernel_2d(w2d: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return q, scale.astype(jnp.float32)
 
 
-def quantize_params(params: Any, patterns: Sequence[str] = (r".*",)) -> Any:
+LLAMA_QUANT_PATTERNS = (r"attn/(q|k|v|o)$", r"mlp/(gate|up|down)$", r"lm_head$")
+
+
+def quantize_params(params: Any, patterns: Sequence[str]) -> Any:
     """Convert fp dense kernels to the quantized param structure.
+
+    ``patterns`` is required (use :data:`LLAMA_QUANT_PATTERNS` for the
+    Llama zoo model): a catch-all would silently mis-split kernels whose
+    geometry this name-based dispatch doesn't know (e.g. BERT's
+    ``attn_o``, ViT's 4D patch-embed conv).
 
     Walks the tree; any dict holding a ``kernel`` whose path matches one
     of ``patterns`` becomes ``{"kernel_q": int8 [K, N], "scale": [N]}``.

@@ -68,9 +68,17 @@ def make_mesh(
 
     if dcn_axes:
         ici_shape = [axes[k] // dcn_axes.get(k, 1) for k in axes]
-        mesh_arr = mesh_utils.create_hybrid_device_mesh(
-            ici_shape, [dcn_axes.get(k, 1) for k in axes], devices=devices
-        )
+        if hasattr(devices[0], "slice_index"):
+            # real multi-slice hardware: topology-aware placement; config
+            # errors (wrong slice count, indivisible shapes) propagate
+            mesh_arr = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, [dcn_axes.get(k, 1) for k in axes], devices=devices
+            )
+        else:
+            # simulated/CPU devices carry no slice topology: plain reshape
+            # (collectives still correct; ICI/DCN placement only exists on
+            # hardware)
+            mesh_arr = np.asarray(devices).reshape(tuple(axes.values()))
         return Mesh(mesh_arr, tuple(axes))
 
     try:
