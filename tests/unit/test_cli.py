@@ -35,7 +35,7 @@ def test_init_tpu_template(runner, tmp_path, monkeypatch):
     assert "train_step" in (tmp_path / "tpu_app" / "app.py").read_text()
 
 
-@pytest.mark.parametrize("template", ["serverless", "vision_tpu"])
+@pytest.mark.parametrize("template", ["serverless", "vision_tpu", "llm_serving"])
 def test_init_new_templates_compile_and_register(runner, tmp_path, monkeypatch, template):
     monkeypatch.chdir(tmp_path)
     result = runner.invoke(app, ["init", "cv_app", "--template", template])

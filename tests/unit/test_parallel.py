@@ -56,6 +56,24 @@ def test_make_mesh_hybrid_dcn_axes():
     )
 
 
+def test_make_mesh_dcn_axes_validated():
+    with pytest.raises(ValueError, match="not a mesh axis"):
+        make_mesh({"data": 4, "tensor": 2}, dcn_axes={"dat": 2})
+    with pytest.raises(ValueError, match="must divide"):
+        make_mesh({"data": 4, "tensor": 2}, dcn_axes={"data": 3})
+
+
+def test_bert_attn_impl_validated():
+    from unionml_tpu.models import BertClassifier, BertConfig
+
+    model = BertClassifier(
+        BertConfig(**{**BertConfig.tiny().__dict__, "attn_impl": "nope"})
+    )
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="unknown attention impl"):
+        model.init(jax.random.PRNGKey(0), tokens)
+
+
 def test_serve_gradio_gated_without_dependency():
     from unionml_tpu import Dataset, Model
 

@@ -108,6 +108,32 @@ def test_quantized_generation_under_tensor_parallel():
     np.testing.assert_array_equal(got, ref)
 
 
+def test_quantized_params_checkpoint_roundtrip(tmp_path):
+    """Serving restart path: int8 params survive save/load bit-exactly."""
+    from unionml_tpu.checkpoint.pytree_io import load_pytree, save_pytree
+
+    cfg = LlamaConfig.tiny(vocab_size=97)
+    fp = Llama(cfg)
+    fp_params = fp.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    q_params = quantize_params(fp_params, LLAMA_QUANT_PATTERNS)
+
+    path = tmp_path / "m.utpu"
+    save_pytree(q_params, {"seed": 0}, path)
+
+    def factory(hp):
+        assert hp == {"seed": 0}
+        qm = Llama(LlamaConfig(**{**cfg.__dict__, "quantized": True}))
+        return qm.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    restored = load_pytree(path, factory)
+    for (pa, la), (pb, lb) in zip(
+        jax.tree_util.tree_flatten_with_path(q_params)[0],
+        jax.tree_util.tree_flatten_with_path(restored)[0],
+    ):
+        assert np.asarray(la).dtype == np.asarray(lb).dtype, pa
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
 def test_quantization_halves_param_bytes():
     cfg = LlamaConfig.tiny(vocab_size=97)
     fp = Llama(cfg)

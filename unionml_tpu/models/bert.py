@@ -30,6 +30,7 @@ class BertConfig:
     num_heads: int = 12
     mlp_dim: int = 3072
     num_classes: int = 2  # classification head width
+    attn_impl: str = "xla"  # "fused" only when attention_mask is None
     dtype: str = "bfloat16"
 
     @staticmethod
@@ -58,7 +59,18 @@ class BertBlock(nn.Module):
         q = dense((cfg.num_heads, head_dim), "attn_q")(x)
         k = dense((cfg.num_heads, head_dim), "attn_k")(x)
         v = dense((cfg.num_heads, head_dim), "attn_v")(x)
-        attn = mha_reference(q, k, v, bias=bias)
+        if bias is not None:
+            # only the XLA reference takes an additive mask bias (padded
+            # batches); other impls would silently ignore the padding
+            attn = mha_reference(q, k, v, bias=bias)
+        else:
+            # shared dispatcher: validates the impl name (unknown values
+            # raise instead of silently running the reference)
+            from unionml_tpu.models.layers import _run_attention
+
+            attn = _run_attention(
+                q, k, v, impl=cfg.attn_impl, causal=False, sequence_axis=None
+            )
         attn = nn.DenseGeneral(
             features=cfg.hidden_dim, axis=(-2, -1), dtype=dtype, name="attn_o"
         )(attn)

@@ -67,6 +67,15 @@ def make_mesh(
         )
 
     if dcn_axes:
+        # validate upfront so misconfigurations fail identically in
+        # simulation and on multi-slice hardware
+        for k, slices in dcn_axes.items():
+            if k not in axes:
+                raise ValueError(f"dcn_axes key {k!r} is not a mesh axis {tuple(axes)}")
+            if axes[k] % slices:
+                raise ValueError(
+                    f"dcn_axes[{k!r}]={slices} must divide axis size {axes[k]}"
+                )
         ici_shape = [axes[k] // dcn_axes.get(k, 1) for k in axes]
         if hasattr(devices[0], "slice_index"):
             # real multi-slice hardware: topology-aware placement; config

@@ -1,0 +1,91 @@
+"""{{app_name}}: LLM generation served as a unionml-tpu microservice.
+
+The fifth template: a Llama-family causal LM behind the standard
+Dataset/Model spec — prompts come in as token-id lists over HTTP, the
+predictor pads them into bucketed shapes and runs the jitted
+prefill + scan-decode generator (optionally int8-quantized for serving).
+
+Run:
+    python app.py                       # init + save (random weights demo)
+    unionml-tpu serve app:model --model-path model.utpu
+    curl -X POST localhost:8000/predict \
+         -d '{"features": [[1, 5, 9], [2, 4, 6, 8]]}'
+
+Swap ``LlamaConfig.tiny`` for ``LlamaConfig.llama3_8b()`` plus trained
+weights for the real thing; on a multi-chip slice shard the params with
+``LLAMA_QUANT_PARTITION_RULES`` over a ``tensor`` mesh axis.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from unionml_tpu import Dataset, Model
+from unionml_tpu.models import (
+    LLAMA_QUANT_PATTERNS,
+    Llama,
+    LlamaConfig,
+    make_lm_predictor,
+    quantize_params,
+)
+
+MAX_NEW_TOKENS = 32
+QUANTIZE = True  # int8 weight-only serving (~1.3-1.5x faster decode)
+
+config = LlamaConfig.tiny(vocab_size=512)
+module = Llama(config)
+serving_config = LlamaConfig(**{**config.__dict__, "quantized": True}) if QUANTIZE else config
+serving_module = Llama(serving_config)
+
+dataset = Dataset(name="{{app_name}}_dataset")
+
+
+@dataset.reader
+def reader() -> list:
+    # LMs have no training dataset here; the reader exists so the spec
+    # compiles (fine-tuning would read token corpora instead)
+    return [[1, 2, 3]]
+
+
+@dataset.feature_loader
+def feature_loader(raw: list) -> list:
+    # ragged token-id prompts stay lists; the predictor buckets/pads them
+    return raw
+
+
+model = Model(name="{{app_name}}", dataset=dataset)
+
+
+@model.init
+def init(hyperparameters: dict) -> dict:
+    params = jax.jit(module.init)(
+        jax.random.PRNGKey(hyperparameters.get("seed", 0)),
+        jnp.zeros((1, 8), jnp.int32),
+    )["params"]
+    if QUANTIZE:
+        params = quantize_params(params, LLAMA_QUANT_PATTERNS)
+    return params
+
+
+@model.trainer
+def trainer(params: dict, features: list, targets: list) -> dict:
+    # serving-only app: "training" materializes the (quantized) weights;
+    # see the basic_tpu template for a real train_step
+    return params
+
+
+_generate = make_lm_predictor(
+    serving_module, max_new_tokens=MAX_NEW_TOKENS, bucket_lens=(16, 32, 64, 128)
+)
+
+
+@model.predictor
+def predictor(params: dict, prompts: list) -> list:
+    return _generate(params, prompts)
+
+
+if __name__ == "__main__":
+    params, _ = model.train()
+    out = model.predict(features=[[1, 5, 9], [2, 4, 6, 8]])
+    print(f"generated: {np.asarray(out).shape}")
+    model.save("model.utpu")
