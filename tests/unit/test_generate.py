@@ -158,6 +158,29 @@ def test_generation_under_tensor_parallel_sharding(tiny_llama):
     np.testing.assert_array_equal(got, ref)
 
 
+def test_remat_gradients_match_non_remat(tiny_llama):
+    """remat recomputes, never changes math: grads must be identical."""
+    module, params = tiny_llama
+    cfg = module.config
+    rm = Llama(LlamaConfig(**{**cfg.__dict__, "remat": True}))
+    tokens = jnp.asarray(
+        np.random.default_rng(2).integers(1, 97, size=(2, 12)), jnp.int32
+    )
+
+    def loss(m):
+        def f(p):
+            logits = m.apply({"params": p}, tokens)
+            return jnp.mean(logits.astype(jnp.float32) ** 2)
+        return f
+
+    g_plain = jax.grad(loss(module))(params)
+    g_remat = jax.grad(loss(rm))(params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_plain), jax.tree_util.tree_leaves(g_remat)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
 def test_lm_predictor_ragged_prompts(tiny_llama):
     module, params = tiny_llama
 

@@ -61,6 +61,8 @@ def test_make_mesh_dcn_axes_validated():
         make_mesh({"data": 4, "tensor": 2}, dcn_axes={"dat": 2})
     with pytest.raises(ValueError, match="must divide"):
         make_mesh({"data": 4, "tensor": 2}, dcn_axes={"data": 3})
+    with pytest.raises(ValueError, match="must divide"):
+        make_mesh({"data": 4, "tensor": 2}, dcn_axes={"data": 0})
 
 
 def test_bert_attn_impl_validated():
@@ -72,6 +74,13 @@ def test_bert_attn_impl_validated():
     tokens = jnp.zeros((1, 8), jnp.int32)
     with pytest.raises(ValueError, match="unknown attention impl"):
         model.init(jax.random.PRNGKey(0), tokens)
+    # the padded-batch (bias) path must validate too, not silently fall
+    # back to the reference kernel
+    with pytest.raises(ValueError, match="unknown attention impl"):
+        model.init(
+            jax.random.PRNGKey(0), tokens,
+            attention_mask=jnp.ones((1, 8), jnp.int32),
+        )
 
 
 def test_serve_gradio_gated_without_dependency():

@@ -59,13 +59,16 @@ class BertBlock(nn.Module):
         q = dense((cfg.num_heads, head_dim), "attn_q")(x)
         k = dense((cfg.num_heads, head_dim), "attn_k")(x)
         v = dense((cfg.num_heads, head_dim), "attn_v")(x)
+        if cfg.attn_impl not in ("xla", "fused", "flash", "blockwise"):
+            raise ValueError(
+                f"unknown attention impl {cfg.attn_impl!r}; "
+                "use xla|fused|flash|blockwise"
+            )
         if bias is not None:
             # only the XLA reference takes an additive mask bias (padded
             # batches); other impls would silently ignore the padding
             attn = mha_reference(q, k, v, bias=bias)
         else:
-            # shared dispatcher: validates the impl name (unknown values
-            # raise instead of silently running the reference)
             from unionml_tpu.models.layers import _run_attention
 
             attn = _run_attention(

@@ -43,6 +43,7 @@ class LlamaConfig:
     attn_impl: str = "xla"
     sequence_axis: Optional[str] = None
     quantized: bool = False  # int8 weight-only matmuls (serving path)
+    remat: bool = False  # gradient checkpointing per block (long-context training)
     dtype: str = "bfloat16"
 
     @staticmethod
@@ -130,9 +131,17 @@ class Llama(nn.Module):
         if positions is None and cache_index is not None:
             positions = cache_index + jnp.arange(tokens.shape[1])[None, :]
         new_cache = []
+        # remat: recompute block activations in the backward instead of
+        # storing them — O(sqrt)-style memory for long-context training.
+        # Decode (cache path) never remats: there is no backward.
+        block_cls = (
+            nn.remat(LlamaBlock, static_argnums=())
+            if cfg.remat and cache is None
+            else LlamaBlock
+        )
         for i in range(cfg.num_layers):
             layer_cache = cache[i] if cache is not None else None
-            x, c = LlamaBlock(cfg, name=f"block_{i}")(
+            x, c = block_cls(cfg, name=f"block_{i}")(
                 x, positions=positions, cache=layer_cache, cache_index=cache_index,
                 kv_mask=kv_mask,
             )

@@ -72,7 +72,7 @@ def make_mesh(
         for k, slices in dcn_axes.items():
             if k not in axes:
                 raise ValueError(f"dcn_axes key {k!r} is not a mesh axis {tuple(axes)}")
-            if axes[k] % slices:
+            if slices <= 0 or axes[k] % slices:
                 raise ValueError(
                     f"dcn_axes[{k!r}]={slices} must divide axis size {axes[k]}"
                 )
