@@ -3,6 +3,8 @@ step-free full-recompute decoding, and left-padded prompts must generate
 exactly what their unpadded versions do (pad masking + logical RoPE
 positions)."""
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -162,7 +164,7 @@ def test_remat_gradients_match_non_remat(tiny_llama):
     """remat recomputes, never changes math: grads must be identical."""
     module, params = tiny_llama
     cfg = module.config
-    rm = Llama(LlamaConfig(**{**cfg.__dict__, "remat": True}))
+    rm = Llama(dataclasses.replace(cfg, remat=True))
     tokens = jnp.asarray(
         np.random.default_rng(2).integers(1, 97, size=(2, 12)), jnp.int32
     )

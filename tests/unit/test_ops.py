@@ -114,6 +114,32 @@ def test_fused_rejects_unequal_lengths():
         fused_attention(q[:, :16], k, v)
 
 
+def test_flash_causal_cross_length_bottom_right_aligned():
+    """Decode convention: with q_len < kv_len the queries are the LAST
+    q_len positions — flash must match the reference's alignment in both
+    forward and gradients."""
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (2, 8, 4, 16))
+    k = jax.random.normal(ks[1], (2, 40, 4, 16))
+    v = jax.random.normal(ks[2], (2, 40, 4, 16))
+    ref = mha_reference(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_kv=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(mha_reference(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_flash = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=True, block_q=16, block_kv=16) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_ref, g_flash):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
+
+
 def test_flash_gradients_gqa_cross_length():
     # KV prefix longer than q (decode-style): GQA group-sum must reshape
     # with kv_len, not q_len
@@ -214,11 +240,30 @@ def test_ulysses_matches_reference(causal):
 
 def test_attention_dispatcher():
     q, k, v = make_qkv(seq=32)
-    for impl in ("xla", "blockwise", "flash"):
+    for impl in ("xla", "blockwise", "flash", "fused", "auto"):
         out = attention(q, k, v, impl=impl, causal=True)
         assert out.shape == q.shape
     with pytest.raises(ValueError, match="unknown attention impl"):
         attention(q, k, v, impl="nope")
+
+
+def test_attention_auto_routes_by_length():
+    # short → fused; long or cross-length → flash (both numerically checked
+    # against the reference elsewhere; here we check the routing decision
+    # by matching each candidate's output exactly)
+    from unionml_tpu.ops.flash_attention import flash_attention
+    from unionml_tpu.ops.fused_attention import fused_attention
+
+    q, k, v = make_qkv(seq=48, dim=16)
+    np.testing.assert_array_equal(
+        np.asarray(attention(q, k, v, impl="auto")),
+        np.asarray(fused_attention(q, k, v)),
+    )
+    ql, kl, vl = make_qkv(batch=1, seq=1056, q_heads=2, kv_heads=2, dim=16)
+    np.testing.assert_array_equal(
+        np.asarray(attention(ql, kl, vl, impl="auto")),
+        np.asarray(flash_attention(ql, kl, vl)),
+    )
 
 
 # ------------------------------------------------------------------ MoE

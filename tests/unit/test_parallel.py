@@ -2,6 +2,8 @@
 
 import jax
 import jax.numpy as jnp
+import dataclasses
+
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
@@ -69,7 +71,7 @@ def test_bert_attn_impl_validated():
     from unionml_tpu.models import BertClassifier, BertConfig
 
     model = BertClassifier(
-        BertConfig(**{**BertConfig.tiny().__dict__, "attn_impl": "nope"})
+        dataclasses.replace(BertConfig.tiny(), attn_impl="nope")
     )
     tokens = jnp.zeros((1, 8), jnp.int32)
     with pytest.raises(ValueError, match="unknown attention impl"):

@@ -1,6 +1,8 @@
 """Weight-only int8 serving quantization: the quantized model must load
 converted fp weights and generate nearly the same tokens."""
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -34,7 +36,7 @@ def test_quantized_dense_matches_fp_geometry():
 def test_quantize_params_structure_matches_quantized_module():
     cfg = LlamaConfig.tiny(vocab_size=97)
     fp = Llama(cfg)
-    qm = Llama(LlamaConfig(**{**cfg.__dict__, "quantized": True}))
+    qm = Llama(dataclasses.replace(cfg, quantized=True))
     tokens = jnp.zeros((1, 8), jnp.int32)
     fp_params = fp.init(jax.random.PRNGKey(0), tokens)["params"]
     q_template = qm.init(jax.random.PRNGKey(0), tokens)["params"]
@@ -58,7 +60,7 @@ def test_quantized_generation_close_to_fp():
     )
     fp_params = fp.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
     q_params = quantize_params(fp_params, LLAMA_QUANT_PATTERNS)
-    qm = Llama(LlamaConfig(**{**cfg.__dict__, "quantized": True}))
+    qm = Llama(dataclasses.replace(cfg, quantized=True))
 
     # logits agree closely (int8 per-channel weight-only error)
     lf = fp.apply({"params": fp_params}, tokens)
@@ -89,7 +91,7 @@ def test_quantized_generation_under_tensor_parallel():
     fp = Llama(cfg)
     fp_params = fp.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
     q_params = quantize_params(fp_params, LLAMA_QUANT_PATTERNS)
-    qm = Llama(LlamaConfig(**{**cfg.__dict__, "quantized": True}))
+    qm = Llama(dataclasses.replace(cfg, quantized=True))
 
     prompt = jnp.asarray([[7, 3, 9, 2]], jnp.int32)
     gen = make_generator(qm, max_new_tokens=4, max_len=32)
@@ -122,7 +124,7 @@ def test_quantized_params_checkpoint_roundtrip(tmp_path):
 
     def factory(hp):
         assert hp == {"seed": 0}
-        qm = Llama(LlamaConfig(**{**cfg.__dict__, "quantized": True}))
+        qm = Llama(dataclasses.replace(cfg, quantized=True))
         return qm.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
 
     restored = load_pytree(path, factory)

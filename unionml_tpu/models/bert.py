@@ -59,10 +59,14 @@ class BertBlock(nn.Module):
         q = dense((cfg.num_heads, head_dim), "attn_q")(x)
         k = dense((cfg.num_heads, head_dim), "attn_k")(x)
         v = dense((cfg.num_heads, head_dim), "attn_v")(x)
-        if cfg.attn_impl not in ("xla", "fused", "flash", "blockwise"):
+        from unionml_tpu.models.layers import ATTN_IMPLS
+
+        # BERT has no sequence mesh axis: the sequence-parallel impls can
+        # never work here
+        supported = tuple(i for i in ATTN_IMPLS if i not in ("ring", "ulysses"))
+        if cfg.attn_impl not in supported:
             raise ValueError(
-                f"unknown attention impl {cfg.attn_impl!r}; "
-                "use xla|fused|flash|blockwise"
+                f"unknown attention impl {cfg.attn_impl!r}; use one of {supported}"
             )
         if bias is not None:
             # only the XLA reference takes an additive mask bias (padded

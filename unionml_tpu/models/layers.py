@@ -91,6 +91,11 @@ def rotary_embedding(
     return out.astype(x.dtype)
 
 
+# the dispatcher's accepted impl names — validate against this instead of
+# maintaining per-model copies
+ATTN_IMPLS = ("auto", "xla", "blockwise", "flash", "fused", "ring", "ulysses")
+
+
 def _run_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -100,7 +105,19 @@ def _run_attention(
     causal: bool,
     sequence_axis: Optional[str],
 ) -> jnp.ndarray:
-    """Dispatch (batch, seq, heads, head_dim) tensors to an attention op."""
+    """Dispatch (batch, seq, heads, head_dim) tensors to an attention op.
+
+    ``"auto"`` picks fused below the measured short-seq crossover (equal
+    q/kv lengths only), flash above it.
+    """
+    if impl == "auto":
+        from unionml_tpu.ops.fused_attention import MAX_FUSED_SEQ
+
+        impl = (
+            "fused"
+            if q.shape[1] <= MAX_FUSED_SEQ and k.shape[1] == q.shape[1]
+            else "flash"
+        )
     if impl == "xla":
         return xla_attention(q, k, v, causal=causal)
     if impl == "blockwise":

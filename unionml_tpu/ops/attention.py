@@ -203,8 +203,19 @@ def attention(
 
     impl: ``"xla"`` (full scores), ``"blockwise"`` (O(S·block) memory),
     ``"flash"`` (Pallas TPU kernel, long sequences), ``"fused"`` (Pallas
-    one-program-per-batch kernel, fastest for short sequences).
+    one-program-per-batch kernel, fastest for short sequences), or
+    ``"auto"`` — fused up to the measured v5e crossover (~1k tokens,
+    where the single-tile score matrix stops fitting VMEM comfortably),
+    flash beyond it.
     """
+    if impl == "auto":
+        from unionml_tpu.ops.fused_attention import MAX_FUSED_SEQ
+
+        impl = (
+            "fused"
+            if q.shape[1] <= MAX_FUSED_SEQ and k.shape[1] == q.shape[1]
+            else "flash"
+        )
     if impl == "xla":
         return mha_reference(q, k, v, causal=causal, **kwargs)
     if impl == "blockwise":
@@ -217,4 +228,6 @@ def attention(
         from unionml_tpu.ops.fused_attention import fused_attention
 
         return fused_attention(q, k, v, causal=causal, **kwargs)
-    raise ValueError(f"unknown attention impl {impl!r}; use xla|blockwise|flash|fused")
+    raise ValueError(
+        f"unknown attention impl {impl!r}; use auto|xla|blockwise|flash|fused"
+    )

@@ -39,12 +39,18 @@ NEG_INF = -1e30
 
 
 def _tile_masks(q_start, kv_start, block_q, block_kv, q_len, kv_len, causal):
-    """Validity (+ causal) mask for one [BQ, BKV] score tile."""
+    """Validity (+ causal) mask for one [BQ, BKV] score tile.
+
+    Causal alignment is bottom-right (the KV-cache decode convention,
+    matching ``mha_reference``): with q_len < kv_len the queries are the
+    LAST q_len positions, so query i sits at global position
+    ``i + (kv_len - q_len)``.
+    """
     q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
     kv_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
     mask = jnp.logical_and(q_pos < q_len, kv_pos < kv_len)
     if causal:
-        mask = jnp.logical_and(mask, q_pos >= kv_pos)
+        mask = jnp.logical_and(mask, q_pos + (kv_len - q_len) >= kv_pos)
     return mask
 
 
@@ -62,8 +68,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
     q_start = qi * block_q
     kv_start = ki * block_kv
     # causal: skip kv blocks entirely in the future of this q block
+    # bottom-right causal: query block's last GLOBAL position is
+    # q_start + block_q - 1 + (kv_len - q_len)
     run = jnp.logical_or(
-        jnp.logical_not(causal), kv_start <= q_start + block_q - 1
+        jnp.logical_not(causal),
+        kv_start <= q_start + block_q - 1 + (kv_len - q_len),
     )
 
     @pl.when(run)
@@ -164,8 +173,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, 
 
     q_start = qi * block_q
     kv_start = ki * block_kv
+    # bottom-right causal: query block's last GLOBAL position is
+    # q_start + block_q - 1 + (kv_len - q_len)
     run = jnp.logical_or(
-        jnp.logical_not(causal), kv_start <= q_start + block_q - 1
+        jnp.logical_not(causal),
+        kv_start <= q_start + block_q - 1 + (kv_len - q_len),
     )
 
     @pl.when(run)
@@ -213,7 +225,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     q_start = qi * block_q
     kv_start = ki * block_kv
     run = jnp.logical_or(
-        jnp.logical_not(causal), q_start + block_q - 1 >= kv_start
+        jnp.logical_not(causal),
+        q_start + block_q - 1 + (kv_len - q_len) >= kv_start,
     )
 
     @pl.when(run)

@@ -16,6 +16,8 @@ weights for the real thing; on a multi-chip slice shard the params with
 ``LLAMA_QUANT_PARTITION_RULES`` over a ``tensor`` mesh axis.
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -34,7 +36,7 @@ QUANTIZE = True  # int8 weight-only serving (~1.3-1.5x faster decode)
 
 config = LlamaConfig.tiny(vocab_size=512)
 module = Llama(config)
-serving_config = LlamaConfig(**{**config.__dict__, "quantized": True}) if QUANTIZE else config
+serving_config = dataclasses.replace(config, quantized=True) if QUANTIZE else config
 serving_module = Llama(serving_config)
 
 dataset = Dataset(name="{{app_name}}_dataset")
