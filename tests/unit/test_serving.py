@@ -80,6 +80,26 @@ def test_model_path_env_loading(trained_model, tmp_path, monkeypatch, dataset):
 # ------------------------------------------------------------------ batcher
 
 
+def test_fastapi_transport_parity(trained_model):
+    """The FastAPI adapter must serve the same routes/payloads as the
+    stdlib transport (reference: unionml/fastapi.py is the primary
+    serving surface)."""
+    fastapi = pytest.importorskip("fastapi")
+    from fastapi.testclient import TestClient
+
+    app = fastapi.FastAPI()
+    trained_model.serve(app)
+    with TestClient(app) as client:
+        assert client.get("/health").json()["model_loaded"] is True
+        root = client.get("/")
+        assert root.status_code == 200 and "unionml" in root.text.lower()
+        r = client.post("/predict", json={"features": [[0.1, 0.2], [1.5, -0.3]]})
+        assert r.status_code == 200 and len(r.json()) == 2
+        # same status the stdlib transport asserts for the identical payload
+        bad = client.post("/predict", json={"features": [[0.1, 0.2]], "inputs": {}})
+        assert bad.status_code == 422
+
+
 def test_microbatcher_coalesces_requests():
     calls = []
 
