@@ -19,9 +19,25 @@ def runner():
 
 def test_init_scaffolds_template(runner, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
+    # bytecode caches appear in templates/ whenever a template app is
+    # imported; the scaffolder must skip them (regression: compileall
+    # broke init with a UnicodeDecodeError). Work on a copy so the
+    # installed package dir is never mutated.
+    import shutil
+
+    import unionml_tpu.cli as cli_mod
+
+    templates_copy = tmp_path / "templates"
+    shutil.copytree(cli_mod.TEMPLATES_DIR, templates_copy)
+    pycache = templates_copy / "basic" / "__pycache__"
+    pycache.mkdir()
+    (pycache / "app.cpython-312.pyc").write_bytes(b"\xcb\r\r\n\x00binary")
+    monkeypatch.setattr(cli_mod, "TEMPLATES_DIR", templates_copy)
+
     result = runner.invoke(app, ["init", "my_app"])
     assert result.exit_code == 0, result.output
     assert (tmp_path / "my_app" / "app.py").exists()
+    assert not (tmp_path / "my_app" / "__pycache__").exists()
     content = (tmp_path / "my_app" / "app.py").read_text()
     assert "my_app" in content and "{{app_name}}" not in content
     # post-gen git init ran

@@ -45,12 +45,22 @@ def init(app_name: str, template: str):
         raise click.ClickException(f"directory {dest} already exists")
     dest.mkdir(parents=True)
     for f in sorted(src.rglob("*")):
-        if f.is_dir():
+        if f.is_dir() or "__pycache__" in f.parts:
+            # bytecode caches appear whenever a template app gets imported
+            # (tests, compileall) and must never reach the scaffold
             continue
         rel = Path(str(f.relative_to(src)).replace("{{app_name}}", app_name))
         target = dest / rel
         target.parent.mkdir(parents=True, exist_ok=True)
-        target.write_text(f.read_text().replace("{{app_name}}", app_name))
+        try:
+            # explicit utf-8: with the locale default, a non-ASCII TEXT
+            # template could decode-fail and skip {{app_name}} substitution
+            target.write_text(
+                f.read_text(encoding="utf-8").replace("{{app_name}}", app_name),
+                encoding="utf-8",
+            )
+        except UnicodeDecodeError:
+            target.write_bytes(f.read_bytes())  # binary assets copy verbatim
     # post-gen: git init + initial commit (reference: post_gen_project.py)
     try:
         quiet = {"capture_output": True, "cwd": dest}
