@@ -1,0 +1,201 @@
+"""MoE dispatch + expert-parallel tests on the CPU-simulated mesh.
+
+Strategy (SURVEY.md §4.3): the explicit all_to_all shard_map path is
+checked numerically (values AND gradients) against a dense per-token
+reference; the GSPMD path is checked by compiling a full MoE-Llama train
+step with the `expert` mesh axis.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from unionml_tpu.models import (
+    LLAMA_MOE_PARTITION_RULES,
+    Llama,
+    LlamaConfig,
+    create_train_state,
+    lm_step,
+    make_generator,
+)
+from unionml_tpu.ops.moe import (
+    MoEMlp,
+    expert_parallel_moe,
+    make_dispatch,
+    top_k_routing,
+)
+from unionml_tpu.parallel import ShardingConfig, compile_step, make_mesh
+
+
+def _dense_moe_reference(x, router_kernel, w_gate, w_up, w_down, num_selected):
+    """Per-token loop-free dense reference: every routed token processed."""
+    gate_logits = (x @ router_kernel).astype(jnp.float32)
+    weights, indices, aux = top_k_routing(gate_logits, num_selected)
+    num_experts = w_gate.shape[0]
+    onehot = jax.nn.one_hot(indices, num_experts, dtype=x.dtype)  # [T,k,E]
+    combine = jnp.einsum("tke,tk->te", onehot, weights.astype(x.dtype))
+    gated = jax.nn.silu(jnp.einsum("td,edh->eth", x, w_gate))
+    up = jnp.einsum("td,edh->eth", x, w_up)
+    expert_out = jnp.einsum("eth,ehd->etd", gated * up, w_down)
+    return jnp.einsum("etd,te->td", expert_out, combine), aux
+
+
+def _moe_weights(tokens=32, d=16, hidden=32, experts=8, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 5)
+    x = jax.random.normal(ks[0], (tokens, d))
+    router = jax.random.normal(ks[1], (d, experts)) * 0.5
+    w_gate = jax.random.normal(ks[2], (experts, d, hidden)) * (d**-0.5)
+    w_up = jax.random.normal(ks[3], (experts, d, hidden)) * (d**-0.5)
+    w_down = jax.random.normal(ks[4], (experts, hidden, d)) * (hidden**-0.5)
+    return x, router, w_gate, w_up, w_down
+
+
+def test_make_dispatch_respects_capacity():
+    gate_logits = jax.random.normal(jax.random.PRNGKey(0), (64, 4))
+    dispatch, combine, _ = make_dispatch(gate_logits, num_selected=2, capacity=5)
+    # each expert bucket holds at most `capacity` tokens, one per slot
+    assert float(dispatch.sum(axis=(0, 2)).max()) <= 5
+    assert float(dispatch.max()) <= 1.0
+    # every slot holds at most one token
+    assert float(dispatch.sum(axis=0).max()) <= 1.0
+    # combine weight lives exactly where dispatch does
+    assert float(jnp.abs(combine * (1 - dispatch)).max()) == 0.0
+
+
+def test_make_dispatch_first_choices_win_slots():
+    # 3 tokens all routing expert 0 first; capacity 2 drops the last token's
+    # first choice but keeps all second choices on expert 1
+    gate_logits = jnp.array(
+        [[5.0, 1.0, -5.0], [5.0, 1.0, -5.0], [5.0, 1.0, -5.0]], jnp.float32
+    )
+    dispatch, _, _ = make_dispatch(gate_logits, num_selected=2, capacity=2)
+    per_expert = np.asarray(dispatch.sum(axis=2))  # [T, E]
+    # tokens 0 and 1 won expert 0's two slots; token 2's 1st choice dropped
+    np.testing.assert_array_equal(per_expert[:, 0], [1, 1, 0])
+    # 2nd choices (expert 1) bucket after all 1st choices: tokens 0, 1 fit
+    np.testing.assert_array_equal(per_expert[:, 1], [1, 1, 0])
+
+
+@pytest.mark.parametrize("ep", [2, 4])
+def test_expert_parallel_matches_dense(ep):
+    x, router, w_gate, w_up, w_down = _moe_weights()
+    mesh = make_mesh({"expert": ep}, devices=jax.devices()[:ep])
+    ref, aux_ref = _dense_moe_reference(x, router, w_gate, w_up, w_down, 2)
+    # capacity = local token count: nothing can overflow, outputs must match
+    out, aux = expert_parallel_moe(
+        x, router, w_gate, w_up, w_down, mesh,
+        num_selected=2, capacity=x.shape[0] // ep,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # aux loss: per-shard mean of per-shard top-1 fractions != global aux in
+    # general, but both are O(1) balance stats — just require finiteness
+    assert np.isfinite(float(aux))
+
+
+def test_expert_parallel_gradients_match_dense():
+    x, router, w_gate, w_up, w_down = _moe_weights(tokens=16, experts=4)
+    mesh = make_mesh({"expert": 2}, devices=jax.devices()[:2])
+
+    def loss_ep(x, w_gate, w_down):
+        out, _ = expert_parallel_moe(
+            x, router, w_gate, w_up, w_down, mesh,
+            num_selected=2, capacity=x.shape[0] // 2,
+        )
+        return jnp.sum(out**2)
+
+    def loss_ref(x, w_gate, w_down):
+        out, _ = _dense_moe_reference(x, router, w_gate, w_up, w_down, 2)
+        return jnp.sum(out**2)
+
+    g_ep = jax.grad(loss_ep, argnums=(0, 1, 2))(x, w_gate, w_down)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(x, w_gate, w_down)
+    for a, b in zip(g_ep, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_expert_parallel_capacity_drops_tokens():
+    # capacity 1 per expert: overflow tokens lose (part of) their MLP
+    # contribution, so the output must differ from the uncapped reference
+    x, router, w_gate, w_up, w_down = _moe_weights(tokens=32, experts=2)
+    mesh = make_mesh({"expert": 2}, devices=jax.devices()[:2])
+    ref, _ = _dense_moe_reference(x, router, w_gate, w_up, w_down, 1)
+    out, _ = expert_parallel_moe(
+        x, router, w_gate, w_up, w_down, mesh, num_selected=1, capacity=1
+    )
+    assert not np.allclose(np.asarray(out), np.asarray(ref))
+
+
+def test_moe_mlp_module_dense_path():
+    module = MoEMlp(
+        num_experts=4, num_selected=2, hidden_dim=32, model_dim=16,
+        dtype=jnp.float32,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+    params = module.init(jax.random.PRNGKey(1), x)["params"]
+    out, aux = module.apply({"params": params}, x)
+    assert out.shape == x.shape
+    assert np.isfinite(float(aux))
+    # routed MLP must actually transform the input
+    assert not np.allclose(np.asarray(out), np.asarray(x))
+
+
+def test_moe_llama_train_step_loss_decreases():
+    cfg = LlamaConfig.tiny(vocab_size=64, num_experts=4, num_selected=2)
+    module = Llama(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, 64)
+    state = create_train_state(module, tokens[:1], learning_rate=1e-2)
+    step = jax.jit(lm_step(module))
+    _, first = step(state, tokens)
+    for _ in range(10):
+        state, metrics = step(state, tokens)
+    assert float(metrics["loss"]) < float(first["loss"])
+    assert np.isfinite(float(metrics["aux_loss"])) and float(metrics["aux_loss"]) > 0
+
+
+def test_dense_llama_aux_loss_metric_is_zero():
+    cfg = LlamaConfig.tiny(vocab_size=64)
+    module = Llama(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 8), 0, 64)
+    state = create_train_state(module, tokens[:1])
+    _, metrics = jax.jit(lm_step(module))(state, tokens)
+    assert float(metrics["aux_loss"]) == 0.0
+
+
+def test_moe_llama_expert_parallel_gspmd_step():
+    # full train step over a data x expert x tensor mesh: expert weights
+    # shard over `expert` per LLAMA_MOE_PARTITION_RULES, GSPMD inserts the
+    # dispatch collectives
+    cfg = LlamaConfig.tiny(vocab_size=64, num_experts=4, num_selected=2)
+    module = Llama(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, 64)
+    state = create_train_state(module, tokens[:1], learning_rate=1e-2)
+    sharding = ShardingConfig(
+        data=-1, expert=2, tensor=2, rules=LLAMA_MOE_PARTITION_RULES
+    )
+    step, state = compile_step(lm_step(module), state, sharding=sharding)
+    # expert dim actually sharded on the mesh
+    moe_shard = state.params["block_0"]["moe"]["w_gate"].sharding
+    assert "expert" in moe_shard.spec
+    state, metrics = step(state, tokens)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_moe_config_validation():
+    with pytest.raises(ValueError, match="num_selected"):
+        LlamaConfig.tiny(num_experts=1)  # default num_selected=2 > experts
+    with pytest.raises(ValueError, match="num_selected"):
+        LlamaConfig.tiny(num_experts=4, num_selected=0)
+    with pytest.raises(NotImplementedError, match="quantization"):
+        LlamaConfig.tiny(num_experts=4, quantized=True)
+
+
+def test_moe_llama_generation():
+    cfg = LlamaConfig.tiny(vocab_size=64, num_experts=4, num_selected=2)
+    module = Llama(cfg)
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), tokens)["params"]
+    generate = make_generator(module, max_new_tokens=4)
+    out = generate(params, jnp.asarray([[1, 2, 3, 4]], jnp.int32))
+    assert out.shape == (1, 4)
+    assert np.isfinite(np.asarray(out)).all()

@@ -15,6 +15,7 @@ from unionml_tpu.models.bert import (
     BertMlm,
 )
 from unionml_tpu.models.llama import (
+    LLAMA_MOE_PARTITION_RULES,
     LLAMA_PARTITION_RULES,
     LLAMA_QUANT_PARTITION_RULES,
     Llama,
@@ -40,7 +41,7 @@ __all__ = [
     "ViT", "ViTConfig", "VIT_PARTITION_RULES",
     "BertEncoder", "BertClassifier", "BertMlm", "BertConfig", "BERT_PARTITION_RULES",
     "Llama", "LlamaConfig", "init_cache", "LLAMA_PARTITION_RULES",
-    "LLAMA_QUANT_PARTITION_RULES",
+    "LLAMA_QUANT_PARTITION_RULES", "LLAMA_MOE_PARTITION_RULES",
     "TrainState", "create_train_state", "classification_step", "lm_step",
     "make_evaluator", "make_predictor",
     "make_generator", "make_lm_predictor", "adamw",

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from unionml_tpu.models.layers import Attention, MlpBlock, RMSNorm, make_dense
+from unionml_tpu.ops.moe import MoEMlp
 from unionml_tpu.parallel.sharding import PartitionRule
 
 Cache = Tuple[Tuple[jnp.ndarray, jnp.ndarray], ...]  # per-layer (k, v)
@@ -44,18 +45,46 @@ class LlamaConfig:
     sequence_axis: Optional[str] = None
     quantized: bool = False  # int8 weight-only matmuls (serving path)
     remat: bool = False  # gradient checkpointing per block (long-context training)
+    # mixture-of-experts MLPs (0 = dense). Experts shard over the mesh's
+    # `expert` axis via LLAMA_MOE_PARTITION_RULES; GSPMD inserts the
+    # dispatch collectives (see ops/moe.py for the explicit all_to_all op).
+    num_experts: int = 0
+    num_selected: int = 2
     dtype: str = "bfloat16"
+
+    def __post_init__(self):
+        if self.num_experts:
+            if not 1 <= self.num_selected <= self.num_experts:
+                raise ValueError(
+                    f"num_selected={self.num_selected} must be in "
+                    f"[1, num_experts={self.num_experts}]"
+                )
+            if self.quantized:
+                raise NotImplementedError(
+                    "int8 weight-only quantization does not cover MoE experts yet"
+                )
 
     @staticmethod
     def llama3_8b() -> "LlamaConfig":
         return LlamaConfig()
 
     @staticmethod
-    def tiny(vocab_size: int = 512) -> "LlamaConfig":
+    def mixtral_8x7b() -> "LlamaConfig":
+        """Mixtral-8x7B geometry: Llama blocks + 8-expert top-2 MoE MLPs."""
         return LlamaConfig(
+            vocab_size=32_000, hidden_dim=4096, num_layers=32, num_heads=32,
+            num_kv_heads=8, mlp_dim=14_336, rope_theta=1e6, max_len=32_768,
+            num_experts=8, num_selected=2,
+        )
+
+    @staticmethod
+    def tiny(vocab_size: int = 512, **overrides) -> "LlamaConfig":
+        kwargs = dict(
             vocab_size=vocab_size, hidden_dim=64, num_layers=2, num_heads=4,
             num_kv_heads=2, mlp_dim=128, max_len=256, rope_theta=10_000.0,
         )
+        kwargs.update(overrides)
+        return LlamaConfig(**kwargs)
 
     @property
     def head_dim(self) -> int:
@@ -100,10 +129,21 @@ class LlamaBlock(nn.Module):
             a, new_cache = attn(h, positions=positions), None
         x = x + a
         h = RMSNorm(dtype=dtype, name="mlp_norm")(x)
-        x = x + MlpBlock(
-            hidden_dim=cfg.mlp_dim, gated=True, quantized=cfg.quantized,
-            dtype=dtype, name="mlp",
-        )(h)
+        if cfg.num_experts:
+            mlp_out, aux = MoEMlp(
+                num_experts=cfg.num_experts, num_selected=cfg.num_selected,
+                hidden_dim=cfg.mlp_dim, model_dim=cfg.hidden_dim,
+                dtype=dtype, name="moe",
+            )(h)
+            # collected by lm_step via mutable=["aux_losses"] and added to
+            # the CE loss with a load-balancing weight
+            self.sow("aux_losses", "moe_load_balance", aux)
+            x = x + mlp_out
+        else:
+            x = x + MlpBlock(
+                hidden_dim=cfg.mlp_dim, gated=True, quantized=cfg.quantized,
+                dtype=dtype, name="mlp",
+            )(h)
         return x, new_cache
 
 
@@ -175,6 +215,16 @@ LLAMA_PARTITION_RULES = (
     PartitionRule(r"embed/embedding$", ("tensor", None)),
     PartitionRule(r"lm_head/kernel$", (None, "tensor")),
 )
+
+# MoE configs (num_experts > 0): expert weights [E, d, h] shard E over the
+# `expert` mesh axis (GSPMD turns the one-hot dispatch einsums into
+# all_to_all on that axis) and the hidden dim over `tensor`; the router is
+# replicated — it is tiny and every device routes its own tokens.
+LLAMA_MOE_PARTITION_RULES = (
+    PartitionRule(r"moe/w_(gate|up)$", ("expert", None, "tensor")),
+    PartitionRule(r"moe/w_down$", ("expert", "tensor", None)),
+    PartitionRule(r"moe/router_kernel$", (None,)),
+) + LLAMA_PARTITION_RULES
 
 # int8 serving (LlamaConfig.quantized=True): kernels are 2D [K, N] with a
 # per-output-channel scale [N]. Megatron layout carries over: qkv/gate/up/

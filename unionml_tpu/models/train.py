@@ -90,11 +90,18 @@ def classification_step(module: nn.Module) -> Callable:
     return step
 
 
-def lm_step(module: nn.Module, *, ignore_id: int = -100) -> Callable:
+def lm_step(
+    module: nn.Module, *, ignore_id: int = -100, aux_loss_weight: float = 0.01
+) -> Callable:
     """Next-token LM step: batch is token ids [B, S]; loss over shifted pairs.
 
     Also accepts ``(tokens, labels)`` for masked-LM/fine-tune batches where
     labels carry ``ignore_id`` at unsupervised positions.
+
+    MoE modules sow per-layer load-balancing losses into the
+    ``aux_losses`` collection (ops/moe.py); their layer-mean is added to
+    the CE loss scaled by ``aux_loss_weight`` and reported as the
+    ``aux_loss`` metric (0 for dense models).
     """
 
     def step(state: TrainState, batch):
@@ -105,16 +112,27 @@ def lm_step(module: nn.Module, *, ignore_id: int = -100) -> Callable:
             inputs, targets = batch[:, :-1], batch[:, 1:]
 
         def loss_fn(params):
-            logits = state.apply_fn({"params": params}, inputs).astype(jnp.float32)
+            logits, mods = state.apply_fn(
+                {"params": params}, inputs, mutable=["aux_losses"]
+            )
+            logits = logits.astype(jnp.float32)
             mask = (targets != ignore_id).astype(jnp.float32)
             safe = jnp.where(targets == ignore_id, 0, targets)
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
-            loss = (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
-            return loss, logits
+            ce_loss = (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+            sown = jax.tree_util.tree_leaves(mods.get("aux_losses", {}))
+            aux = (
+                sum(v.astype(jnp.float32) for v in sown) / len(sown)
+                if sown
+                else jnp.float32(0.0)
+            )
+            return ce_loss + aux_loss_weight * aux, (ce_loss, aux)
 
-        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        (_, (loss, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
         state = state.apply_gradients(grads=grads)
-        return state, {"loss": loss, "perplexity": jnp.exp(loss)}
+        return state, {"loss": loss, "perplexity": jnp.exp(loss), "aux_loss": aux}
 
     return step
 

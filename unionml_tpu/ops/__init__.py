@@ -17,5 +17,17 @@ kernels of the TPU-native model zoo:
 """
 
 from unionml_tpu.ops.attention import attention, blockwise_attention, mha_reference
+from unionml_tpu.ops.moe import (
+    MoEMlp,
+    expert_capacity,
+    expert_parallel_moe,
+    expert_parallel_moe_sharded,
+    make_dispatch,
+    top_k_routing,
+)
 
-__all__ = ["attention", "blockwise_attention", "mha_reference"]
+__all__ = [
+    "attention", "blockwise_attention", "mha_reference",
+    "MoEMlp", "top_k_routing", "make_dispatch", "expert_capacity",
+    "expert_parallel_moe", "expert_parallel_moe_sharded",
+]
