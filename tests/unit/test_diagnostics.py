@@ -139,6 +139,34 @@ def test_elastic_resume_reaches_identical_state(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_checkpoint_manager_async_rotation_and_roundtrip(tmp_path):
+    from unionml_tpu.checkpoint.sharded import CheckpointManager
+
+    states = {
+        s: {"w": jnp.full((4,), float(s)), "step": jnp.int32(s)} for s in (1, 2, 3, 4)
+    }
+    with CheckpointManager(str(tmp_path / "ck"), max_to_keep=2) as mgr:
+        for s, st in states.items():
+            mgr.save(s, st)
+        mgr.wait()
+        assert mgr._steps() == [3, 4]  # rotation kept the newest two
+        restored = mgr.restore(states[4])
+        np.testing.assert_array_equal(np.asarray(restored["w"]), np.full((4,), 4.0))
+        assert int(restored["step"]) == 4
+        # pinned restore of the older surviving step
+        older = mgr.restore(states[3], step=3)
+        assert int(older["step"]) == 3
+
+
+def test_checkpoint_manager_sync_mode(tmp_path):
+    from unionml_tpu.checkpoint.sharded import CheckpointManager
+
+    with CheckpointManager(str(tmp_path / "ck"), async_save=False) as mgr:
+        mgr.save(7, {"w": jnp.ones((2,))})
+        # committed before save() returned: visible without wait()
+        assert mgr._steps() == [7]
+
+
 def test_elastic_fresh_run_no_checkpoint(tmp_path):
     from unionml_tpu.elastic import run_elastic_trainer
 

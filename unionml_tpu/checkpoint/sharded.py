@@ -5,11 +5,19 @@ No reference counterpart — the reference checkpoints only final artifacts
 checkpoints: each host writes only its addressable shards (Orbax), and
 restore re-places shards per the target's NamedSharding, enabling
 deterministic resume from step N after slice preemption.
+
+Saves are **asynchronous by default** through a persistent
+``StandardCheckpointer``: ``save`` snapshots device arrays to host, kicks
+off the filesystem write in the background, and returns — the training
+loop overlaps the write with the next steps. Orbax commits atomically
+(tmp-dir rename), so a preemption mid-write never leaves a half
+checkpoint: resume simply finds the previous complete step.
 """
 
 from __future__ import annotations
 
 import os
+import shutil
 from pathlib import Path
 from typing import Any, Optional, Union
 
@@ -20,8 +28,18 @@ def _ocp():
     return ocp
 
 
-def save_sharded(path: Union[str, os.PathLike], state: Any, *, step: Optional[int] = None, force: bool = True) -> None:
-    """Write a sharded checkpoint of ``state`` (params + opt state pytree)."""
+def save_sharded(
+    path: Union[str, os.PathLike],
+    state: Any,
+    *,
+    step: Optional[int] = None,
+    force: bool = True,
+) -> None:
+    """Write a sharded checkpoint of ``state`` (params + opt state pytree).
+
+    Blocking one-shot form (artifact saves); training loops should use
+    :class:`CheckpointManager` for overlapped async saves.
+    """
     ocp = _ocp()
     path = Path(path).absolute()
     if step is not None:
@@ -46,16 +64,39 @@ class CheckpointManager:
 
     Keeps the most recent ``max_to_keep`` step checkpoints under ``root``;
     ``latest_step()`` enables deterministic resume (SURVEY.md §5.3).
+    Pruning runs only after pending writes commit, so the number of
+    *durable* checkpoints never drops below ``max_to_keep`` (one extra
+    dir may exist transiently between a commit and the next prune).
+    With ``async_save`` (default) each ``save`` waits for the previous
+    write to commit (normally instant — it ran during the intervening
+    training steps), then returns as soon as the new write is launched.
+    Call :meth:`wait` (or ``close``) before reading the newest checkpoint
+    back or ending the process.
     """
 
-    def __init__(self, root: Union[str, os.PathLike], *, max_to_keep: int = 3):
+    def __init__(
+        self,
+        root: Union[str, os.PathLike],
+        *,
+        max_to_keep: int = 3,
+        async_save: bool = True,
+    ):
         self.root = Path(root).absolute()
         self.max_to_keep = max_to_keep
+        self.async_save = async_save
         self.root.mkdir(parents=True, exist_ok=True)
+        self._ckptr = None
+
+    def _checkpointer(self):
+        if self._ckptr is None:
+            self._ckptr = _ocp().StandardCheckpointer()
+        return self._ckptr
 
     def _steps(self):
         steps = []
         for p in self.root.glob("step_*"):
+            # in-flight async writes live in `step_N.orbax-checkpoint-tmp-*`
+            # dirs: the int() parse skips them until commit renames
             try:
                 steps.append(int(p.name.split("_", 1)[1]))
             except ValueError:
@@ -66,17 +107,50 @@ class CheckpointManager:
         steps = self._steps()
         return steps[-1] if steps else None
 
-    def save(self, step: int, state: Any) -> None:
-        save_sharded(self.root, state, step=step)
-        steps = self._steps()
-        while len(steps) > self.max_to_keep:
-            victim = steps.pop(0)
-            import shutil
-
+    def _prune(self) -> None:
+        # only ever called right after wait_until_finished: every step dir
+        # is committed, so deleting down to max_to_keep never drops the
+        # durable count below max_to_keep even if the process dies now
+        for victim in self._steps()[: -self.max_to_keep or None]:
             shutil.rmtree(self.root / f"step_{victim}", ignore_errors=True)
 
+    def save(self, step: int, state: Any) -> None:
+        ckptr = self._checkpointer()
+        # one write in flight at a time: pruning must never race a pending
+        # commit, and a second save would contend for host I/O
+        ckptr.wait_until_finished()
+        self._prune()
+        ckptr.save(self.root / f"step_{step}", state, force=True)
+        if not self.async_save:
+            ckptr.wait_until_finished()
+
+    def wait(self) -> None:
+        """Block until every launched save has committed, then prune."""
+        if self._ckptr is not None:
+            self._ckptr.wait_until_finished()
+            self._prune()
+
     def restore(self, state_target: Any = None, step: Optional[int] = None) -> Any:
+        self.wait()
         step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.root}")
-        return restore_sharded(self.root, state_target, step=step)
+        ckptr = self._checkpointer()
+        path = self.root / f"step_{step}"
+        return (
+            ckptr.restore(path, state_target)
+            if state_target is not None
+            else ckptr.restore(path)
+        )
+
+    def close(self) -> None:
+        if self._ckptr is not None:
+            self.wait()
+            self._ckptr.close()
+            self._ckptr = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -60,8 +60,6 @@ def run_elastic_trainer(
     checkpoints are written under ``checkpoint_dir/step_{global_step}``
     where the state has already consumed batch ``global_step - 1``.
     """
-    import jax
-
     if sharding is not None:
         from unionml_tpu.parallel import compile_step
 
@@ -102,12 +100,18 @@ def run_elastic_trainer(
             state, _metrics = step(state, batch[0] if single else batch)
             global_step += 1
             if global_step % checkpoint_every == 0 or global_step == total_steps:
-                jax.block_until_ready(state)
+                # async save: device->host snapshot happens before save()
+                # returns (so donation of state buffers by the next step is
+                # safe); the disk write overlaps the following steps
                 manager.save(global_step, state)
             if fault_hook is not None:
                 fault_hook(global_step)
     finally:
         loader.close()
+        # a preemption mid-write leaves only an uncommitted tmp dir (orbax
+        # renames atomically); close() waits for the final checkpoint to
+        # commit and releases the async checkpointer's worker threads
+        manager.close()
 
     logger.info(f"elastic trainer: finished at step {global_step}/{total_steps}")
     return state, global_step
