@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Convert MyST executable tutorials to Jupyter notebooks.
+
+Reference analog: scripts/myst_to_ipynb.py in the upstream project (there
+a jupytext wrapper run as a pre-commit hook). This standalone version has
+no dependencies: it splits a MyST markdown file on ````{code-cell}``
+fences, emitting markdown cells for prose and code cells for fenced
+blocks, and writes nbformat-4 JSON next to the source (or to ``--out``).
+
+Usage::
+
+    python scripts/myst_to_ipynb.py docs/tutorials/*.md [--out-dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+from pathlib import Path
+
+_FENCE = re.compile(r"^```\{code-cell\}[^\n]*\n(.*?)^```\s*$", re.M | re.S)
+_FRONTMATTER = re.compile(r"\A---\n.*?\n---\n", re.S)
+_CELL_OPTION = re.compile(r"^:([\w-]+):\s*(.*)$")
+
+
+def _strip_options(code: str):
+    """Split leading MyST ``:key: value`` option lines from cell code."""
+    lines = code.split("\n")
+    options = {}
+    while lines:
+        m = _CELL_OPTION.match(lines[0])
+        if m:
+            options[m.group(1)] = m.group(2)
+            lines.pop(0)
+        elif not lines[0].strip() and options:
+            lines.pop(0)  # blank separator after the option block
+            break
+        else:
+            break
+    return "\n".join(lines), options
+
+
+def split_cells(text: str):
+    """Yield ("markdown"|"code", source) pairs for a MyST document.
+
+    Code sources have MyST cell options (``:tags: [...]`` etc.) stripped,
+    so they are directly executable.
+    """
+    text = _FRONTMATTER.sub("", text)
+    pos = 0
+    for m in _FENCE.finditer(text):
+        prose = text[pos : m.start()].strip("\n")
+        if prose.strip():
+            yield "markdown", prose
+        code, _ = _strip_options(m.group(1).rstrip("\n"))
+        yield "code", code
+        pos = m.end()
+    tail = text[pos:].strip("\n")
+    if tail.strip():
+        yield "markdown", tail
+
+
+def to_notebook(text: str) -> dict:
+    cells = []
+    for i, (kind, source) in enumerate(split_cells(text)):
+        lines = [line + "\n" for line in source.split("\n")]
+        if lines:
+            lines[-1] = lines[-1].rstrip("\n")
+        # nbformat >= 4.5 requires a unique per-cell id
+        cell = {"cell_type": kind, "id": f"cell-{i}", "metadata": {}, "source": lines}
+        if kind == "code":
+            cell.update(execution_count=None, outputs=[])
+        cells.append(cell)
+    return {
+        "cells": cells,
+        "metadata": {
+            "kernelspec": {
+                "display_name": "Python 3",
+                "language": "python",
+                "name": "python3",
+            },
+            "language_info": {"name": "python"},
+        },
+        "nbformat": 4,
+        "nbformat_minor": 5,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("files", nargs="+", type=Path)
+    parser.add_argument("--out-dir", type=Path, default=None)
+    args = parser.parse_args(argv)
+    for src in args.files:
+        nb = to_notebook(src.read_text(encoding="utf-8"))
+        out_dir = args.out_dir or src.parent
+        out_dir.mkdir(parents=True, exist_ok=True)
+        out = out_dir / (src.stem + ".ipynb")
+        out.write_text(json.dumps(nb, indent=1), encoding="utf-8")
+        print(f"{src} -> {out} ({len(nb['cells'])} cells)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
