@@ -1,0 +1,125 @@
+"""Serving-latency benchmark: Llama generation p50/p95 (BASELINE.md).
+
+Reproduces the BASELINE.md serving rows: jitted prefill + scan decode
+via :func:`unionml_tpu.models.make_generator` on a ~1.5B-param Llama-3
+geometry (the largest that fits one v5e chip in bf16; the 8B config
+needs the tensor-parallel path). Prints one JSON line per
+(quantized, batch) combination.
+
+Usage::
+
+    python benchmarks/serve_latency.py [--batches 1 8] [--trials 20]
+    UNIONML_TPU_BENCH_PRESET=tiny python benchmarks/serve_latency.py  # CPU smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def serving_config(preset: str):
+    from unionml_tpu.models import LlamaConfig
+
+    if preset == "tiny":
+        return LlamaConfig.tiny(vocab_size=256)
+    # ~1.5B params: Llama-3 geometry scaled to one v5e chip (bf16 ~3 GB)
+    return LlamaConfig(
+        vocab_size=128_256, hidden_dim=2048, num_layers=20, num_heads=16,
+        num_kv_heads=8, mlp_dim=5632, max_len=2048,
+    )
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batches", type=int, nargs="+", default=[1, 8])
+    parser.add_argument("--trials", type=int, default=20)
+    parser.add_argument("--prompt-len", type=int, default=64)
+    parser.add_argument("--new-tokens", type=int, default=32)
+    args = parser.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu.models import (
+        LLAMA_QUANT_PATTERNS,
+        Llama,
+        make_generator,
+        quantize_params,
+        serving_params,
+    )
+
+    backend = jax.default_backend()
+    preset = os.environ.get(
+        "UNIONML_TPU_BENCH_PRESET", "tiny" if backend == "cpu" else "serve_1p5b"
+    )
+    if preset == "tiny":
+        args.trials = min(args.trials, 3)
+    cfg = serving_config(preset)
+    rng = np.random.default_rng(0)
+
+    module = Llama(cfg)
+    tokens0 = jnp.zeros((1, 8), jnp.int32)
+    fp_params = jax.jit(module.init)(jax.random.PRNGKey(0), tokens0)["params"]
+    # serving residency: one-time bf16 cast (decode re-reads weights per token)
+    params = serving_params(fp_params)
+
+    for quantized in (False, True):
+        if quantized:
+            qcfg = type(cfg)(**{**cfg.__dict__, "quantized": True})
+            qmodule = Llama(qcfg)
+            # quantize from the fp32 masters (the production path), not the
+            # bf16 serving copy: scales from bf16 weights double-round
+            qparams = quantize_params(fp_params, LLAMA_QUANT_PATTERNS)
+            run_module, run_params = qmodule, qparams
+        else:
+            run_module, run_params = module, params
+        # cache sized to the request (make_lm_predictor does this per bucket)
+        generate = make_generator(
+            run_module, max_new_tokens=args.new_tokens,
+            max_len=args.prompt_len + args.new_tokens,
+        )
+        for batch in args.batches:
+            prompt = jnp.asarray(
+                rng.integers(1, cfg.vocab_size, size=(batch, args.prompt_len)),
+                jnp.int32,
+            )
+            # warmup/compile
+            out = generate(run_params, prompt)
+            _ = np.asarray(out)
+            lat = []
+            for _ in range(args.trials):
+                t0 = time.perf_counter()
+                out = generate(run_params, prompt)
+                _ = np.asarray(out)  # host readback = end of request
+                lat.append((time.perf_counter() - t0) * 1e3)
+            lat.sort()
+            p50 = lat[len(lat) // 2]
+            p95 = lat[max(0, math.ceil(0.95 * len(lat)) - 1)]  # nearest-rank
+            toks = batch * args.new_tokens / (p50 / 1e3)
+            print(json.dumps({
+                "metric": f"{preset}_generate_p50_ms",
+                "quantized": quantized,
+                "batch": batch,
+                "prompt_len": args.prompt_len,
+                "new_tokens": args.new_tokens,
+                "value": round(p50, 1),
+                "p95_ms": round(p95, 1),
+                "tokens_per_sec": round(toks, 1),
+                "unit": "ms",
+            }))
+
+
+if __name__ == "__main__":
+    main()

@@ -129,6 +129,46 @@ def test_lm_predictor_batch_bucketing(tiny_llama):
     np.testing.assert_array_equal(np.asarray(out[0]), ref[0])
 
 
+def test_lm_predictor_sizes_cache_per_bucket(tiny_llama, monkeypatch):
+    # decode attention reads the whole cache each step: the predictor must
+    # build one generator per bucket with cache = bucket + max_new_tokens,
+    # not one full-cfg.max_len cache for everything (measured ~4x p50)
+    module, params = tiny_llama
+    from unionml_tpu.models import generate as gen_mod
+
+    seen = []
+    real = gen_mod.make_generator
+
+    def spy(mod, **kwargs):
+        seen.append(kwargs["max_len"])
+        return real(mod, **kwargs)
+
+    monkeypatch.setattr(gen_mod, "make_generator", spy)
+    predictor = gen_mod.make_lm_predictor(
+        module, max_new_tokens=4, bucket_lens=(8, 16, 64)
+    )
+    assert sorted(seen) == [12, 20, 68]
+    # bucketed-cache results still match a full-cache generator
+    out = predictor(params, [[1, 2, 3]])
+    full = real(module, max_new_tokens=4, max_len=module.config.max_len)
+    ref = np.asarray(
+        full(params, jnp.asarray([[0] * 5 + [1, 2, 3]], jnp.int32), None,
+             jnp.asarray([[False] * 5 + [True] * 3]))
+    )
+    np.testing.assert_array_equal(np.asarray(out[0]), ref[0])
+
+
+def test_serving_params_casts_floats_only():
+    from unionml_tpu.models import serving_params
+
+    tree = {"w": jnp.ones((2,), jnp.float32), "q": jnp.ones((2,), jnp.int8),
+            "s": jnp.ones((2,), jnp.float32)}
+    cast = serving_params(tree)
+    assert cast["w"].dtype == jnp.bfloat16
+    assert cast["s"].dtype == jnp.bfloat16
+    assert cast["q"].dtype == jnp.int8
+
+
 def test_generation_rejects_cache_overflow(tiny_llama):
     module, params = tiny_llama
     gen = make_generator(module, max_new_tokens=8, max_len=12)

@@ -22,7 +22,7 @@ from unionml_tpu.models.llama import (
     LlamaConfig,
     init_cache,
 )
-from unionml_tpu.models.generate import make_generator, make_lm_predictor
+from unionml_tpu.models.generate import make_generator, make_lm_predictor, serving_params
 from unionml_tpu.models.mlp import Mlp, MlpConfig
 from unionml_tpu.models.quantization import LLAMA_QUANT_PATTERNS, QuantizedDenseGeneral, quantize_params
 from unionml_tpu.models.train import (
@@ -44,6 +44,6 @@ __all__ = [
     "LLAMA_QUANT_PARTITION_RULES", "LLAMA_MOE_PARTITION_RULES",
     "TrainState", "create_train_state", "classification_step", "lm_step",
     "make_evaluator", "make_predictor",
-    "make_generator", "make_lm_predictor", "adamw",
+    "make_generator", "make_lm_predictor", "serving_params", "adamw",
     "QuantizedDenseGeneral", "quantize_params", "LLAMA_QUANT_PATTERNS",
 ]

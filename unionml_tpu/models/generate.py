@@ -168,10 +168,18 @@ def make_lm_predictor(
             f"no bucket in {bucket_lens} leaves room for {max_new_tokens} new "
             f"tokens within max_len {total_len}"
         )
-    generator = make_generator(
-        module, max_new_tokens=max_new_tokens, max_len=total_len,
-        pad_id=pad_id, **gen_kwargs,
-    )
+    # one generator per bucket, each with a cache sized to the bucket:
+    # decode attention reads the whole cache every step, so a full-length
+    # (cfg.max_len) cache costs up to ~4x p50 at batch 8 on short prompts
+    # (measured, 1.5B on v5e). XLA compiles per shape either way — the
+    # per-bucket generators don't add executables.
+    generators = {
+        b: make_generator(
+            module, max_new_tokens=max_new_tokens, max_len=b + max_new_tokens,
+            pad_id=pad_id, **gen_kwargs,
+        )
+        for b in usable
+    }
     key_state = {"key": jax.random.PRNGKey(seed)}
 
     def predictor(state, prompts) -> list:
@@ -195,7 +203,22 @@ def make_lm_predictor(
             batch[i, bucket - len(r):] = r        # right-align (left-pad)
             mask[i, bucket - len(r):] = True
         key_state["key"], sub = jax.random.split(key_state["key"])
-        out = generator(params, jnp.asarray(batch), sub, jnp.asarray(mask))
+        out = generators[bucket](params, jnp.asarray(batch), sub, jnp.asarray(mask))
         return np.asarray(out)[:n].tolist()
 
     return predictor
+
+
+def serving_params(params, dtype=jnp.bfloat16):
+    """Cast float params once for serving residency.
+
+    Training artifacts carry fp32 master weights; decoding straight from
+    them re-reads (and casts) the fp32 tree every step. A one-time cast
+    to ``dtype`` halves decode weight traffic (~12% p50 on the 1.5B
+    serving config, one v5e chip). Integer leaves (e.g. int8 ``kernel_q``)
+    pass through unchanged.
+    """
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        params,
+    )

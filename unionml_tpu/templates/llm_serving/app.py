@@ -28,6 +28,7 @@ from unionml_tpu.models import (
     Llama,
     LlamaConfig,
     make_lm_predictor,
+    serving_params,
     quantize_params,
 )
 
@@ -66,7 +67,9 @@ def init(hyperparameters: dict) -> dict:
     )["params"]
     if QUANTIZE:
         params = quantize_params(params, LLAMA_QUANT_PATTERNS)
-    return params
+    # one-time bf16 cast: decode re-reads the whole weight tree per token,
+    # fp32 masters double that traffic (models.serving_params)
+    return serving_params(params)
 
 
 @model.trainer
