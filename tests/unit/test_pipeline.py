@@ -87,3 +87,121 @@ def test_pipeline_rejects_bad_microbatching():
         pipeline_apply(stage_fn, stacked, x, mesh=mesh, num_microbatches=5)
     with pytest.raises(ValueError, match="bubble"):
         pipeline_apply(stage_fn, stacked, x, mesh=mesh, num_microbatches=2)
+
+
+# --------------------------------------------------------------------- #
+# pipelined Llama (models/pipeline_lm.py)
+# --------------------------------------------------------------------- #
+
+def _llama_setup(dtype="float32", vocab=64, layers=2):
+    from unionml_tpu.models import Llama, LlamaConfig
+
+    cfg = LlamaConfig.tiny(vocab_size=vocab, num_layers=layers, dtype=dtype)
+    module = Llama(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, vocab)
+    flat = module.init(jax.random.PRNGKey(1), tokens[:1])["params"]
+    return cfg, module, tokens, flat
+
+
+def test_pipelined_llama_logits_match_serial():
+    from unionml_tpu.models import pipelined_lm_apply, to_pipeline_params
+
+    cfg, module, tokens, flat = _llama_setup()
+    mesh = make_mesh({"pipeline": 2, "data": -1})
+    pp = to_pipeline_params(flat, cfg, num_stages=2)
+    ref = module.apply({"params": flat}, tokens)
+    out = jax.jit(
+        lambda p, t: pipelined_lm_apply(
+            p, t, cfg, 2, mesh=mesh, num_microbatches=2
+        )
+    )(pp, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_pipelined_lm_step_matches_serial_step():
+    from unionml_tpu.models import (
+        create_train_state, lm_step, pipelined_lm_step, to_pipeline_params,
+    )
+    from unionml_tpu.models.train import TrainState, adamw
+
+    cfg, module, tokens, flat = _llama_setup()
+    mesh = make_mesh({"pipeline": 2, "data": -1})
+
+    serial_state = create_train_state(module, tokens[:1], learning_rate=1e-2)
+    serial_state = serial_state.replace(params=flat)
+    _, serial_metrics = jax.jit(lm_step(module))(serial_state, tokens)
+
+    pp_state = TrainState.create(
+        apply_fn=None, params=to_pipeline_params(flat, cfg, 2), tx=adamw(1e-2)
+    )
+    step = jax.jit(pipelined_lm_step(cfg, 2, mesh=mesh, num_microbatches=2))
+    pp_state, pp_metrics = step(pp_state, tokens)
+    np.testing.assert_allclose(
+        float(pp_metrics["loss"]), float(serial_metrics["loss"]), rtol=1e-4
+    )
+
+
+def test_pipelined_lm_step_composes_with_dp():
+    from unionml_tpu.models import create_pipelined_lm_state, pipelined_lm_step
+
+    cfg, _, tokens, _ = _llama_setup()
+    mesh = make_mesh({"pipeline": 2, "data": 2}, devices=jax.devices()[:4])
+    state = create_pipelined_lm_state(cfg, 2, tokens[:1], learning_rate=1e-2)
+    step = jax.jit(
+        pipelined_lm_step(cfg, 2, mesh=mesh, num_microbatches=2, data_axis="data")
+    )
+    first = None
+    for _ in range(5):
+        state, metrics = step(state, tokens)
+        first = first if first is not None else float(metrics["loss"])
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(metrics["loss"]) < first
+
+
+def test_pipelined_rejects_moe_and_bad_split():
+    from unionml_tpu.models import LlamaConfig, create_pipelined_lm_state
+
+    cfg = LlamaConfig.tiny(num_layers=3)
+    with pytest.raises(ValueError, match="not divisible"):
+        create_pipelined_lm_state(cfg, 2, jnp.zeros((1, 4), jnp.int32))
+    moe = LlamaConfig.tiny(num_experts=4)
+    with pytest.raises(NotImplementedError, match="pipelined MoE"):
+        create_pipelined_lm_state(moe, 2, jnp.zeros((1, 4), jnp.int32))
+
+
+def test_pipeline_partition_rules_shard_state_via_compile_step():
+    from unionml_tpu.models import (
+        PIPELINE_PARTITION_RULES, create_pipelined_lm_state, pipelined_lm_step,
+    )
+    from unionml_tpu.models import LlamaConfig
+    from unionml_tpu.parallel import ShardingConfig, compile_step
+
+    cfg = LlamaConfig.tiny(vocab_size=64, num_layers=2, dtype="float32")
+    tokens = jnp.zeros((8, 16), jnp.int32)
+    state = create_pipelined_lm_state(cfg, 2, tokens[:1])
+    sharding = ShardingConfig(
+        data=-1, pipeline=2, rules=PIPELINE_PARTITION_RULES
+    )
+    step_fn = pipelined_lm_step(
+        cfg, 2, mesh=sharding.mesh(), num_microbatches=2, data_axis="data"
+    )
+    step, placed = compile_step(step_fn, state, sharding=sharding)
+    # stage params AND their adam moments shard over the pipeline axis
+    assert "pipeline" in jax.tree_util.tree_leaves(
+        placed.params["stages"],
+        is_leaf=lambda x: hasattr(x, "sharding"),
+    )[0].sharding.spec
+    mu = placed.opt_state[0].mu["stages"]
+    assert "pipeline" in jax.tree_util.tree_leaves(mu)[0].sharding.spec
+    placed, metrics = step(placed, jnp.zeros((8, 16), jnp.int32))
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_to_pipeline_params_validates_divisibility():
+    from unionml_tpu.models import LlamaConfig, to_pipeline_params
+
+    cfg = LlamaConfig.tiny(num_layers=3)
+    with pytest.raises(ValueError, match="not divisible"):
+        to_pipeline_params({}, cfg, 2)
+    with pytest.raises(NotImplementedError, match="quantization"):
+        to_pipeline_params({}, LlamaConfig.tiny(quantized=True), 2)

@@ -24,6 +24,13 @@ from unionml_tpu.models.llama import (
 )
 from unionml_tpu.models.generate import make_generator, make_lm_predictor, serving_params
 from unionml_tpu.models.mlp import Mlp, MlpConfig
+from unionml_tpu.models.pipeline_lm import (
+    PIPELINE_PARTITION_RULES,
+    create_pipelined_lm_state,
+    pipelined_lm_apply,
+    pipelined_lm_step,
+    to_pipeline_params,
+)
 from unionml_tpu.models.quantization import LLAMA_QUANT_PATTERNS, QuantizedDenseGeneral, quantize_params
 from unionml_tpu.models.train import (
     TrainState,
@@ -45,5 +52,7 @@ __all__ = [
     "TrainState", "create_train_state", "classification_step", "lm_step",
     "make_evaluator", "make_predictor",
     "make_generator", "make_lm_predictor", "serving_params", "adamw",
+    "create_pipelined_lm_state", "pipelined_lm_step", "pipelined_lm_apply",
+    "to_pipeline_params", "PIPELINE_PARTITION_RULES",
     "QuantizedDenseGeneral", "quantize_params", "LLAMA_QUANT_PATTERNS",
 ]

@@ -1,0 +1,209 @@
+"""Pipeline-parallel Llama training: GPipe stages over the `pipeline` axis.
+
+SURVEY.md §7.8 makes PP a named strategy; this module wires it into the
+model zoo. The decoder's block stack splits into ``num_stages`` runs of
+consecutive blocks; each stage's parameters live on one slice of the
+``pipeline`` mesh axis, and :func:`unionml_tpu.parallel.pipeline_apply`
+runs the differentiable SPMD GPipe schedule (microbatches flow between
+stages via ``ppermute`` over ICI, the whole schedule is one jit program).
+Embedding and the LM head run outside the pipeline — they are replicated
+(or data-sharded) and cheap relative to the block stack.
+
+PP composes with DP: pass ``ShardingConfig(pipeline=n, data=m)``-style
+meshes and ``data_axis="data"`` — microbatch rows shard over ``data``
+while stage weights shard over ``pipeline``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from unionml_tpu.models.llama import LlamaBlock, LlamaConfig
+from unionml_tpu.models.layers import RMSNorm, make_dense
+from unionml_tpu.models.train import TrainState, adamw
+from unionml_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from unionml_tpu.parallel.sharding import PartitionRule
+
+PIPELINE_PARTITION_RULES = (
+    # stacked stage params carry a leading stage dim; unanchored so it
+    # matches both params/stages/... and opt_state/.../mu/stages/...
+    PartitionRule(r"stages/", ("pipeline",)),
+)
+
+
+class LlamaStage(nn.Module):
+    """A run of ``num_blocks`` consecutive Llama blocks (one pipeline stage)."""
+
+    config: LlamaConfig
+    num_blocks: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for i in range(self.num_blocks):
+            x, _ = LlamaBlock(self.config, name=f"block_{i}")(x)
+        return x
+
+
+class _Embedder(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        return nn.Embed(
+            cfg.vocab_size, cfg.hidden_dim, dtype=jnp.dtype(cfg.dtype), name="embed"
+        )(tokens)
+
+
+class _Head(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        x = RMSNorm(dtype=jnp.dtype(cfg.dtype), name="final_norm")(x)
+        # same bias-free DenseGeneral as Llama's lm_head: param structures
+        # stay interchangeable (to_pipeline_params)
+        return make_dense(
+            quantized=False, features=cfg.vocab_size, dtype=jnp.float32,
+            name="lm_head",
+        )(x.astype(jnp.float32))
+
+
+def _modules(cfg: LlamaConfig, num_stages: int):
+    if cfg.num_layers % num_stages:
+        raise ValueError(
+            f"num_layers {cfg.num_layers} not divisible by num_stages {num_stages}"
+        )
+    if cfg.num_experts:
+        raise NotImplementedError(
+            "pipelined MoE is not supported: the per-layer aux losses sown "
+            "inside shard_map stages cannot reach the loss"
+        )
+    if cfg.quantized:
+        raise NotImplementedError(
+            "pipelined training does not support int8 serving quantization"
+        )
+    per = cfg.num_layers // num_stages
+    return _Embedder(cfg), LlamaStage(cfg, per), _Head(cfg)
+
+
+def create_pipelined_lm_state(
+    cfg: LlamaConfig,
+    num_stages: int,
+    example_tokens: jnp.ndarray,
+    *,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    learning_rate: float = 1e-3,
+    weight_decay: float = 0.0,
+    seed: int = 0,
+) -> TrainState:
+    """TrainState whose params are ``{embed, stages, head}``.
+
+    ``stages`` stacks per-stage block params on a leading axis — shard it
+    over ``pipeline`` with :data:`PIPELINE_PARTITION_RULES`.
+    """
+    embedder, stage_module, head = _modules(cfg, num_stages)
+    keys = jax.random.split(jax.random.PRNGKey(seed), num_stages + 2)
+    x = embedder.init(keys[0], example_tokens)
+    h = embedder.apply(x, example_tokens)
+    stage_params = [
+        stage_module.init(keys[1 + s], h)["params"] for s in range(num_stages)
+    ]
+    params = {
+        "embed": x["params"],
+        "stages": stack_stage_params(stage_params),
+        "head": head.init(keys[-1], h)["params"],
+    }
+    tx = optimizer or adamw(learning_rate, weight_decay=weight_decay)
+    return TrainState.create(apply_fn=None, params=params, tx=tx)
+
+
+def to_pipeline_params(flat_params: Any, cfg: LlamaConfig, num_stages: int) -> Any:
+    """Regroup a flat :class:`Llama` param tree into the pipelined layout.
+
+    ``block_i`` goes to stage ``i // (L/num_stages)`` as its local
+    ``block_{i mod per}``; embed and final_norm/lm_head move to the
+    ``embed`` / ``head`` groups. Enables checkpoint migration between the
+    serial and pipelined trainers.
+    """
+    _modules(cfg, num_stages)  # same validation as the trainer path
+    per = cfg.num_layers // num_stages
+    stages = []
+    for s in range(num_stages):
+        stages.append({
+            f"block_{i}": flat_params[f"block_{s * per + i}"] for i in range(per)
+        })
+    return {
+        "embed": {"embed": flat_params["embed"]},
+        "stages": stack_stage_params(stages),
+        "head": {
+            "final_norm": flat_params["final_norm"],
+            "lm_head": flat_params["lm_head"],
+        },
+    }
+
+
+def pipelined_lm_apply(
+    params: Any,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    num_stages: int,
+    *,
+    mesh,
+    num_microbatches: int,
+    data_axis: Optional[str] = None,
+    remat: bool = True,
+) -> jnp.ndarray:
+    """Forward logits [B, S, V] through the pipelined decoder."""
+    embedder, stage_module, head = _modules(cfg, num_stages)
+    h = embedder.apply({"params": params["embed"]}, tokens)
+    h = pipeline_apply(
+        lambda p, mb: stage_module.apply({"params": p}, mb),
+        params["stages"], h,
+        mesh=mesh, num_microbatches=num_microbatches,
+        data_axis=data_axis, remat=remat,
+    )
+    return head.apply({"params": params["head"]}, h)
+
+
+def pipelined_lm_step(
+    cfg: LlamaConfig,
+    num_stages: int,
+    *,
+    mesh,
+    num_microbatches: int,
+    data_axis: Optional[str] = None,
+    ignore_id: int = -100,
+) -> Callable:
+    """``step(state, batch) -> (state, metrics)`` with the block stack
+    pipelined (jit this under the mesh, e.g. via ``compile_step`` with
+    ``ShardingConfig(pipeline=n, data=m, rules=PIPELINE_PARTITION_RULES)``).
+    """
+
+    def step(state: TrainState, batch):
+        if isinstance(batch, tuple):
+            inputs, targets = batch
+        else:
+            inputs, targets = batch[:, :-1], batch[:, 1:]
+
+        def loss_fn(params):
+            logits = pipelined_lm_apply(
+                params, inputs, cfg, num_stages,
+                mesh=mesh, num_microbatches=num_microbatches, data_axis=data_axis,
+            ).astype(jnp.float32)
+            mask = (targets != ignore_id).astype(jnp.float32)
+            safe = jnp.where(targets == ignore_id, 0, targets)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
+            return (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        state = state.apply_gradients(grads=grads)
+        return state, {"loss": loss, "perplexity": jnp.exp(loss)}
+
+    return step

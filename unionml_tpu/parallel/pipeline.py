@@ -96,12 +96,18 @@ def pipeline_apply(
     axis: str = "pipeline",
     num_microbatches: int,
     remat: bool = True,
+    data_axis: Optional[str] = None,
 ) -> jnp.ndarray:
     """Globally-shaped pipeline execution (jit-able, differentiable).
 
     ``stacked_params``: pytree with a leading stage axis of size
     ``mesh.shape[axis]``; ``batch``: [B, ...] with ``B`` divisible by
     ``num_microbatches``. Returns [B, ...] outputs replicated over ``axis``.
+
+    ``data_axis`` composes PP x DP: the microbatch dimension shards over
+    that mesh axis (each data shard runs its own pipeline over the same
+    stage weights; ppermute/psum stay on the ``pipeline`` axis), so the
+    per-device microbatch is ``B / num_microbatches / mesh.shape[data_axis]``.
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -115,8 +121,14 @@ def pipeline_apply(
             f"num_microbatches {num_microbatches} < pipeline stages {n}: "
             f"the bubble would dominate; use at least one microbatch per stage"
         )
+    mb_rows = b // num_microbatches
+    if data_axis is not None and mb_rows % mesh.shape[data_axis]:
+        raise ValueError(
+            f"microbatch rows {mb_rows} not divisible by data axis size "
+            f"{mesh.shape[data_axis]}"
+        )
 
-    micro = batch.reshape((num_microbatches, b // num_microbatches) + batch.shape[1:])
+    micro = batch.reshape((num_microbatches, mb_rows) + batch.shape[1:])
 
     # the scan carry is one microbatch-shaped activation, so every stage
     # must map [mb, ...] -> same shape/dtype; fail here with a clear error
@@ -136,6 +148,7 @@ def pipeline_apply(
         )
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    batch_spec = P(None, data_axis) if data_axis is not None else P()
 
     def body(params, mb):
         return pipeline_spmd(
@@ -145,8 +158,8 @@ def pipeline_apply(
     out = shard_map(
         body,
         mesh=mesh,
-        in_specs=(pspec, P()),
-        out_specs=P(),
+        in_specs=(pspec, batch_spec),
+        out_specs=batch_spec,
         check_vma=False,
     )(stacked_params, micro)
     return out.reshape((b,) + out.shape[2:])
