@@ -1,0 +1,25 @@
+# Developer entry points (reference analog: the upstream Makefile).
+# Tests force the CPU-simulated 8-device mesh via tests/conftest.py.
+
+.PHONY: test lint bench bench-all notebooks dryrun
+
+test:
+	python -m pytest tests/ -x -q
+
+lint:
+	python -m ruff check unionml_tpu tests benchmarks scripts 2>/dev/null || \
+	python -m flake8 --max-line-length 100 unionml_tpu || true
+
+bench:
+	python bench.py
+
+bench-all: bench
+	python benchmarks/train_throughput.py
+	python benchmarks/serve_latency.py
+	python benchmarks/attn_kernels.py
+
+notebooks:
+	python scripts/myst_to_ipynb.py docs/tutorials/*.md
+
+dryrun:
+	JAX_PLATFORMS=cpu python __graft_entry__.py 8

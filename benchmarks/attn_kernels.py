@@ -1,0 +1,92 @@
+"""Attention-kernel selection benchmark (BASELINE.md kernel table).
+
+Times each attention implementation (fwd+bwd, one jit program over a
+12-layer chain) at the two regimes that drive the `attn_impl` defaults:
+
+- short-seq ViT/BERT shape (64 x 197 x 12 x 64, non-causal) — where the
+  one-program-per-batch `fused` kernel wins;
+- long-seq LLM shape (4 x 4096 x 16 x 128, causal) — where the
+  VMEM-tiled `flash` kernel wins.
+
+Prints one JSON line per (regime, impl). On CPU backends Pallas kernels
+run in interpret mode — use UNIONML_TPU_BENCH_PRESET=tiny for a smoke
+run there.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu.ops.attention import attention
+
+    tiny = os.environ.get("UNIONML_TPU_BENCH_PRESET") == "tiny" or (
+        jax.default_backend() == "cpu"
+    )
+    regimes = {
+        "short_seq": dict(shape=(8, 64, 4, 16) if tiny else (64, 197, 12, 64),
+                          causal=False, impls=("xla", "blockwise", "fused")),
+        "long_seq": dict(shape=(1, 256, 4, 32) if tiny else (4, 4096, 16, 128),
+                         causal=True, impls=("xla", "blockwise", "flash"),
+                         layers=1),
+    }
+    steps, warmup = (3, 1) if tiny else (30, 5)
+
+    for regime, spec in regimes.items():
+        # chaining 12 layers of full 4096^2 score tensors through one bwd
+        # program crashes the compiler; the long regime times one layer
+        layers = spec.get("layers", 2 if tiny else 12)
+        b, s, h, d = spec["shape"]
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (b, s, h, d), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (b, s, h, d), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (b, s, h, d), jnp.bfloat16)
+
+        for impl in spec["impls"]:
+            def loss(q, k, v, _impl=impl):
+                x = q
+                for _ in range(layers):
+                    x = attention(x, k, v, impl=_impl, causal=spec["causal"])
+                return jnp.sum(x.astype(jnp.float32) ** 2)
+
+            grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            try:
+                for _ in range(warmup):
+                    out = grad(q, k, v)
+                _ = float(np.asarray(out[0]).ravel()[0])
+            except Exception as e:
+                print(json.dumps({
+                    "metric": f"attn_{regime}_{impl}_ms", "value": None,
+                    "error": str(e)[:120],
+                }))
+                continue
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = grad(q, k, v)
+            _ = float(np.asarray(out[0]).ravel()[0])
+            ms = (time.perf_counter() - t0) / steps * 1e3
+            print(json.dumps({
+                "metric": f"attn_{regime}_{impl}_ms",
+                "shape": [b, s, h, d],
+                "layers": layers,
+                "value": round(ms, 2),
+                "unit": "ms (fwd+bwd)",
+            }))
+
+
+if __name__ == "__main__":
+    main()

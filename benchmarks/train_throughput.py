@@ -1,0 +1,105 @@
+"""Training-throughput benchmarks beyond the headline ViT (bench.py).
+
+Reproduces the remaining BASELINE.md training rows on one chip:
+
+- ``bert_ft``  — BERT-base classification fine-tune (batch 32, seq 128),
+  samples/sec/chip; the config that exposed the donated-optax-adamw
+  pathology (BASELINE.md) — uses the donation-safe ``adamw`` chain.
+- ``llama_lc`` — long-context LM training (0.19B-param Llama geometry,
+  batch 2, seq 4096, Pallas flash attention), tokens/sec/chip.
+
+Prints one JSON line per config. Timing follows the BASELINE.md
+methodology: warmup, >=100-step window on TPU, end with a host readback
+data-dependent on the final donated state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def _time_steps(step, state, batch, steps, warmup):
+    import jax
+
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    _ = float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    _ = float(jax.tree_util.tree_leaves(state.params)[0].ravel()[0])
+    return time.perf_counter() - t0
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu.models import (
+        BertClassifier,
+        BertConfig,
+        Llama,
+        LlamaConfig,
+        classification_step,
+        create_train_state,
+        lm_step,
+    )
+
+    tiny = os.environ.get("UNIONML_TPU_BENCH_PRESET") == "tiny" or (
+        jax.default_backend() == "cpu"
+    )
+    steps, warmup = (3, 1) if tiny else (100, 10)
+    rng = np.random.default_rng(0)
+
+    # -- BERT-base fine-tune ------------------------------------------- #
+    bcfg = BertConfig.tiny() if tiny else BertConfig.base(num_classes=2)
+    batch, seq = (4, 16) if tiny else (32, 128)
+    bert = BertClassifier(bcfg)
+    ids = jnp.asarray(rng.integers(0, bcfg.vocab_size, size=(batch, seq)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, 2, size=(batch,)), jnp.int32)
+    state = create_train_state(bert, ids[:1], learning_rate=2e-5)
+    step = jax.jit(classification_step(bert), donate_argnums=0)
+    dt = _time_steps(step, state, (ids, labels), steps, warmup)
+    print(json.dumps({
+        "metric": "bert_ft_train_samples_per_sec_per_chip",
+        "batch": batch, "seq": seq,
+        "value": round(batch * steps / dt, 1),
+        "unit": "samples/sec/chip",
+    }))
+
+    # -- long-context Llama LM ----------------------------------------- #
+    if tiny:
+        lcfg = LlamaConfig.tiny(vocab_size=256)
+        batch, seq = 2, 64
+    else:
+        # ~0.19B params: 12 x 768 Llama geometry, flash attention
+        lcfg = LlamaConfig(
+            vocab_size=32_000, hidden_dim=768, num_layers=12, num_heads=12,
+            num_kv_heads=4, mlp_dim=2048, max_len=4096, attn_impl="flash",
+        )
+        batch, seq = 2, 4096
+    lm = Llama(lcfg)
+    tokens = jnp.asarray(rng.integers(0, lcfg.vocab_size, size=(batch, seq)), jnp.int32)
+    state = create_train_state(lm, tokens[:1, :8], learning_rate=1e-3)
+    step = jax.jit(lm_step(lm), donate_argnums=0)
+    dt = _time_steps(step, state, tokens, steps, warmup)
+    print(json.dumps({
+        "metric": "llama_lc_train_tokens_per_sec_per_chip",
+        "batch": batch, "seq": seq,
+        "value": round(batch * (seq - 1) * steps / dt, 1),
+        "unit": "tokens/sec/chip",
+    }))
+
+
+if __name__ == "__main__":
+    main()

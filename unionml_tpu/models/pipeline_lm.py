@@ -25,7 +25,7 @@ from flax import linen as nn
 
 from unionml_tpu.models.llama import LlamaBlock, LlamaConfig
 from unionml_tpu.models.layers import RMSNorm, make_dense
-from unionml_tpu.models.train import TrainState, adamw
+from unionml_tpu.models.train import TrainState, adamw, masked_cross_entropy
 from unionml_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 from unionml_tpu.parallel.sharding import PartitionRule
 
@@ -196,11 +196,8 @@ def pipelined_lm_step(
             logits = pipelined_lm_apply(
                 params, inputs, cfg, num_stages,
                 mesh=mesh, num_microbatches=num_microbatches, data_axis=data_axis,
-            ).astype(jnp.float32)
-            mask = (targets != ignore_id).astype(jnp.float32)
-            safe = jnp.where(targets == ignore_id, 0, targets)
-            ce = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
-            return (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+            )
+            return masked_cross_entropy(logits, targets, ignore_id=ignore_id)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         state = state.apply_gradients(grads=grads)

@@ -70,6 +70,21 @@ def _accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
 
 
+def masked_cross_entropy(
+    logits: jnp.ndarray, targets: jnp.ndarray, *, ignore_id: int = -100
+) -> jnp.ndarray:
+    """Mean CE over positions where ``targets != ignore_id`` (fp32 math).
+
+    Shared by the serial :func:`lm_step` and the pipelined trainer
+    (models/pipeline_lm.py) so their losses cannot drift apart.
+    """
+    logits = logits.astype(jnp.float32)
+    mask = (targets != ignore_id).astype(jnp.float32)
+    safe = jnp.where(targets == ignore_id, 0, targets)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
+    return (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
 def classification_step(module: nn.Module) -> Callable:
     """softmax-CE step for (features, int_labels) batches (MLP/ViT/BERT-cls)."""
 
@@ -115,11 +130,7 @@ def lm_step(
             logits, mods = state.apply_fn(
                 {"params": params}, inputs, mutable=["aux_losses"]
             )
-            logits = logits.astype(jnp.float32)
-            mask = (targets != ignore_id).astype(jnp.float32)
-            safe = jnp.where(targets == ignore_id, 0, targets)
-            ce = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
-            ce_loss = (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+            ce_loss = masked_cross_entropy(logits, targets, ignore_id=ignore_id)
             sown = jax.tree_util.tree_leaves(mods.get("aux_losses", {}))
             aux = (
                 sum(v.astype(jnp.float32) for v in sown) / len(sown)

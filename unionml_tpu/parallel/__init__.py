@@ -17,7 +17,6 @@ first-class replacement: strategies compose as axes of one
 from unionml_tpu.parallel.mesh import make_mesh, mesh_devices, multihost_initialize
 from unionml_tpu.parallel.pipeline import (
     pipeline_apply,
-    pipeline_partition_rules,
     pipeline_spmd,
     stack_stage_params,
 )
@@ -37,7 +36,6 @@ __all__ = [
     "pipeline_apply",
     "pipeline_spmd",
     "stack_stage_params",
-    "pipeline_partition_rules",
     "PartitionRule",
     "ShardingConfig",
     "compile_step",

@@ -122,11 +122,16 @@ def pipeline_apply(
             f"the bubble would dominate; use at least one microbatch per stage"
         )
     mb_rows = b // num_microbatches
-    if data_axis is not None and mb_rows % mesh.shape[data_axis]:
-        raise ValueError(
-            f"microbatch rows {mb_rows} not divisible by data axis size "
-            f"{mesh.shape[data_axis]}"
-        )
+    if data_axis is not None:
+        if data_axis not in mesh.shape:
+            raise ValueError(
+                f"data_axis {data_axis!r} is not a mesh axis {tuple(mesh.shape)}"
+            )
+        if mb_rows % mesh.shape[data_axis]:
+            raise ValueError(
+                f"microbatch rows {mb_rows} not divisible by data axis size "
+                f"{mesh.shape[data_axis]}"
+            )
 
     micro = batch.reshape((num_microbatches, mb_rows) + batch.shape[1:])
 
@@ -166,12 +171,10 @@ def pipeline_apply(
 
 
 def stack_stage_params(per_stage: list) -> Any:
-    """Stack a list of per-stage param pytrees along a new leading axis."""
+    """Stack a list of per-stage param pytrees along a new leading axis.
+
+    Shard the result's leading axis over ``pipeline`` with
+    ``unionml_tpu.models.PIPELINE_PARTITION_RULES`` (which targets only
+    the ``stages/`` subtree, leaving embed/head alone).
+    """
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage)
-
-
-def pipeline_partition_rules(axis: str = "pipeline"):
-    """PartitionRule matching stacked stage params' leading axis."""
-    from unionml_tpu.parallel.sharding import PartitionRule
-
-    return (PartitionRule(r".*", (axis,)),)
