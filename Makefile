@@ -7,8 +7,13 @@ test:
 	python -m pytest tests/ -x -q
 
 lint:
-	python -m ruff check unionml_tpu tests benchmarks scripts 2>/dev/null || \
-	python -m flake8 --max-line-length 100 unionml_tpu || true
+	@if python -c "import ruff" 2>/dev/null; then \
+		python -m ruff check unionml_tpu tests benchmarks scripts; \
+	elif python -c "import flake8" 2>/dev/null; then \
+		python -m flake8 --max-line-length 100 unionml_tpu tests benchmarks scripts; \
+	else \
+		echo "no linter installed (pip install ruff or flake8)"; exit 1; \
+	fi
 
 bench:
 	python bench.py

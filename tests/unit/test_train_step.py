@@ -141,3 +141,82 @@ def test_pytree_artifact_roundtrip(tmp_path):
     new_leaves = jax.tree_util.tree_leaves(loaded.params)
     for a, b in zip(orig_leaves, new_leaves):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+# --------------------------------------------------------------------- #
+# streaming trainers (execution.run_step_trainer, SURVEY.md §7.4)
+# --------------------------------------------------------------------- #
+
+def _stream_problem():
+    from unionml_tpu.models import Mlp, MlpConfig, classification_step, create_train_state
+
+    module = Mlp(MlpConfig(hidden_dims=(16,), num_classes=2))
+    rng = np.random.default_rng(0)
+    x = np.concatenate([rng.normal(-2, 1, (64, 4)), rng.normal(2, 1, (64, 4))]).astype(np.float32)
+    y = np.concatenate([np.zeros(64), np.ones(64)]).astype(np.int32)
+    state = create_train_state(module, jnp.asarray(x[:1]), learning_rate=0.05)
+    return classification_step(module), state, x, y
+
+
+def test_streaming_trainer_callable_per_epoch():
+    from unionml_tpu.execution import run_step_trainer
+
+    step, state, x, y = _stream_problem()
+
+    def epoch_stream():
+        for i in range(0, 128, 32):
+            yield (jnp.asarray(x[i:i + 32]), jnp.asarray(y[i:i + 32]))
+
+    out = run_step_trainer(
+        step_fn=step, state=state, features=epoch_stream, num_epochs=4,
+    )
+    logits = out.apply_fn({"params": out.params}, jnp.asarray(x))
+    acc = float((jnp.argmax(logits, -1) == jnp.asarray(y)).mean())
+    assert acc > 0.9
+
+
+def test_streaming_trainer_one_shot_iterator():
+    from unionml_tpu.execution import run_step_trainer
+
+    step, state, x, y = _stream_problem()
+    stream = ((jnp.asarray(x[i:i + 32]), jnp.asarray(y[i:i + 32]))
+              for i in range(0, 128, 32))
+    out = run_step_trainer(step_fn=step, state=state, features=stream)
+    assert out.step == 4  # consumed exactly the four streamed batches
+
+
+def test_streaming_trainer_rejections():
+    from unionml_tpu.execution import run_step_trainer
+
+    step, state, x, y = _stream_problem()
+    stream = iter([(jnp.asarray(x[:32]), jnp.asarray(y[:32]))])
+    with pytest.raises(ValueError, match="cannot be replayed"):
+        run_step_trainer(step_fn=step, state=state, features=stream, num_epochs=2)
+    with pytest.raises(ValueError, match="streaming trainers"):
+        run_step_trainer(
+            step_fn=step, state=state, features=iter([]), targets=np.zeros(4),
+        )
+
+
+def test_streaming_trainer_reiterable_loader_multi_epoch():
+    from unionml_tpu.execution import run_step_trainer
+
+    step, state, x, y = _stream_problem()
+
+    class Loader:  # DataLoader-like: __iter__ only, fresh pass each time
+        def __iter__(self):
+            for i in range(0, 128, 32):
+                yield (jnp.asarray(x[i:i + 32]), jnp.asarray(y[i:i + 32]))
+
+    out = run_step_trainer(step_fn=step, state=state, features=Loader(), num_epochs=3)
+    assert out.step == 12
+
+
+def test_streaming_trainer_exhausted_callable_raises():
+    from unionml_tpu.execution import run_step_trainer
+
+    step, state, x, y = _stream_problem()
+    gen = ((jnp.asarray(x[i:i + 32]), jnp.asarray(y[i:i + 32]))
+           for i in range(0, 64, 32))
+    with pytest.raises(ValueError, match="FRESH iterable"):
+        run_step_trainer(step_fn=step, state=state, features=lambda: gen, num_epochs=3)

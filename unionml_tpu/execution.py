@@ -98,10 +98,39 @@ def run_step_trainer(
     the step is compiled under its mesh: state placed per the config's param
     spec, batches sharded along the data axis, XLA inserting the gradient
     ``psum`` over ICI automatically.
+
+    **Streaming**: ``features`` may instead be an iterator/generator of
+    ready batches (one pass; ``num_epochs`` must be 1) or a zero-arg
+    callable returning one iterable per epoch (SURVEY.md §7.4 "reader →
+    host prefetch, made streaming"). Each yielded item is fed to the step
+    as-is (build ``(x, y)`` tuples in the stream); batch shapes must be
+    constant or XLA recompiles per shape. ``targets`` must be None.
     """
     import jax
 
-    n = _num_examples(features)
+    # streams: callables (fresh iterable per epoch), iterators (one pass),
+    # or re-iterable loader objects (DataLoader-likes). Pytree containers
+    # and arrays are NOT streams — they carry the (features[, targets])
+    # array contract.
+    streaming = callable(features) or (
+        hasattr(features, "__iter__")
+        and not isinstance(features, (dict, list, tuple, str, bytes))
+        and not hasattr(features, "__array__")
+        and not hasattr(features, "shape")
+    )
+    if streaming:
+        if targets is not None:
+            raise ValueError(
+                "streaming trainers take batches from `features` alone — "
+                "yield (x, y) tuples from the stream instead of passing targets"
+            )
+        if hasattr(features, "__next__") and num_epochs != 1:
+            raise ValueError(
+                f"a one-shot batch iterator cannot be replayed for "
+                f"num_epochs={num_epochs}; pass a callable returning a fresh "
+                "iterable per epoch"
+            )
+    n = 0 if streaming else _num_examples(features)
     has_targets = targets is not None
 
     if sharding is not None:
@@ -119,6 +148,23 @@ def run_step_trainer(
         return not isinstance(x, (dict, list, tuple)) and hasattr(x, "__array__")
 
     def host_batches():
+        if streaming:
+            for epoch in range(num_epochs):
+                stream = features() if callable(features) else iter(features)
+                got = 0
+                for item in stream:
+                    got += 1
+                    yield item
+                if got == 0 and epoch > 0:
+                    # a callable returning the SAME exhausted iterator each
+                    # epoch would otherwise silently under-train
+                    raise ValueError(
+                        f"streaming source yielded no batches in epoch "
+                        f"{epoch + 1}/{num_epochs}: the callable must return "
+                        "a FRESH iterable per call (a lambda closing over one "
+                        "generator replays an exhausted stream)"
+                    )
+            return
         # fast path: plain (features[, targets]) arrays go through the
         # native threaded batch loader. copy=True: device_put only
         # ENQUEUES the host→HBM transfer (PJRT may read the host buffer
@@ -166,7 +212,16 @@ def run_step_trainer(
                 leaves = jax.tree_util.tree_leaves(metrics)
                 if leaves:
                     np.asarray(leaves[0])
-            timer.tick(batch_size)
+            # actual leading dim (streamed batches may differ from batch_size)
+            rows = next(
+                (
+                    leaf.shape[0]
+                    for leaf in jax.tree_util.tree_leaves(batch)
+                    if getattr(leaf, "ndim", 0) >= 1
+                ),
+                batch_size,
+            )
+            timer.tick(rows)
             steps += 1
     if steps:
         jax.block_until_ready(state)
