@@ -186,8 +186,43 @@ def test_moe_config_validation():
         LlamaConfig.tiny(num_experts=1)  # default num_selected=2 > experts
     with pytest.raises(ValueError, match="num_selected"):
         LlamaConfig.tiny(num_experts=4, num_selected=0)
-    with pytest.raises(NotImplementedError, match="quantization"):
-        LlamaConfig.tiny(num_experts=4, quantized=True)
+
+
+def test_quantized_moe_matches_fp_module():
+    from unionml_tpu.models import LLAMA_QUANT_PATTERNS, quantize_params
+
+    fp = MoEMlp(num_experts=4, num_selected=2, hidden_dim=32, model_dim=16,
+                dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+    params = fp.init(jax.random.PRNGKey(1), x)["params"]
+    ref, aux_ref = fp.apply({"params": params}, x)
+
+    qparams = quantize_params({"moe": params}, LLAMA_QUANT_PATTERNS)["moe"]
+    assert qparams["w_gate_q"].dtype == jnp.int8
+    assert qparams["w_gate_scale"].shape == (4, 32)
+    qmod = MoEMlp(num_experts=4, num_selected=2, hidden_dim=32, model_dim=16,
+                  dtype=jnp.float32, quantized=True)
+    out, aux = qmod.apply({"params": qparams}, x)
+    # int8 weight-only: a ~1% relative error bound on the block output
+    rel = float(jnp.linalg.norm(out - ref) / jnp.linalg.norm(ref))
+    assert rel < 0.02, rel
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
+
+
+def test_quantized_moe_llama_generation():
+    from unionml_tpu.models import LLAMA_QUANT_PATTERNS, quantize_params
+
+    cfg = LlamaConfig.tiny(vocab_size=64, num_experts=4, num_selected=2)
+    module = Llama(cfg)
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), tokens)["params"]
+    qcfg = LlamaConfig.tiny(vocab_size=64, num_experts=4, num_selected=2,
+                            quantized=True)
+    qparams = quantize_params(params, LLAMA_QUANT_PATTERNS)
+    generate = make_generator(Llama(qcfg), max_new_tokens=4)
+    out = generate(qparams, jnp.asarray([[1, 2, 3, 4]], jnp.int32))
+    assert out.shape == (1, 4)
+    assert np.isfinite(np.asarray(out)).all()
 
 
 def test_moe_llama_generation():

@@ -220,3 +220,11 @@ def test_streaming_trainer_exhausted_callable_raises():
            for i in range(0, 64, 32))
     with pytest.raises(ValueError, match="FRESH iterable"):
         run_step_trainer(step_fn=step, state=state, features=lambda: gen, num_epochs=3)
+
+
+def test_streaming_trainer_empty_stream_raises():
+    from unionml_tpu.execution import run_step_trainer
+
+    step, state, x, y = _stream_problem()
+    with pytest.raises(ValueError, match="no batches in epoch 1"):
+        run_step_trainer(step_fn=step, state=state, features=iter([]))

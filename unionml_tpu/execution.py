@@ -155,14 +155,16 @@ def run_step_trainer(
                 for item in stream:
                     got += 1
                     yield item
-                if got == 0 and epoch > 0:
-                    # a callable returning the SAME exhausted iterator each
-                    # epoch would otherwise silently under-train
+                if got == 0:
+                    # silent zero-batch epochs under-train with no signal:
+                    # an already-exhausted iterator, or a callable returning
+                    # the SAME exhausted iterator each epoch
                     raise ValueError(
                         f"streaming source yielded no batches in epoch "
-                        f"{epoch + 1}/{num_epochs}: the callable must return "
-                        "a FRESH iterable per call (a lambda closing over one "
-                        "generator replays an exhausted stream)"
+                        f"{epoch + 1}/{num_epochs}. A callable must return a "
+                        "FRESH iterable per call (a lambda closing over one "
+                        "generator replays an exhausted stream); an iterator "
+                        "must not be consumed before training"
                     )
             return
         # fast path: plain (features[, targets]) arrays go through the

@@ -59,10 +59,6 @@ class LlamaConfig:
                     f"num_selected={self.num_selected} must be in "
                     f"[1, num_experts={self.num_experts}]"
                 )
-            if self.quantized:
-                raise NotImplementedError(
-                    "int8 weight-only quantization does not cover MoE experts yet"
-                )
 
     @staticmethod
     def llama3_8b() -> "LlamaConfig":
@@ -133,7 +129,7 @@ class LlamaBlock(nn.Module):
             mlp_out, aux = MoEMlp(
                 num_experts=cfg.num_experts, num_selected=cfg.num_selected,
                 hidden_dim=cfg.mlp_dim, model_dim=cfg.hidden_dim,
-                dtype=dtype, name="moe",
+                quantized=cfg.quantized, dtype=dtype, name="moe",
             )(h)
             # collected by lm_step via mutable=["aux_losses"] and added to
             # the CE loss with a load-balancing weight
@@ -216,16 +212,6 @@ LLAMA_PARTITION_RULES = (
     PartitionRule(r"lm_head/kernel$", (None, "tensor")),
 )
 
-# MoE configs (num_experts > 0): expert weights [E, d, h] shard E over the
-# `expert` mesh axis (GSPMD turns the one-hot dispatch einsums into
-# all_to_all on that axis) and the hidden dim over `tensor`; the router is
-# replicated — it is tiny and every device routes its own tokens.
-LLAMA_MOE_PARTITION_RULES = (
-    PartitionRule(r"moe/w_(gate|up)$", ("expert", None, "tensor")),
-    PartitionRule(r"moe/w_down$", ("expert", "tensor", None)),
-    PartitionRule(r"moe/router_kernel$", (None,)),
-) + LLAMA_PARTITION_RULES
-
 # int8 serving (LlamaConfig.quantized=True): kernels are 2D [K, N] with a
 # per-output-channel scale [N]. Megatron layout carries over: qkv/gate/up/
 # lm_head shard N (their scales shard with it); o/down shard K (their
@@ -240,3 +226,20 @@ LLAMA_QUANT_PARTITION_RULES = LLAMA_PARTITION_RULES + (
     PartitionRule(r"lm_head/kernel_q$", (None, "tensor")),
     PartitionRule(r"lm_head/scale$", ("tensor",)),
 )
+
+# MoE configs (num_experts > 0): expert weights [E, d, h] shard E over the
+# `expert` mesh axis (GSPMD turns the one-hot dispatch einsums into
+# all_to_all on that axis) and the hidden dim over `tensor`; the router is
+# replicated — it is tiny and every device routes its own tokens.
+LLAMA_MOE_PARTITION_RULES = (
+    PartitionRule(r"moe/w_(gate|up)$", ("expert", None, "tensor")),
+    PartitionRule(r"moe/w_down$", ("expert", "tensor", None)),
+    # int8 serving form: [E, K, N] weights + [E, N] scales
+    PartitionRule(r"moe/w_(gate|up)_q$", ("expert", None, "tensor")),
+    PartitionRule(r"moe/w_(gate|up)_scale$", ("expert", "tensor")),
+    PartitionRule(r"moe/w_down_q$", ("expert", "tensor", None)),
+    PartitionRule(r"moe/w_down_scale$", ("expert", None)),
+    PartitionRule(r"moe/router_kernel$", (None,)),
+    # includes the attention/mlp/lm_head int8 rules (supersets the fp set),
+    # so one rule list covers fp and quantized MoE models alike
+) + LLAMA_QUANT_PARTITION_RULES

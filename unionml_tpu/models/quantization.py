@@ -84,7 +84,15 @@ def _quantize_kernel_2d(w2d: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return q, scale.astype(jnp.float32)
 
 
-LLAMA_QUANT_PATTERNS = (r"attn/(q|k|v|o)$", r"mlp/(gate|up|down)$", r"lm_head$")
+def _quantize_expert_kernel(w3d: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-(expert, out-channel) symmetric int8 for [E, K, N] MoE weights:
+    the 2D recipe vmapped over the leading expert axis."""
+    return jax.vmap(_quantize_kernel_2d)(jnp.asarray(w3d))
+
+
+LLAMA_QUANT_PATTERNS = (
+    r"attn/(q|k|v|o)$", r"mlp/(gate|up|down)$", r"lm_head$", r"moe$"
+)
 
 
 def quantize_params(params: Any, patterns: Sequence[str]) -> Any:
@@ -109,6 +117,20 @@ def quantize_params(params: Any, patterns: Sequence[str]) -> Any:
     compiled = [re.compile(p) for p in patterns]
 
     def walk(path, tree):
+        if isinstance(tree, dict) and "w_gate" in tree and "w_down" in tree:
+            # MoE expert block (ops/moe.py): [E, K, N] weights quantize
+            # per (expert, out-channel); the fp32 router passes through
+            joined = "/".join(path)
+            if any(c.search(joined) for c in compiled):
+                out = {}
+                for name, v in tree.items():
+                    if name in ("w_gate", "w_up", "w_down"):
+                        q, scale = _quantize_expert_kernel(jnp.asarray(v))
+                        out[f"{name}_q"] = q
+                        out[f"{name}_scale"] = scale
+                    else:
+                        out[name] = v
+                return out
         if isinstance(tree, dict) and "kernel" in tree and isinstance(
             tree["kernel"], (jnp.ndarray, np.ndarray)
         ):

@@ -205,6 +205,7 @@ class MoEMlp(nn.Module):
     hidden_dim: int
     model_dim: int
     dtype: jnp.dtype = jnp.bfloat16
+    quantized: bool = False  # int8 weight-only experts (serving path)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -218,18 +219,32 @@ class MoEMlp(nn.Module):
             "router_kernel", nn.initializers.lecun_normal(),
             (d, self.num_experts), jnp.float32,
         )
-        w_gate = self.param(
-            "w_gate", nn.initializers.lecun_normal(),
-            (self.num_experts, d, self.hidden_dim), self.dtype,
-        )
-        w_up = self.param(
-            "w_up", nn.initializers.lecun_normal(),
-            (self.num_experts, d, self.hidden_dim), self.dtype,
-        )
-        w_down = self.param(
-            "w_down", nn.initializers.lecun_normal(),
-            (self.num_experts, self.hidden_dim, d), self.dtype,
-        )
+        if self.quantized:
+            # int8 weights + per-(expert, out-channel) fp32 scales, filled
+            # by quantize_params (LLAMA_QUANT_PATTERNS matches `moe$`)
+            def qparam(name, k, n):
+                q = self.param(f"{name}_q", nn.initializers.zeros,
+                               (self.num_experts, k, n), jnp.int8)
+                s = self.param(f"{name}_scale", nn.initializers.ones,
+                               (self.num_experts, n), jnp.float32)
+                return q, s
+
+            gate_q, gate_s = qparam("w_gate", d, self.hidden_dim)
+            up_q, up_s = qparam("w_up", d, self.hidden_dim)
+            down_q, down_s = qparam("w_down", self.hidden_dim, d)
+        else:
+            w_gate = self.param(
+                "w_gate", nn.initializers.lecun_normal(),
+                (self.num_experts, d, self.hidden_dim), self.dtype,
+            )
+            w_up = self.param(
+                "w_up", nn.initializers.lecun_normal(),
+                (self.num_experts, d, self.hidden_dim), self.dtype,
+            )
+            w_down = self.param(
+                "w_down", nn.initializers.lecun_normal(),
+                (self.num_experts, self.hidden_dim, d), self.dtype,
+            )
 
         gate_logits = tokens @ router_kernel.astype(tokens.dtype)
         weights, indices, aux_loss = top_k_routing(gate_logits, self.num_selected)
@@ -242,6 +257,21 @@ class MoEMlp(nn.Module):
 
         mask = (combine > 0).astype(self.dtype)
         expert_in = jnp.einsum("te,td->etd", mask, tokens.astype(self.dtype))
-        expert_out = _swiglu_experts(expert_in, w_gate, w_up, w_down)
+        if self.quantized:
+            # int8->bf16 converts fuse into the einsums: HBM reads stay int8
+            gated = jax.nn.silu(
+                jnp.einsum("etd,edh->eth", expert_in, gate_q.astype(self.dtype))
+                * gate_s[:, None, :].astype(self.dtype)
+            )
+            up = (
+                jnp.einsum("etd,edh->eth", expert_in, up_q.astype(self.dtype))
+                * up_s[:, None, :].astype(self.dtype)
+            )
+            expert_out = (
+                jnp.einsum("eth,ehd->etd", gated * up, down_q.astype(self.dtype))
+                * down_s[:, None, :].astype(self.dtype)
+            )
+        else:
+            expert_out = _swiglu_experts(expert_in, w_gate, w_up, w_down)
         out = jnp.einsum("etd,te->td", expert_out, combine)
         return out.reshape(b, s, d).astype(self.dtype), aux_loss
