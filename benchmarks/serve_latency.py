@@ -30,6 +30,13 @@ def serving_config(preset: str):
 
     if preset == "tiny":
         return LlamaConfig.tiny(vocab_size=256)
+    if preset == "serve_moe":
+        # ~1.1B-total-param 8-expert top-2 MoE (~0.4B active per token)
+        return LlamaConfig(
+            vocab_size=128_256, hidden_dim=1024, num_layers=12, num_heads=16,
+            num_kv_heads=8, mlp_dim=2816, max_len=2048,
+            num_experts=8, num_selected=2,
+        )
     # ~1.5B params: Llama-3 geometry scaled to one v5e chip (bf16 ~3 GB)
     return LlamaConfig(
         vocab_size=128_256, hidden_dim=2048, num_layers=20, num_heads=16,
@@ -54,6 +61,7 @@ def main() -> None:
 
     from unionml_tpu.models import (
         LLAMA_QUANT_PATTERNS,
+        LlamaConfig,
         Llama,
         make_generator,
         quantize_params,
@@ -77,7 +85,7 @@ def main() -> None:
 
     for quantized in (False, True):
         if quantized:
-            qcfg = type(cfg)(**{**cfg.__dict__, "quantized": True})
+            qcfg = LlamaConfig(**{**cfg.__dict__, "quantized": True})
             qmodule = Llama(qcfg)
             # quantize from the fp32 masters (the production path), not the
             # bf16 serving copy: scales from bf16 weights double-round

@@ -209,6 +209,26 @@ def test_quantized_moe_matches_fp_module():
     np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
 
 
+def test_quantized_moe_bf16_error_bounded():
+    # production dtype: fp32 accumulate + fp32 scale before the single
+    # bf16 cast must keep the int8 error near the fp32-path bound
+    from unionml_tpu.models import LLAMA_QUANT_PATTERNS, quantize_params
+
+    fp = MoEMlp(num_experts=4, num_selected=2, hidden_dim=64, model_dim=32,
+                dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 32))
+    params = fp.init(jax.random.PRNGKey(1), x)["params"]
+    ref, _ = fp.apply({"params": params}, x)
+    qparams = quantize_params({"moe": params}, LLAMA_QUANT_PATTERNS)["moe"]
+    qmod = MoEMlp(num_experts=4, num_selected=2, hidden_dim=64, model_dim=32,
+                  dtype=jnp.bfloat16, quantized=True)
+    out, _ = qmod.apply({"params": qparams}, x.astype(jnp.bfloat16))
+    rel = float(
+        jnp.linalg.norm(out.astype(jnp.float32) - ref) / jnp.linalg.norm(ref)
+    )
+    assert rel < 0.05, rel
+
+
 def test_quantized_moe_llama_generation():
     from unionml_tpu.models import LLAMA_QUANT_PATTERNS, quantize_params
 

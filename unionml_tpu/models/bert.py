@@ -63,7 +63,9 @@ class BertBlock(nn.Module):
 
         # BERT has no sequence mesh axis: the sequence-parallel impls can
         # never work here
-        supported = tuple(i for i in ATTN_IMPLS if i not in ("ring", "ulysses"))
+        supported = tuple(
+            i for i in ATTN_IMPLS if i not in ("ring", "ring_flash", "ulysses")
+        )
         if cfg.attn_impl not in supported:
             raise ValueError(
                 f"unknown attention impl {cfg.attn_impl!r}; use one of {supported}"

@@ -93,7 +93,9 @@ def rotary_embedding(
 
 # the dispatcher's accepted impl names — validate against this instead of
 # maintaining per-model copies
-ATTN_IMPLS = ("auto", "xla", "blockwise", "flash", "fused", "ring", "ulysses")
+ATTN_IMPLS = (
+    "auto", "xla", "blockwise", "flash", "fused", "ring", "ring_flash", "ulysses"
+)
 
 
 def _run_attention(
@@ -135,6 +137,13 @@ def _run_attention(
 
         assert sequence_axis, "ring attention needs a sequence mesh axis"
         return ring_attention_sharded(q, k, v, axis=sequence_axis, causal=causal)
+    if impl == "ring_flash":
+        from unionml_tpu.ops.ring_attention import ring_flash_attention_sharded
+
+        assert sequence_axis, "ring attention needs a sequence mesh axis"
+        return ring_flash_attention_sharded(
+            q, k, v, axis=sequence_axis, causal=causal
+        )
     if impl == "ulysses":
         from unionml_tpu.ops.ulysses import ulysses_attention_sharded
 

@@ -258,19 +258,19 @@ class MoEMlp(nn.Module):
         mask = (combine > 0).astype(self.dtype)
         expert_in = jnp.einsum("te,td->etd", mask, tokens.astype(self.dtype))
         if self.quantized:
-            # int8->bf16 converts fuse into the einsums: HBM reads stay int8
-            gated = jax.nn.silu(
-                jnp.einsum("etd,edh->eth", expert_in, gate_q.astype(self.dtype))
-                * gate_s[:, None, :].astype(self.dtype)
-            )
-            up = (
-                jnp.einsum("etd,edh->eth", expert_in, up_q.astype(self.dtype))
-                * up_s[:, None, :].astype(self.dtype)
-            )
-            expert_out = (
-                jnp.einsum("eth,ehd->etd", gated * up, down_q.astype(self.dtype))
-                * down_s[:, None, :].astype(self.dtype)
-            )
+            # int8->compute-dtype converts fuse into the einsums (HBM reads
+            # stay int8); accumulate fp32 and apply the fp32 scale BEFORE
+            # the single cast down — same recipe as QuantizedDenseGeneral
+            def qmm(x, w_q, w_s):
+                y = jnp.einsum(
+                    "etd,edh->eth", x, w_q.astype(self.dtype),
+                    preferred_element_type=jnp.float32,
+                )
+                return (y * w_s[:, None, :]).astype(self.dtype)
+
+            gated = jax.nn.silu(qmm(expert_in, gate_q, gate_s))
+            up = qmm(expert_in, up_q, up_s)
+            expert_out = qmm(gated * up, down_q, down_s)
         else:
             expert_out = _swiglu_experts(expert_in, w_gate, w_up, w_down)
         out = jnp.einsum("etd,te->td", expert_out, combine)
