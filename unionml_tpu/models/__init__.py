@@ -24,6 +24,10 @@ from unionml_tpu.models.llama import (
 )
 from unionml_tpu.models.generate import make_generator, make_lm_predictor, serving_params
 from unionml_tpu.models.mlp import Mlp, MlpConfig
+from unionml_tpu.models.sequence_parallel import (
+    sequence_parallel_config,
+    sequence_parallel_lm_step,
+)
 from unionml_tpu.models.pipeline_lm import (
     PIPELINE_PARTITION_RULES,
     create_pipelined_lm_state,
@@ -54,5 +58,6 @@ __all__ = [
     "make_generator", "make_lm_predictor", "serving_params", "adamw",
     "create_pipelined_lm_state", "pipelined_lm_step", "pipelined_lm_apply",
     "to_pipeline_params", "PIPELINE_PARTITION_RULES",
+    "sequence_parallel_config", "sequence_parallel_lm_step",
     "QuantizedDenseGeneral", "quantize_params", "LLAMA_QUANT_PATTERNS",
 ]

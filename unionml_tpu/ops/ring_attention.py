@@ -260,6 +260,11 @@ def _ring_flash_bwd(axis, causal, scale, block_q, block_kv, residuals, g):
     def step(carry, s):
         dq, k_cur, v_cur, dk_cur, dv_cur = carry
         kv_src = (my_idx - s) % n
+        # issue the next kv rotation BEFORE the backward kernels (same as
+        # the forward) so the ICI transfer overlaps the Pallas compute;
+        # only dk/dv depend on this step's accumulation
+        k_nxt = lax.ppermute(k_cur, axis, perm)
+        v_nxt = lax.ppermute(v_cur, axis, perm)
         if causal:
             rel = jnp.where(kv_src < my_idx, 0, jnp.where(kv_src == my_idx, 1, 2))
             dq_i, dk_i, dv_i = lax.switch(
@@ -280,10 +285,8 @@ def _ring_flash_bwd(axis, causal, scale, block_q, block_kv, residuals, g):
         dq = dq + dq_i.astype(dq.dtype)
         dk_cur = dk_cur + dk_i.astype(dk_cur.dtype)
         dv_cur = dv_cur + dv_i.astype(dv_cur.dtype)
-        # rotate kv AND their gradient accumulators together: after the
+        # gradient accumulators rotate with their kv shards: after the
         # full loop both are back at the shard's home device
-        k_nxt = lax.ppermute(k_cur, axis, perm)
-        v_nxt = lax.ppermute(v_cur, axis, perm)
         dk_nxt = lax.ppermute(dk_cur, axis, perm)
         dv_nxt = lax.ppermute(dv_cur, axis, perm)
         return (dq, k_nxt, v_nxt, dk_nxt, dv_nxt), None
