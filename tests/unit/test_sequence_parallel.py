@@ -25,7 +25,7 @@ def _setup(dtype="float32", vocab=64):
     return cfg, module, tokens, params
 
 
-@pytest.mark.parametrize("attn", ["ring", "ring_flash"])
+@pytest.mark.parametrize("attn", ["ring", "ring_flash", "ulysses"])
 def test_sp_step_matches_serial(attn):
     cfg, module, tokens, params = _setup()
     mesh = make_mesh({"data": 2, "sequence": 2}, devices=jax.devices()[:4])
@@ -75,6 +75,13 @@ def test_sp_rejects_bad_configs():
         sequence_parallel_config(
             LlamaConfig.tiny(num_experts=4), attn="ring"
         )
+
+
+def test_sp_ulysses_head_divisibility_checked_eagerly():
+    cfg = LlamaConfig.tiny()  # 4 q heads, 2 kv heads
+    mesh = make_mesh({"data": 2, "sequence": 4}, devices=jax.devices()[:8])
+    with pytest.raises(ValueError, match="kv heads"):
+        sequence_parallel_lm_step(cfg, mesh=mesh, attn="ulysses")
 
 
 def test_sp_sequence_only_mesh():

@@ -148,7 +148,12 @@ def _run_attention(
         from unionml_tpu.ops.ulysses import ulysses_attention_sharded
 
         assert sequence_axis, "ulysses attention needs a sequence mesh axis"
-        return ulysses_attention_sharded(q, k, v, axis=sequence_axis, causal=causal)
+        # the inner attention sees the FULL gathered sequence: "auto"
+        # (fused short / flash long) keeps it memory-efficient instead of
+        # materializing O(S^2) scores at the lengths SP targets
+        return ulysses_attention_sharded(
+            q, k, v, axis=sequence_axis, causal=causal, impl="auto"
+        )
     raise ValueError(f"unknown attention impl {impl!r}")
 
 

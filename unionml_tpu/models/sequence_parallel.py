@@ -2,11 +2,13 @@
 
 Long-context training (SURVEY.md §5.7): activations — not parameters —
 are the memory bottleneck, so the sequence dimension shards over the
-mesh's ``sequence`` axis and attention runs as a ring (``attn_impl=
-"ring"`` or the Pallas-local ``"ring_flash"``, ops/ring_attention.py).
+mesh's ``sequence`` axis and attention runs sequence-sharded: a ring
+(``attn_impl="ring"`` or the Pallas-local ``"ring_flash"``,
+ops/ring_attention.py — ppermute KV rotation) or ``"ulysses"``
+(ops/ulysses.py — an all_to_all head<->sequence reshuffle each way).
 Everything else in the decoder is position-local (embedding, RMSNorm,
-MLP, lm_head), so the whole forward runs on [B, S/n] shards with the
-ring as the only cross-shard exchange.
+MLP, lm_head), so attention's collectives are the only cross-shard
+exchange in the whole forward.
 
 Mechanics:
 
@@ -40,9 +42,16 @@ from unionml_tpu.models.train import TrainState
 def sequence_parallel_config(
     cfg: LlamaConfig, *, attn: str = "ring", seq_axis: str = "sequence"
 ) -> LlamaConfig:
-    """The same model with ring attention bound to the sequence axis."""
-    if attn not in ("ring", "ring_flash"):
-        raise ValueError(f"sequence-parallel attention must be ring/ring_flash, got {attn!r}")
+    """The same model with sequence-sharded attention bound to the axis.
+
+    ``attn``: ``"ring"`` / ``"ring_flash"`` (ppermute KV rotation) or
+    ``"ulysses"`` (all-to-all head<->sequence reshuffle; requires q AND
+    kv head counts divisible by the axis size).
+    """
+    if attn not in ("ring", "ring_flash", "ulysses"):
+        raise ValueError(
+            f"sequence-parallel attention must be ring/ring_flash/ulysses, got {attn!r}"
+        )
     if cfg.num_experts:
         raise NotImplementedError(
             "sequence-parallel MoE is not supported: aux losses sown inside "
@@ -74,6 +83,14 @@ def sequence_parallel_lm_step(
     from jax.sharding import PartitionSpec as P
 
     sp_cfg = sequence_parallel_config(cfg, attn=attn, seq_axis=seq_axis)
+    n_seq = mesh.shape[seq_axis]
+    if attn == "ulysses" and (cfg.num_heads % n_seq or (cfg.num_kv_heads or cfg.num_heads) % n_seq):
+        # fail at config time, not deep inside jit tracing
+        raise ValueError(
+            f"ulysses needs q heads ({cfg.num_heads}) and kv heads "
+            f"({cfg.num_kv_heads}) divisible by the sequence axis size "
+            f"({n_seq}); use ring/ring_flash instead"
+        )
     module = Llama(sp_cfg)
     axes = (data_axis, seq_axis) if data_axis else (seq_axis,)
 
