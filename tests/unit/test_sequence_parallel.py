@@ -93,3 +93,44 @@ def test_sp_sequence_only_mesh():
     )
     state, metrics = step(state, tokens)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_sp_step_through_model_spec():
+    """Full-stack: @dataset.reader -> @model.train_step(sequence-parallel)
+    -> model.train() — the SP step is a plain (state, batch) step, so the
+    spec-level trainer loop drives it unchanged."""
+    from unionml_tpu import Dataset, Model
+
+    cfg = LlamaConfig.tiny(vocab_size=97)
+    module = Llama(cfg)
+    mesh = make_mesh({"data": 2, "sequence": 2}, devices=jax.devices()[:4])
+
+    dataset = Dataset(name="sp_tokens", targets=[])
+
+    @dataset.reader
+    def reader(n: int = 32) -> np.ndarray:
+        rng = np.random.default_rng(0)
+        return rng.integers(0, 97, size=(n, 32)).astype(np.int32)
+
+    model = Model(
+        name="sp_lm",
+        init=lambda: create_train_state(
+            module, jnp.zeros((1, 8), jnp.int32), learning_rate=5e-3
+        ),
+        dataset=dataset,
+    )
+    model.train_step(
+        sequence_parallel_lm_step(cfg, mesh=mesh), donate_state=False
+    )
+
+    @model.evaluator
+    def evaluator(state, features, targets=None) -> float:
+        _, metrics = sequence_parallel_lm_step(cfg, mesh=mesh)(
+            state, jnp.asarray(features)
+        )
+        return float(metrics["loss"])
+
+    state, metrics = model.train(
+        trainer_kwargs={"num_epochs": 4, "batch_size": 16}, n=32
+    )
+    assert np.isfinite(metrics["train"])

@@ -84,11 +84,12 @@ def sequence_parallel_lm_step(
 
     sp_cfg = sequence_parallel_config(cfg, attn=attn, seq_axis=seq_axis)
     n_seq = mesh.shape[seq_axis]
-    if attn == "ulysses" and (cfg.num_heads % n_seq or (cfg.num_kv_heads or cfg.num_heads) % n_seq):
+    kv_heads = cfg.num_kv_heads or cfg.num_heads
+    if attn == "ulysses" and (cfg.num_heads % n_seq or kv_heads % n_seq):
         # fail at config time, not deep inside jit tracing
         raise ValueError(
             f"ulysses needs q heads ({cfg.num_heads}) and kv heads "
-            f"({cfg.num_kv_heads}) divisible by the sequence axis size "
+            f"({kv_heads}) divisible by the sequence axis size "
             f"({n_seq}); use ring/ring_flash instead"
         )
     module = Llama(sp_cfg)
