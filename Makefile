@@ -21,6 +21,7 @@ bench:
 bench-all: bench
 	python benchmarks/train_throughput.py
 	python benchmarks/serve_latency.py
+	UNIONML_TPU_BENCH_PRESET=serve_moe python benchmarks/serve_latency.py
 	python benchmarks/attn_kernels.py
 
 notebooks:

@@ -119,15 +119,13 @@ def test_sp_step_through_model_spec():
         ),
         dataset=dataset,
     )
-    model.train_step(
-        sequence_parallel_lm_step(cfg, mesh=mesh), donate_state=False
-    )
+    sp_step = sequence_parallel_lm_step(cfg, mesh=mesh)
+    model.train_step(sp_step, donate_state=False)
+    eval_step = jax.jit(sp_step)  # one jitted instance reused per eval call
 
     @model.evaluator
     def evaluator(state, features, targets=None) -> float:
-        _, metrics = sequence_parallel_lm_step(cfg, mesh=mesh)(
-            state, jnp.asarray(features)
-        )
+        _, metrics = eval_step(state, jnp.asarray(features))
         return float(metrics["loss"])
 
     state, metrics = model.train(

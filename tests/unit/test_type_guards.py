@@ -225,3 +225,58 @@ def test_guard_feature_transformer():
 
     with pytest.raises(SignatureError):
         type_guards.guard_feature_transformer(bad)
+
+
+# --------------------------------------------------------------------- #
+# train_step guard (TPU-native tier)
+# --------------------------------------------------------------------- #
+
+def test_guard_train_step_accepts_valid_signatures():
+    from unionml_tpu.type_guards import guard_train_step
+
+    guard_train_step(lambda state, batch: (state, {}))
+
+    def with_defaults(state, batch, lr=0.1):
+        return state, {}
+
+    guard_train_step(with_defaults)
+
+    def passthrough(*args):
+        return args
+
+    guard_train_step(passthrough)
+
+
+def test_guard_train_step_rejects_bad_signatures():
+    import pytest
+
+    from unionml_tpu.type_guards import SignatureError, guard_train_step
+
+    with pytest.raises(SignatureError, match="train_step"):
+        guard_train_step(lambda state: (state, {}))
+    with pytest.raises(SignatureError, match="train_step"):
+        guard_train_step(lambda a, b, c: (a, {}))
+
+    def kw_only(state, batch, *, lr):
+        return state, {}
+
+    # a required keyword-only arg would crash at the first trainer call
+    with pytest.raises(SignatureError, match="train_step"):
+        guard_train_step(kw_only)
+
+
+def test_model_train_step_registration_guard():
+    import pytest
+
+    from unionml_tpu import Dataset, Model
+    from unionml_tpu.type_guards import SignatureError
+
+    dataset = Dataset(name="g")
+
+    @dataset.reader
+    def reader() -> dict:
+        return {}
+
+    model = Model(name="g", init=dict, dataset=dataset)
+    with pytest.raises(SignatureError, match="train_step"):
+        model.train_step(lambda onlystate: (onlystate, {}))

@@ -304,6 +304,7 @@ class Model(TrackedInstance):
             return lambda f: self.train_step(
                 f, sharding=sharding, donate_state=donate_state, **train_task_kwargs
             )
+        type_guards.guard_train_step(fn)
         self._train_step = fn
         self._train_step_options = {"sharding": sharding, "donate_state": donate_state}
         self._trainer = self._make_step_trainer()

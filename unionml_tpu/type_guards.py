@@ -200,6 +200,39 @@ def guard_trainer(
             )
 
 
+def guard_train_step(step: Callable) -> None:
+    """A jittable step must accept exactly (state, batch) positionally.
+
+    No reference counterpart (train_step is the TPU-native tier); same
+    decoration-time contract philosophy as the reference's guards —
+    misregistered steps fail at registration with a named error, not at
+    first jit trace.
+    """
+    sig = signature(step)
+    all_params = list(sig.parameters.values())
+    params = [
+        p
+        for p in all_params
+        if p.kind in (Parameter.POSITIONAL_ONLY, Parameter.POSITIONAL_OR_KEYWORD)
+    ]
+    has_var_pos = any(p.kind is Parameter.VAR_POSITIONAL for p in all_params)
+    required = [p for p in params if p.default is Parameter.empty]
+    required_kw_only = [
+        p
+        for p in all_params
+        if p.kind is Parameter.KEYWORD_ONLY and p.default is Parameter.empty
+    ]
+    if (
+        len(required) > 2
+        or (len(params) < 2 and not has_var_pos)
+        or required_kw_only
+    ):
+        raise SignatureError(
+            f"'train_step' must be callable as step(state, batch) -> "
+            f"(state, metrics); got signature {sig}."
+        )
+
+
 def guard_evaluator(
     evaluator: Callable, expected_model_type: Any, expected_data_types: Iterable[Any]
 ) -> None:
