@@ -152,3 +152,38 @@ def test_batched_serving_end_to_end(trained_model):
         assert r.status_code == 200
     finally:
         app.shutdown()
+
+
+def test_microbatcher_ragged_prompt_lists():
+    """LLM-style predictors take a LIST of ragged token-id rows; the
+    batcher must coalesce by list concat (no array padding) and split
+    results per request."""
+    from unionml_tpu.serving.batcher import MicroBatcher
+
+    calls = []
+
+    def predict(prompts):
+        calls.append(len(prompts))
+        # echo generation: per-row output depends only on that row
+        return [[int(t) + 1 for t in row][-2:] for row in prompts]
+
+    batcher = MicroBatcher(
+        predict, max_batch_size=8, max_wait_ms=30.0, row_lists=True
+    )
+    try:
+        # deterministic: ONE multi-row ragged request -> one bucketed
+        # device call, results split per row
+        out = batcher.submit([[1, 2, 3], [4, 5], [6, 7, 8, 9]])
+        assert out == [[3, 4], [5, 6], [9, 10]]
+        assert calls == [4]  # bucketed 3 -> 4 with a replicated pad row
+
+        # concurrent ragged single-row requests: correct per-request splits
+        import concurrent.futures as cf
+
+        prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [10]]
+        with cf.ThreadPoolExecutor(4) as pool:
+            futs = [pool.submit(batcher.submit, [p]) for p in prompts]
+            results = [f.result(timeout=30) for f in futs]
+        assert results == [[[3, 4]], [[5, 6]], [[9, 10]], [[11]]]
+    finally:
+        batcher.close()

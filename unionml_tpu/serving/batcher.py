@@ -30,21 +30,32 @@ from unionml_tpu._logging import logger
 DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 
 
-def _leading_dim(features: Any) -> int:
+def _leading_dim(features: Any, row_lists: bool) -> int:
     import jax
 
+    if row_lists:
+        return len(features)
     leaves = jax.tree_util.tree_leaves(features)
     return int(leaves[0].shape[0]) if leaves else 0
 
 
-def _concat(items: Sequence[Any]) -> Any:
+def _concat(items: Sequence[Any], row_lists: bool) -> Any:
     import jax
 
+    if row_lists:
+        out: list = []
+        for i in items:
+            out.extend(i)
+        return out
     return jax.tree_util.tree_map(lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0), *items)
 
 
-def _pad_to(features: Any, n: int) -> Any:
+def _pad_to(features: Any, n: int, row_lists: bool) -> Any:
     import jax
+
+    if row_lists:
+        # replicate the last row; the split below drops padded results
+        return list(features) + [features[-1]] * (n - len(features))
 
     def pad(x):
         x = np.asarray(x)
@@ -56,9 +67,14 @@ def _pad_to(features: Any, n: int) -> Any:
     return jax.tree_util.tree_map(pad, features)
 
 
-def _slice_rows(result: Any, start: int, stop: int) -> Any:
+def _slice_rows(result: Any, start: int, stop: int, row_lists: bool) -> Any:
     import jax
 
+    if row_lists or isinstance(result, list):
+        # plain lists are batches of per-row outputs (scalars or ragged
+        # sequences); tuples/dicts remain pytree structure unless the
+        # batcher is in row-list mode
+        return list(result)[start:stop]
     return jax.tree_util.tree_map(lambda x: np.asarray(x)[start:stop], result)
 
 
@@ -81,8 +97,14 @@ class MicroBatcher:
         max_batch_size: int = 64,
         max_wait_ms: float = 5.0,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
+        row_lists: bool = False,
     ):
+        """``row_lists=True``: features/results are plain Python lists of
+        per-example rows (possibly ragged — LLM token-id prompts), so the
+        batcher coalesces by list concat instead of array concat. Use for
+        predictors with the make_lm_predictor contract."""
         self._predict_fn = predict_fn
+        self.row_lists = row_lists
         self.max_batch_size = max_batch_size
         self.max_wait_s = max_wait_ms / 1000.0
         self.buckets = tuple(sorted(set(buckets) | {max_batch_size}))
@@ -99,7 +121,9 @@ class MicroBatcher:
 
     def submit(self, features: Any, timeout: Optional[float] = 60.0) -> Any:
         """Block until the batched prediction for ``features`` is ready."""
-        pending = _Pending(features=features, rows=_leading_dim(features))
+        pending = _Pending(
+            features=features, rows=_leading_dim(features, self.row_lists)
+        )
         self._queue.put(pending)
         if not pending.event.wait(timeout):
             raise TimeoutError("micro-batcher did not produce a result in time")
@@ -153,7 +177,8 @@ class MicroBatcher:
             if not batch:
                 continue
             try:
-                feats = _concat([p.features for p in batch])
+                rl = self.row_lists
+                feats = _concat([p.features for p in batch], rl)
                 total = sum(p.rows for p in batch)
                 # a single request may exceed the largest bucket: chunk the
                 # device calls so every call still hits a bucketed shape
@@ -161,18 +186,16 @@ class MicroBatcher:
                 parts = []
                 for start in range(0, total, cap):
                     stop = min(start + cap, total)
-                    chunk = _slice_rows(feats, start, stop) if total > cap else feats
-                    padded = _pad_to(chunk, self._bucket(stop - start))
+                    chunk = (
+                        _slice_rows(feats, start, stop, rl) if total > cap else feats
+                    )
+                    padded = _pad_to(chunk, self._bucket(stop - start), rl)
                     out = self._predict_fn(padded)
-                    if isinstance(out, list):
-                        # predictors returning plain lists: the list IS the
-                        # batch axis, not a pytree of per-example outputs
-                        out = np.asarray(out)
-                    parts.append(_slice_rows(out, 0, stop - start))
-                result = _concat(parts) if len(parts) > 1 else parts[0]
+                    parts.append(_slice_rows(out, 0, stop - start, rl))
+                result = _concat(parts, rl) if len(parts) > 1 else parts[0]
                 offset = 0
                 for p in batch:
-                    p.result = _slice_rows(result, offset, offset + p.rows)
+                    p.result = _slice_rows(result, offset, offset + p.rows, rl)
                     offset += p.rows
             except BaseException as exc:  # surface errors to every waiter
                 logger.info(f"micro-batcher error: {exc!r}")
