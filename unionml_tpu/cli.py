@@ -161,7 +161,11 @@ def fetch_model(app_str: str, output: str, app_version, model_version: str):
 @click.option("--host", default="127.0.0.1")
 @click.option("--port", default=8000)
 @click.option("--batch/--no-batch", default=False, help="enable the on-device micro-batcher")
-def serve(app_str: str, model_path, host: str, port: int, batch: bool):
+@click.option(
+    "--row-lists/--no-row-lists", default=False,
+    help="batch plain lists of ragged rows (LLM token-id prompts) by list concat",
+)
+def serve(app_str: str, model_path, host: str, port: int, batch: bool, row_lists: bool):
     """Serve an app over HTTP (reference: cli.py:172-212).
 
     APP is ``module:variable`` naming a Model or a ServingApp.
@@ -174,8 +178,11 @@ def serve(app_str: str, model_path, host: str, port: int, batch: bool):
     from unionml_tpu.model import Model
     from unionml_tpu.serving.http import ServingApp
 
+    if row_lists and not batch:
+        batch = True  # row-list mode only exists inside the micro-batcher
+        click.echo("--row-lists implies --batch; enabling the micro-batcher")
     if isinstance(target, Model):
-        serving = ServingApp(target, batch=batch)
+        serving = ServingApp(target, batch=batch, row_lists=row_lists)
     elif isinstance(target, ServingApp):
         serving = target
     else:

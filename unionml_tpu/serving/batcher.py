@@ -31,31 +31,30 @@ DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 
 
 def _leading_dim(features: Any, row_lists: bool) -> int:
-    import jax
-
     if row_lists:
         return len(features)
+    import jax
+
     leaves = jax.tree_util.tree_leaves(features)
     return int(leaves[0].shape[0]) if leaves else 0
 
 
 def _concat(items: Sequence[Any], row_lists: bool) -> Any:
-    import jax
-
     if row_lists:
         out: list = []
         for i in items:
             out.extend(i)
         return out
+    import jax
+
     return jax.tree_util.tree_map(lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0), *items)
 
 
 def _pad_to(features: Any, n: int, row_lists: bool) -> Any:
-    import jax
-
     if row_lists:
         # replicate the last row; the split below drops padded results
         return list(features) + [features[-1]] * (n - len(features))
+    import jax
 
     def pad(x):
         x = np.asarray(x)
@@ -68,13 +67,12 @@ def _pad_to(features: Any, n: int, row_lists: bool) -> Any:
 
 
 def _slice_rows(result: Any, start: int, stop: int, row_lists: bool) -> Any:
+    if row_lists:
+        return list(result)[start:stop]
     import jax
 
-    if row_lists or isinstance(result, list):
-        # plain lists are batches of per-row outputs (scalars or ragged
-        # sequences); tuples/dicts remain pytree structure unless the
-        # batcher is in row-list mode
-        return list(result)[start:stop]
+    # array mode: lists/tuples/dicts are pytree STRUCTURE; every leaf
+    # slices along its batch axis
     return jax.tree_util.tree_map(lambda x: np.asarray(x)[start:stop], result)
 
 
@@ -191,6 +189,11 @@ class MicroBatcher:
                     )
                     padded = _pad_to(chunk, self._bucket(stop - start), rl)
                     out = self._predict_fn(padded)
+                    if not rl and isinstance(out, list):
+                        # array mode normalizes plain-list outputs (the
+                        # list IS the batch axis) so chunk concat/slice
+                        # keep batch semantics
+                        out = np.asarray(out)
                     parts.append(_slice_rows(out, 0, stop - start, rl))
                 result = _concat(parts, rl) if len(parts) > 1 else parts[0]
                 offset = 0
