@@ -158,6 +158,43 @@ def test_lm_predictor_sizes_cache_per_bucket(tiny_llama, monkeypatch):
     np.testing.assert_array_equal(np.asarray(out[0]), ref[0])
 
 
+def test_top_p_sampling_restricts_to_nucleus(tiny_llama):
+    module, params = tiny_llama
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    # find the greedy continuation: with a tight nucleus every sampled
+    # token must stay inside the few top-probability tokens
+    greedy = make_generator(module, max_new_tokens=1, max_len=16)
+    logits_top = int(np.asarray(greedy(params, prompt))[0, 0])
+
+    gen = make_generator(
+        module, max_new_tokens=1, max_len=16, temperature=1.0, top_p=1e-6
+    )
+    # top_p so tight only the argmax survives: sampling becomes greedy
+    for seed in range(5):
+        out = gen(params, prompt, jax.random.PRNGKey(seed))
+        assert int(np.asarray(out)[0, 0]) == logits_top
+
+    # permissive nucleus still yields valid tokens and varies across keys
+    gen_loose = make_generator(
+        module, max_new_tokens=4, max_len=16, temperature=1.0, top_p=0.9
+    )
+    outs = {
+        tuple(np.asarray(gen_loose(params, prompt, jax.random.PRNGKey(s)))[0])
+        for s in range(8)
+    }
+    assert len(outs) > 1  # actually sampling
+
+
+def test_top_p_validation():
+    from unionml_tpu.models import Llama, LlamaConfig
+
+    module = Llama(LlamaConfig.tiny())
+    with pytest.raises(ValueError, match="top_p"):
+        make_generator(module, max_new_tokens=1, top_p=0.0)
+    with pytest.raises(ValueError, match="top_p"):
+        make_generator(module, max_new_tokens=1, top_p=1.5)
+
+
 def test_serving_params_casts_floats_only():
     from unionml_tpu.models import serving_params
 

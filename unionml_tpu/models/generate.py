@@ -17,7 +17,8 @@ Design:
   whole generation is a single XLA program with static shapes —
   recompiles happen per (batch, prompt_len, max_new_tokens) bucket only;
 - **sampling** is greedy at ``temperature=0`` else temperature softmax
-  with optional top-k, driven by a threaded PRNG key;
+  with optional top-k and/or nucleus top-p filters, driven by a threaded
+  PRNG key;
 - **eos** handling keeps shapes static: once a sequence emits
   ``eos_id`` every later token becomes ``pad_id`` and generation simply
   runs out the scan (correct, just not early-exiting — the standard
@@ -45,6 +46,7 @@ def make_generator(
     max_len: Optional[int] = None,
     temperature: float = 0.0,
     top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     eos_id: Optional[int] = None,
     pad_id: int = 0,
 ) -> Callable:
@@ -53,9 +55,17 @@ def make_generator(
     ``tokens``: int32 [B, prompt_len] (equal lengths per call). The
     returned function is jit-compiled; XLA caches one executable per
     (batch, prompt_len) shape.
+
+    Sampling: greedy at ``temperature == 0``; otherwise categorical over
+    temperature-scaled logits, optionally filtered by ``top_k`` and/or
+    nucleus ``top_p`` (keep the smallest prefix of
+    probability-descending tokens whose mass reaches ``top_p``; the
+    filters compose — top_k first, then top_p over the survivors).
     """
     cfg: LlamaConfig = module.config
     total_len = max_len or cfg.max_len
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
 
     def sample(logits: jnp.ndarray, key) -> jnp.ndarray:
         """logits [B, V] -> token [B]."""
@@ -66,6 +76,19 @@ def make_generator(
             top_vals, _ = jax.lax.top_k(scaled, top_k)
             cutoff = top_vals[:, -1:]
             scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+        if top_p is not None and top_p < 1.0:
+            probs = jax.nn.softmax(scaled, axis=-1)
+            sort_idx = jnp.argsort(probs, axis=-1)[:, ::-1]        # descending
+            sorted_probs = jnp.take_along_axis(probs, sort_idx, axis=-1)
+            cum = jnp.cumsum(sorted_probs, axis=-1)
+            # keep the smallest prefix whose mass reaches top_p: a sorted
+            # position survives iff the mass BEFORE it is < top_p. Masking
+            # by position (not probability value) keeps the nucleus
+            # bounded even when many tokens tie at the cutoff.
+            keep_sorted = (cum - sorted_probs) < top_p
+            inv = jnp.argsort(sort_idx, axis=-1)
+            keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+            scaled = jnp.where(keep, scaled, -jnp.inf)
         return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
     def generate(params, tokens: jnp.ndarray, key=None, prompt_mask=None) -> jnp.ndarray:
