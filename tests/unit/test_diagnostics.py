@@ -167,6 +167,21 @@ def test_checkpoint_manager_sync_mode(tmp_path):
         assert mgr._steps() == [7]
 
 
+def test_checkpoint_manager_keep_all_and_validation(tmp_path):
+    from unionml_tpu.checkpoint.sharded import CheckpointManager
+
+    # max_to_keep=0 means "disable rotation", not "delete everything"
+    with CheckpointManager(
+        str(tmp_path / "ck"), max_to_keep=0, async_save=False
+    ) as mgr:
+        for s in (1, 2, 3, 4):
+            mgr.save(s, {"w": jnp.full((2,), float(s))})
+        mgr.wait()
+        assert mgr._steps() == [1, 2, 3, 4]
+    with pytest.raises(ValueError, match="max_to_keep"):
+        CheckpointManager(str(tmp_path / "bad"), max_to_keep=-1)
+
+
 def test_elastic_fresh_run_no_checkpoint(tmp_path):
     from unionml_tpu.elastic import run_elastic_trainer
 

@@ -206,6 +206,45 @@ def test_serving_params_casts_floats_only():
     assert cast["q"].dtype == jnp.int8
 
 
+def test_serving_params_preserves_quantization_metadata():
+    """Scales and the MoE router stay fp32 through the serving cast.
+
+    The dequant contract applies the fp32 scale BEFORE the single cast
+    down; bf16-rounding the scales (or the fp32 router master) would make
+    quantize-then-cast disagree with the benchmarked cast-then-quantize
+    order (ADVICE round 1: templates/llm_serving applies serving_params
+    after quantize_params).
+    """
+    from unionml_tpu.models import serving_params
+
+    tree = {
+        "dense": {"kernel_q": jnp.ones((2, 2), jnp.int8),
+                  "scale": jnp.ones((2,), jnp.float32)},
+        "moe": {"w_gate_q": jnp.ones((2, 2, 2), jnp.int8),
+                "w_gate_scale": jnp.ones((2, 2), jnp.float32),
+                "router_kernel": jnp.ones((2, 4), jnp.float32)},
+        "attn": {"kernel": jnp.ones((2, 2), jnp.float32)},
+    }
+    cast = serving_params(tree)
+    assert cast["dense"]["scale"].dtype == jnp.float32
+    assert cast["moe"]["w_gate_scale"].dtype == jnp.float32
+    assert cast["moe"]["router_kernel"].dtype == jnp.float32
+    assert cast["attn"]["kernel"].dtype == jnp.bfloat16
+    assert cast["dense"]["kernel_q"].dtype == jnp.int8
+
+    # a norm param also named "scale" has no int8 sibling -> it DOES cast
+    norm_tree = {"norm": {"scale": jnp.ones((2,), jnp.float32),
+                          "bias": jnp.zeros((2,), jnp.float32)}}
+    assert serving_params(norm_tree)["norm"]["scale"].dtype == jnp.bfloat16
+    # bare-array input (no containing dict) still casts
+    assert serving_params(jnp.ones((3,), jnp.float32)).dtype == jnp.bfloat16
+    # FrozenDict input is accepted
+    import flax.core
+
+    frozen = flax.core.freeze(tree)
+    assert serving_params(frozen)["dense"]["scale"].dtype == jnp.float32
+
+
 def test_generation_rejects_cache_overflow(tiny_llama):
     module, params = tiny_llama
     gen = make_generator(module, max_new_tokens=8, max_len=12)

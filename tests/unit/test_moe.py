@@ -254,3 +254,39 @@ def test_moe_llama_generation():
     out = generate(params, jnp.asarray([[1, 2, 3, 4]], jnp.int32))
     assert out.shape == (1, 4)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_migrate_moe_router_params_old_layout_restores():
+    """Old Dense-submodule router checkpoints rename to router_kernel.
+
+    PARITY.md documents the layout break; the helper must produce a tree
+    MoEMlp.apply accepts, keep the router fp32, and drop the old bias.
+    """
+    from unionml_tpu.ops import migrate_moe_router_params
+
+    module = MoEMlp(num_experts=4, num_selected=2, hidden_dim=8, model_dim=8)
+    x = jnp.ones((1, 3, 8), jnp.bfloat16)
+    params = module.init(jax.random.PRNGKey(0), x)["params"]
+
+    # reconstruct the pre-round-1 layout: router as a Dense submodule
+    old = {k: v for k, v in params.items() if k != "router_kernel"}
+    old["router"] = {
+        "kernel": params["router_kernel"].astype(jnp.bfloat16),
+        "bias": jnp.zeros((4,), jnp.bfloat16),
+    }
+    nested_old = {"block_0": {"moe": old}, "head": {"kernel": jnp.ones((8, 2))}}
+
+    # old flax artifacts are often FrozenDicts — the helper must recurse
+    # through any Mapping, not just plain dicts
+    import flax.core
+
+    migrated = migrate_moe_router_params(flax.core.freeze(nested_old))
+    new_moe = migrated["block_0"]["moe"]
+    assert "router" not in new_moe
+    assert new_moe["router_kernel"].dtype == jnp.float32
+    # untouched siblings survive
+    np.testing.assert_array_equal(
+        np.asarray(migrated["head"]["kernel"]), np.ones((8, 2))
+    )
+    out, aux = module.apply({"params": new_moe}, x)
+    assert out.shape == x.shape and np.isfinite(float(aux))

@@ -62,7 +62,8 @@ def restore_sharded(path: Union[str, os.PathLike], target: Any = None, *, step: 
 class CheckpointManager:
     """Step-indexed checkpoint rotation for training loops.
 
-    Keeps the most recent ``max_to_keep`` step checkpoints under ``root``;
+    Keeps the most recent ``max_to_keep`` step checkpoints under ``root``
+    (``0`` or ``None`` disables rotation and keeps every checkpoint);
     ``latest_step()`` enables deterministic resume (SURVEY.md §5.3).
     Pruning runs only after pending writes commit, so the number of
     *durable* checkpoints never drops below ``max_to_keep`` (one extra
@@ -81,6 +82,8 @@ class CheckpointManager:
         max_to_keep: int = 3,
         async_save: bool = True,
     ):
+        if max_to_keep is not None and max_to_keep < 0:
+            raise ValueError(f"max_to_keep must be >= 0 or None, got {max_to_keep}")
         self.root = Path(root).absolute()
         self.max_to_keep = max_to_keep
         self.async_save = async_save
@@ -108,6 +111,10 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def _prune(self) -> None:
+        # 0/None mean "keep everything" (without this, -0 makes the slice
+        # [:None] and every committed checkpoint would be deleted)
+        if not self.max_to_keep:
+            return
         # only ever called right after wait_until_finished: every step dir
         # is committed, so deleting down to max_to_keep never drops the
         # durable count below max_to_keep even if the process dies now

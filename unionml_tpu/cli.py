@@ -184,6 +184,12 @@ def serve(app_str: str, model_path, host: str, port: int, batch: bool, row_lists
     if isinstance(target, Model):
         serving = ServingApp(target, batch=batch, row_lists=row_lists)
     elif isinstance(target, ServingApp):
+        if batch or row_lists:
+            click.echo(
+                "warning: --batch/--row-lists are ignored when APP is a "
+                "pre-built ServingApp — its own batcher settings take "
+                "precedence (construct the ServingApp with batch=/row_lists=)"
+            )
         serving = target
     else:
         raise click.ClickException(

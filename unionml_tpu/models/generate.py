@@ -239,9 +239,38 @@ def serving_params(params, dtype=jnp.bfloat16):
     them re-reads (and casts) the fp32 tree every step. A one-time cast
     to ``dtype`` halves decode weight traffic (~12% p50 on the 1.5B
     serving config, one v5e chip). Integer leaves (e.g. int8 ``kernel_q``)
-    pass through unchanged.
+    pass through unchanged, and so does quantization metadata that is
+    fp32 *by contract*: per-channel ``scale`` / ``*_scale`` leaves (the
+    dequant contract is "apply the fp32 scale, then one cast down") and
+    the MoE ``router_kernel`` (kept fp32 so tiny routing updates don't
+    round to zero) — so quantize-then-cast and cast-then-quantize agree.
     """
-    return jax.tree_util.tree_map(
-        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
-        params,
-    )
+
+    from collections.abc import Mapping
+
+    def cast_leaf(x):
+        return x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
+
+    def walk(node):
+        if isinstance(node, Mapping):
+            out = {}
+            for k, v in node.items():
+                if isinstance(v, Mapping) or not hasattr(v, "dtype"):
+                    out[k] = walk(v)
+                    continue
+                # a scale is quant metadata only next to its int8 sibling
+                # (QuantizedDenseGeneral: kernel_q+scale; MoE experts:
+                # w_*_q + w_*_scale) — norm params also named "scale" cast
+                is_quant_scale = (k == "scale" and "kernel_q" in node) or (
+                    k.endswith("_scale") and f"{k[: -len('_scale')]}_q" in node
+                )
+                if k == "router_kernel" or is_quant_scale:
+                    out[k] = v
+                else:
+                    out[k] = cast_leaf(v)
+            return out
+        if hasattr(node, "dtype"):
+            return cast_leaf(node)
+        return jax.tree_util.tree_map(cast_leaf, node)
+
+    return walk(params)

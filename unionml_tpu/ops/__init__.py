@@ -23,6 +23,7 @@ from unionml_tpu.ops.moe import (
     expert_parallel_moe,
     expert_parallel_moe_sharded,
     make_dispatch,
+    migrate_moe_router_params,
     top_k_routing,
 )
 
@@ -30,4 +31,5 @@ __all__ = [
     "attention", "blockwise_attention", "mha_reference",
     "MoEMlp", "top_k_routing", "make_dispatch", "expert_capacity",
     "expert_parallel_moe", "expert_parallel_moe_sharded",
+    "migrate_moe_router_params",
 ]

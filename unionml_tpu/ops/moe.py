@@ -275,3 +275,35 @@ class MoEMlp(nn.Module):
             expert_out = _swiglu_experts(expert_in, w_gate, w_up, w_down)
         out = jnp.einsum("etd,te->td", expert_out, combine)
         return out.reshape(b, s, d).astype(self.dtype), aux_loss
+
+
+def migrate_moe_router_params(params):
+    """Rename old-layout MoE router params to the current layout.
+
+    ``MoEMlp``'s router used to be an ``nn.Dense`` submodule, stored as
+    ``{'router': {'kernel': ...}}``; it is now a direct fp32
+    ``router_kernel`` param (routing updates are tiny and round to zero in
+    bf16, so the master copy must stay fp32). Checkpoints saved under the
+    old layout fail to restore with a param-tree mismatch — pass their
+    params through this helper first. Works on whole-model trees: every
+    nested ``{'router': {'kernel': ...}}`` is rewritten in a copied tree;
+    an old ``router/bias`` is dropped (the current router is bias-free).
+    Accepts any Mapping (plain dicts, ``flax.core.FrozenDict``, …) and
+    returns plain nested dicts.
+    """
+    from collections.abc import Mapping
+
+    if not isinstance(params, Mapping):
+        return params
+    out = {}
+    for k, v in params.items():
+        if (
+            k == "router"
+            and isinstance(v, Mapping)
+            and set(v) <= {"kernel", "bias"}
+            and "kernel" in v
+        ):
+            out["router_kernel"] = jnp.asarray(v["kernel"], jnp.float32)
+        else:
+            out[k] = migrate_moe_router_params(v)
+    return out
