@@ -23,7 +23,13 @@ Mechanics:
   full sequence (last global position gets ``ignore_id``), so the
   shard-boundary token never needs a neighbor exchange;
 - the loss is a masked-CE ratio of two ``psum``s (token sums over both
-  mesh axes), replicated on every device.
+  mesh axes), replicated on every device;
+- MoE composes: each ``MoEMlp`` sows its token-mean routing/gate
+  fractions (``moe_stats``); the step pmeans them over the mesh axes and
+  re-forms the load-balance loss ``E * sum(rf * gf)`` from the GLOBAL
+  fractions — exactly serial ``lm_step``'s aux, since the fractions are
+  token means over equal-size shards (a mean of per-shard aux products
+  would NOT match).
 """
 
 from __future__ import annotations
@@ -52,11 +58,6 @@ def sequence_parallel_config(
         raise ValueError(
             f"sequence-parallel attention must be ring/ring_flash/ulysses, got {attn!r}"
         )
-    if cfg.num_experts:
-        raise NotImplementedError(
-            "sequence-parallel MoE is not supported: aux losses sown inside "
-            "shard_map cannot reach the loss"
-        )
     return LlamaConfig(
         **{**cfg.__dict__, "attn_impl": attn, "sequence_axis": seq_axis}
     )
@@ -70,6 +71,7 @@ def sequence_parallel_lm_step(
     data_axis: Optional[str] = "data",
     seq_axis: str = "sequence",
     ignore_id: int = -100,
+    aux_loss_weight: float = 0.01,
 ) -> Callable:
     """``step(state, tokens[B, S]) -> (state, metrics)`` with the sequence
     dimension sharded over ``mesh[seq_axis]``.
@@ -96,30 +98,47 @@ def sequence_parallel_lm_step(
     axes = (data_axis, seq_axis) if data_axis else (seq_axis,)
 
     def local_loss_sums(params, tok_shard, tgt_shard):
-        """-> (ce_sum, token_count) for this shard (pre-psum)."""
+        """-> (ce_sum, token_count, moe fraction leaves) for this shard."""
         s_loc = tok_shard.shape[1]
         positions = lax.axis_index(seq_axis) * s_loc + jnp.arange(s_loc)[None, :]
-        logits = module.apply(
-            {"params": params}, tok_shard, positions=positions
-        ).astype(jnp.float32)
+        logits, mods = module.apply(
+            {"params": params}, tok_shard, positions=positions,
+            mutable=["moe_stats"],
+        )
+        logits = logits.astype(jnp.float32)
         mask = (tgt_shard != ignore_id).astype(jnp.float32)
         safe = jnp.where(tgt_shard == ignore_id, 0, tgt_shard)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
-        return (ce * mask).sum(), mask.sum()
+        fracs = jax.tree_util.tree_leaves(mods.get("moe_stats", {}))
+        return (ce * mask).sum(), mask.sum(), fracs
 
     def sharded_loss(params, tokens, targets):
-        ce_sum, count = local_loss_sums(params, tokens, targets)
+        ce_sum, count, fracs = local_loss_sums(params, tokens, targets)
         for ax in axes:
             ce_sum = lax.psum(ce_sum, ax)
             count = lax.psum(count, ax)
-        return ce_sum / jnp.maximum(count, 1.0)
+            # token-MEAN fractions: shards hold equal token counts, so the
+            # pmean over shards is exactly the global token mean
+            fracs = [lax.pmean(f, ax) for f in fracs]
+        ce = ce_sum / jnp.maximum(count, 1.0)
+        if fracs:
+            # re-form the load-balance loss from GLOBAL fractions (same
+            # formula as ops/moe.py top_k_routing) — exactly the serial
+            # lm_step aux, unlike a mean of per-shard products
+            per_layer = [
+                cfg.num_experts * jnp.sum(f[0] * f[1]) for f in fracs
+            ]
+            aux = sum(per_layer) / len(per_layer)
+        else:
+            aux = jnp.float32(0.0)
+        return ce + aux_loss_weight * aux, (ce, aux)
 
     batch_spec = P(data_axis, seq_axis) if data_axis else P(None, seq_axis)
     loss_sm = shard_map(
         sharded_loss,
         mesh=mesh,
         in_specs=(P(), batch_spec, batch_spec),
-        out_specs=P(),
+        out_specs=(P(), (P(), P())),
         check_vma=False,
     )
 
@@ -131,10 +150,14 @@ def sequence_parallel_lm_step(
             axis=1,
         )
 
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_sm(p, tokens, targets)
+        (_, (loss, aux)), grads = jax.value_and_grad(
+            lambda p: loss_sm(p, tokens, targets), has_aux=True
         )(state.params)
         state = state.apply_gradients(grads=grads)
-        return state, {"loss": loss, "perplexity": jnp.exp(loss)}
+        return state, {
+            "loss": loss,
+            "perplexity": jnp.exp(loss),
+            "aux_loss": aux,
+        }
 
     return step

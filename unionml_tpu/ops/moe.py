@@ -32,6 +32,26 @@ from flax import linen as nn
 from jax import lax
 
 
+def load_balance_stats(
+    probs: jnp.ndarray, indices: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-expert token-MEAN stats behind the load-balance loss.
+
+    probs: [tokens, experts] router softmax; indices: [tokens, k].
+    Returns ``(routing_fraction [E], gate_fraction [E])``. The aux loss is
+    ``E * sum(rf * gf)`` — both serial (:func:`top_k_routing`) and
+    sequence-parallel (models/sequence_parallel.py, which pmeans the
+    fractions across shards first) form it from THIS function, so the
+    two training paths cannot drift apart.
+    """
+    num_experts = probs.shape[-1]
+    routing_fraction = jnp.mean(
+        jax.nn.one_hot(indices[..., 0], num_experts, dtype=jnp.float32), axis=0
+    )
+    gate_fraction = jnp.mean(probs.astype(jnp.float32), axis=0)
+    return routing_fraction, gate_fraction
+
+
 def top_k_routing(
     gate_logits: jnp.ndarray, num_selected: int
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -46,10 +66,7 @@ def top_k_routing(
     weights = weights / jnp.maximum(weights.sum(-1, keepdims=True), 1e-9)
 
     # load-balancing aux loss (Switch-style)
-    routing_fraction = jnp.mean(
-        jax.nn.one_hot(indices[..., 0], num_experts, dtype=jnp.float32), axis=0
-    )
-    gate_fraction = jnp.mean(probs, axis=0)
+    routing_fraction, gate_fraction = load_balance_stats(probs, indices)
     aux_loss = num_experts * jnp.sum(routing_fraction * gate_fraction)
     return weights.astype(gate_logits.dtype), indices, aux_loss
 
@@ -248,6 +265,16 @@ class MoEMlp(nn.Module):
 
         gate_logits = tokens @ router_kernel.astype(tokens.dtype)
         weights, indices, aux_loss = top_k_routing(gate_logits, self.num_selected)
+
+        # the load-balance loss is a product of token-MEAN stats, so it is
+        # not additive across sequence shards — sow the raw fractions into
+        # a separate collection so sharded consumers (sequence_parallel)
+        # can pmean them globally before re-forming E*sum(rf*gf). A no-op
+        # (flax drops the sow) unless "moe_stats" is made mutable. XLA
+        # CSEs the second softmax with top_k_routing's.
+        probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+        routing_frac, gate_frac = load_balance_stats(probs, indices)
+        self.sow("moe_stats", "fractions", jnp.stack([routing_frac, gate_frac]))
 
         # dense one-hot dispatch: static shapes, collectives inserted by
         # GSPMD when the expert dim is sharded
