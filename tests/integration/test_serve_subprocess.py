@@ -1,0 +1,141 @@
+"""Process-boundary serving: boot the real CLI server in a subprocess.
+
+Reference analog: tests/integration/test_fastapi.py:14-26 (subprocess
+``unionml serve`` + health polling) and :116-121 (the missing
+``--model-path`` error surface). The in-process transport tests live in
+tests/unit/test_serving.py; THIS file is the only place the `serve`
+command's process path (CLI arg parsing -> env handoff -> app resolution
+-> HTTP loop) runs end-to-end.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).parent.parent.parent
+APPS_DIR = REPO_ROOT / "tests" / "apps"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _serve_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(REPO_ROOT), str(APPS_DIR), env.get("PYTHONPATH", "")]
+    )
+    return env
+
+
+@pytest.fixture(scope="module")
+def model_artifact_path(tmp_path_factory):
+    """Train the fixture app once and save its artifact to disk."""
+    sys.path.insert(0, str(APPS_DIR))
+    try:
+        import sklearn_app
+
+        sklearn_app.model.train(hyperparameters={"max_iter": 200}, n=200)
+        path = tmp_path_factory.mktemp("artifact") / "model.joblib"
+        sklearn_app.model.save(str(path))
+        return path
+    finally:
+        sys.path.remove(str(APPS_DIR))
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, json.loads(resp.read() or b"null")
+
+
+def _post(url: str, payload: dict):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _wait_live(port: int, proc: subprocess.Popen, timeout: float = 60.0):
+    """Poll / until the server answers (reference: test_fastapi.py:29-44)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"server exited early rc={proc.returncode}")
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/", timeout=2)
+            return
+        except (urllib.error.URLError, ConnectionError):
+            time.sleep(0.3)
+    raise TimeoutError("server did not come up")
+
+
+def test_serve_subprocess_lifecycle(model_artifact_path, tmp_path):
+    port = _free_port()
+    log = open(tmp_path / "serve.log", "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "unionml_tpu.cli", "serve", "sklearn_app:model",
+         "--model-path", str(model_artifact_path), "--port", str(port)],
+        env=_serve_env(), stdout=log, stderr=log,
+    )
+    try:
+        _wait_live(port, proc)
+        status, health = _get(f"http://127.0.0.1:{port}/health")
+        assert status == 200
+        assert health == {"status": "ok", "model_loaded": True}
+
+        # predict from raw features
+        status, preds = _post(
+            f"http://127.0.0.1:{port}/predict",
+            {"features": [{"x1": 5.0, "x2": 5.0}, {"x1": -5.0, "x2": -5.0}]},
+        )
+        assert status == 200
+        assert preds == [1.0, 0.0]
+
+        # predict through the reader-kwargs path
+        status, preds = _post(
+            f"http://127.0.0.1:{port}/predict", {"inputs": {"n": 10}}
+        )
+        assert status == 200
+        assert isinstance(preds, list) and len(preds) == 10
+    finally:
+        proc.terminate()
+        proc.wait(timeout=15)
+        log.close()
+
+
+def test_serve_subprocess_missing_model_path_errors(tmp_path):
+    """Nonexistent --model-path fails fast with a helpful CLI error
+    (reference: test_fastapi.py:116-121)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "unionml_tpu.cli", "serve", "sklearn_app:model",
+         "--model-path", str(tmp_path / "nope.joblib"), "--port", "0"],
+        env=_serve_env(), capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode != 0
+    assert "does not exist" in proc.stderr
+
+
+def test_serve_subprocess_unloaded_model_fails_fast(tmp_path):
+    """No --model-path and no artifact: the server refuses to start with a
+    named remedy instead of serving a dead /predict."""
+    env = _serve_env()
+    env.pop("UNIONML_MODEL_PATH", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "unionml_tpu.cli", "serve", "sklearn_app:model",
+         "--port", str(_free_port())],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode != 0
+    assert "UNIONML_MODEL_PATH" in proc.stderr

@@ -94,3 +94,218 @@ def test_app_version_dirty_tree_guard(tmp_path, monkeypatch):
     with pytest.raises(VersionFetchError, match="uncommitted"):
         get_app_version(cwd=str(repo))
     assert get_app_version(allow_uncommitted=True, cwd=str(repo)).endswith("-dirty")
+
+
+# ---------------------------------------------------------------------------
+# TPUVMBackend with a faked SSH/scp transport (reference analog:
+# tests/integration/test_flyte_remote.py:33-57 — a local stand-in instead
+# of real cluster hosts). The transport primitives (_ssh/_run_ssh/_scp_*)
+# are replaced with local bash/cp so env wiring, per-host logs, failure
+# aggregation, and the no-shared-FS fetch path all run for real.
+# ---------------------------------------------------------------------------
+
+import os
+import subprocess
+
+REPO_ROOT = Path(__file__).parent.parent.parent
+
+
+def _make_tpuvm_backend(tmp_path, hosts, **kwargs):
+    from unionml_tpu.remote import TPUVMBackend
+
+    kwargs.setdefault("provision", False)
+    return TPUVMBackend(
+        hosts=hosts,
+        project="fixture-project",
+        root=str(tmp_path / "backend"),
+        workdir=str(tmp_path / "vm_work"),
+        **kwargs,
+    )
+
+
+def _fake_transport(monkeypatch, backend, fail_hosts=(), capture=None, stub=False):
+    """Local-subprocess stand-ins for the SSH/scp primitives.
+
+    ``stub=True`` records remote commands without executing them (for
+    wiring/provisioning assertions); otherwise commands run locally via
+    bash, so the real runner executes in the pushed workdir.
+    """
+
+    def fake_run_ssh(host, command):
+        if capture is not None:
+            capture.append(("run_ssh", host, command))
+        if stub and "pip install" in command:
+            return subprocess.CompletedProcess([], 0, "", "")
+        return subprocess.run(["bash", "-c", command], capture_output=True, text=True)
+
+    def fake_scp_to(host, src, dst):
+        if capture is not None:
+            capture.append(("scp_to", host, src, dst))
+        # the fake "remote" shares this FS, so a registry stage can target
+        # the very dir it comes from — a no-op copy, not an error
+        if Path(src.rstrip("/.")).resolve() == Path(dst).resolve():
+            return
+        subprocess.run(["bash", "-c", f"mkdir -p {dst} && cp -r {src} {dst}"], check=True)
+
+    def fake_scp_from(host, src, dst):
+        if capture is not None:
+            capture.append(("scp_from", host, src, dst))
+        subprocess.run(["bash", "-c", f"mkdir -p {dst} && cp -r {src} {dst}"], check=True)
+
+    def fake_ssh(host, command, **popen_kwargs):
+        if capture is not None:
+            capture.append(("ssh", host, command))
+        if stub:
+            return subprocess.Popen(["true"], **popen_kwargs)
+        if host in fail_hosts:
+            return subprocess.Popen(
+                ["bash", "-c", "echo 'fake host crash' >&2; exit 3"], **popen_kwargs
+            )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [str(REPO_ROOT), str(APPS_DIR), env.get("PYTHONPATH", "")]
+        )
+        return subprocess.Popen(["bash", "-c", command], env=env, **popen_kwargs)
+
+    monkeypatch.setattr(backend, "_run_ssh", fake_run_ssh)
+    monkeypatch.setattr(backend, "_scp_to", fake_scp_to)
+    monkeypatch.setattr(backend, "_scp_from", fake_scp_from)
+    monkeypatch.setattr(backend, "_ssh", fake_ssh)
+    return backend
+
+
+@pytest.fixture
+def tpuvm_model(monkeypatch, tmp_path):
+    monkeypatch.setenv("UNIONML_TPU_HOME", str(tmp_path / "backend"))
+    sys.path.insert(0, str(APPS_DIR))
+    try:
+        import sklearn_app
+
+        sklearn_app.model._backend = None
+        sklearn_app.model.remote(project="fixture-project")
+        yield sklearn_app.model, tmp_path
+    finally:
+        sys.path.remove(str(APPS_DIR))
+
+
+def test_tpuvm_multihost_env_wiring(tpuvm_model, monkeypatch):
+    """Every host gets the jax.distributed coordinator env (host 0 is the
+    coordinator) and its own runner log; processes are tracked for wait()."""
+    model, tmp_path = tpuvm_model
+    backend = _make_tpuvm_backend(tmp_path, ["hostA", "hostB"])
+    capture = []
+    _fake_transport(monkeypatch, backend, capture=capture, stub=True)
+    model._backend = backend
+
+    backend.deploy(model, app_version="v1")
+    record = backend.execute(model, workflow="train", app_version="v1",
+                             inputs={}, wait=False)
+    launched = backend._procs[record.execution_id]
+    try:
+        cmds = {e[1]: e[2] for e in capture if e[0] == "ssh"}
+        assert "JAX_COORDINATOR_ADDRESS=hostA:8476" in cmds["hostA"]
+        assert "JAX_NUM_PROCESSES=2" in cmds["hostA"]
+        assert "JAX_PROCESS_ID=0" in cmds["hostA"]
+        assert "JAX_PROCESS_ID=1" in cmds["hostB"]
+        assert len(launched["procs"]) == 2
+        for i in range(2):
+            assert (Path(record.exec_dir) / f"runner.host{i}.log").exists()
+    finally:
+        for _, proc, log in launched["procs"]:
+            proc.wait(timeout=30)
+            log.close()
+        backend._procs.pop(record.execution_id, None)
+
+
+def test_tpuvm_per_host_failure_propagates(tpuvm_model, monkeypatch):
+    """A crashed host fails the execution with that host's rc + log tail
+    (round-1 gap: _launch fired SSH processes and never looked back)."""
+    model, tmp_path = tpuvm_model
+    backend = _make_tpuvm_backend(tmp_path, ["hostA", "hostB"])
+    _fake_transport(monkeypatch, backend, fail_hosts={"hostB"})
+    model._backend = backend
+
+    backend.deploy(model, app_version="v1")
+    with pytest.raises(RuntimeError, match=r"host 1 \(hostB\): rc=3"):
+        backend.execute(model, workflow="train", app_version="v1",
+                        inputs={}, wait=True)
+    # the record was marked FAILED for later inspectors
+    from unionml_tpu.remote import ExecutionRecord
+
+    execs = list((Path(str(tmp_path / "backend")) / "executions" /
+                  "fixture-project").iterdir())
+    assert len(execs) == 1
+    assert ExecutionRecord.load(execs[0]).status == "FAILED"
+
+
+def test_tpuvm_single_host_end_to_end_without_shared_fs(tpuvm_model, monkeypatch):
+    """Full lifecycle over the faked transport with shared_fs=False: deploy
+    push -> runner executes in the per-version workdir -> inputs staged out,
+    host-0 outputs fetched back -> artifact loads. Single host launches
+    without any jax.distributed env."""
+    model, tmp_path = tpuvm_model
+    backend = _make_tpuvm_backend(tmp_path, ["hostA"], shared_fs=False)
+    capture = []
+    _fake_transport(monkeypatch, backend, capture=capture)
+    model._backend = backend
+
+    model.remote_deploy(app_version="v1")
+    artifact = model.remote_train(app_version="v1",
+                                  hyperparameters={"max_iter": 200}, n=200)
+    assert artifact.model_object is not None
+    assert artifact.metrics["test"] > 0.8
+    (cmd,) = [e[2] for e in capture if e[0] == "ssh"]
+    assert "JAX_COORDINATOR_ADDRESS" not in cmd  # single host: no dist init
+    assert "_exec" in cmd  # runner pointed at the staged exec dir
+    assert any(e[0] == "scp_from" for e in capture)  # outputs fetched back
+
+    # predict resolves the trained model on the host: without a shared FS
+    # the backend must stage the train execution into the host's registry
+    preds = model.remote_predict(
+        app_version="v1",
+        features=[{"x1": 5.0, "x2": 5.0}, {"x1": -5.0, "x2": -5.0}],
+    )
+    assert preds == [1.0, 0.0]
+
+
+def test_tpuvm_provisioning_installs_on_every_host(tpuvm_model, monkeypatch):
+    """Full deploys push the environment bundle and pip-install it per host;
+    patch deploys skip provisioning (fast-registration parity)."""
+    model, tmp_path = tpuvm_model
+    backend = _make_tpuvm_backend(tmp_path, ["hostA", "hostB"], provision=True)
+    capture = []
+    _fake_transport(monkeypatch, backend, capture=capture, stub=True)
+    model._backend = backend
+
+    def fake_bundle(dest):
+        env_dir = Path(dest) / "_env"
+        env_dir.mkdir(parents=True, exist_ok=True)
+        (env_dir / "unionml_tpu-0.1.0-py3-none-any.whl").write_bytes(b"wheel")
+        (env_dir / "requirements.lock").write_text("jax==0.0.test\n")
+        return env_dir
+
+    import unionml_tpu.remote.packaging as packaging
+
+    monkeypatch.setattr(packaging, "build_environment_bundle", fake_bundle)
+
+    backend.deploy(model, app_version="v1")
+    pip_cmds = [(e[1], e[2]) for e in capture
+                if e[0] == "run_ssh" and "pip install" in e[2]]
+    assert {h for h, _ in pip_cmds} == {"hostA", "hostB"}
+    assert all("requirements.lock" in c and ".whl" in c for _, c in pip_cmds)
+
+    capture.clear()
+    backend.deploy(model, app_version="v1-patch123", patch=True)
+    assert not [e for e in capture
+                if e[0] == "run_ssh" and "pip install" in e[2]]
+
+
+def test_environment_bundle_builds_offline(tmp_path):
+    """The real wheel build + pinned lock (the docker_build_push analog)."""
+    from unionml_tpu.remote import build_environment_bundle
+
+    env_dir = build_environment_bundle(tmp_path / "dep")
+    wheels = list(env_dir.glob("unionml_tpu-*.whl"))
+    assert len(wheels) == 1
+    lock = (env_dir / "requirements.lock").read_text()
+    assert "jax==" in lock and "flax==" in lock and "optax==" in lock

@@ -53,12 +53,15 @@ def load_balance_stats(
 
 
 def top_k_routing(
-    gate_logits: jnp.ndarray, num_selected: int
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    gate_logits: jnp.ndarray, num_selected: int, *, return_stats: bool = False
+):
     """Softmax-normalized top-k routing.
 
     gate_logits: [tokens, experts]. Returns (weights [T, k],
-    indices [T, k], aux_loss scalar).
+    indices [T, k], aux_loss scalar) — plus the
+    ``(routing_fraction, gate_fraction)`` pair behind the aux loss when
+    ``return_stats`` (so callers that need the raw fractions, e.g. the
+    sequence-parallel sow, don't recompute them).
     """
     num_experts = gate_logits.shape[-1]
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
@@ -68,7 +71,10 @@ def top_k_routing(
     # load-balancing aux loss (Switch-style)
     routing_fraction, gate_fraction = load_balance_stats(probs, indices)
     aux_loss = num_experts * jnp.sum(routing_fraction * gate_fraction)
-    return weights.astype(gate_logits.dtype), indices, aux_loss
+    weights = weights.astype(gate_logits.dtype)
+    if return_stats:
+        return weights, indices, aux_loss, (routing_fraction, gate_fraction)
+    return weights, indices, aux_loss
 
 
 def expert_capacity(
@@ -264,16 +270,15 @@ class MoEMlp(nn.Module):
             )
 
         gate_logits = tokens @ router_kernel.astype(tokens.dtype)
-        weights, indices, aux_loss = top_k_routing(gate_logits, self.num_selected)
+        weights, indices, aux_loss, (routing_frac, gate_frac) = top_k_routing(
+            gate_logits, self.num_selected, return_stats=True
+        )
 
         # the load-balance loss is a product of token-MEAN stats, so it is
         # not additive across sequence shards — sow the raw fractions into
         # a separate collection so sharded consumers (sequence_parallel)
         # can pmean them globally before re-forming E*sum(rf*gf). A no-op
-        # (flax drops the sow) unless "moe_stats" is made mutable. XLA
-        # CSEs the second softmax with top_k_routing's.
-        probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
-        routing_frac, gate_frac = load_balance_stats(probs, indices)
+        # (flax drops the sow) unless "moe_stats" is made mutable.
         self.sow("moe_stats", "fractions", jnp.stack([routing_frac, gate_frac]))
 
         # dense one-hot dispatch: static shapes, collectives inserted by

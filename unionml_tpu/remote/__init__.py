@@ -28,9 +28,11 @@ from unionml_tpu.remote.backend import (
 )
 from unionml_tpu.remote.packaging import (
     VersionFetchError,
+    build_environment_bundle,
     get_app_version,
     package_source,
     patch_suffix,
+    pinned_requirements,
 )
 
 
@@ -62,6 +64,8 @@ __all__ = [
     "TPUVMBackend",
     "get_backend",
     "VersionFetchError",
+    "build_environment_bundle",
+    "pinned_requirements",
     "get_app_version",
     "package_source",
     "patch_suffix",

@@ -274,14 +274,24 @@ class TPUVMBackend(BaseBackend):
     """SSH control plane for TPU VM slices (multi-host).
 
     Config (from the backend YAML): ``hosts`` (worker addresses, host 0 is
-    the coordinator), ``ssh_user``, ``workdir``. Source is pushed to every
-    worker; the runner launches on all hosts with
-    ``jax.distributed.initialize`` coordinator env so XLA collectives span
-    the slice (SURVEY.md §5.8).
+    the coordinator), ``ssh_user``, ``workdir``, ``shared_fs`` (whether the
+    exec dir is visible on every host — NFS/GCS-fuse; when False, inputs
+    are scp'd out and host 0's outputs scp'd back), ``provision`` (build
+    the framework wheel + pinned requirements and pip-install them on
+    every host at deploy time — the ``docker_build_push`` analog,
+    reference remote.py:69-108). Source is pushed to every worker; the
+    runner launches on all hosts with ``jax.distributed.initialize``
+    coordinator env so XLA collectives span the slice (SURVEY.md §5.8).
+
+    ``_launch`` keeps every SSH process; :meth:`wait` joins them all and
+    aggregates per-host failures (rc + log tail) instead of silently
+    returning — a host-1 crash fails the execution, like a lost Flyte pod
+    fails the workflow.
     """
 
     def __init__(self, *, hosts: List[str], ssh_user: str = "root",
-                 workdir: str = "/tmp/unionml_tpu_app", coordinator_port: int = 8476, **kwargs):
+                 workdir: str = "/tmp/unionml_tpu_app", coordinator_port: int = 8476,
+                 shared_fs: bool = True, provision: bool = True, **kwargs):
         super().__init__(**kwargs)
         if not hosts:
             raise ValueError("TPUVMBackend requires at least one host")
@@ -289,49 +299,225 @@ class TPUVMBackend(BaseBackend):
         self.ssh_user = ssh_user
         self.workdir = workdir
         self.coordinator_port = coordinator_port
+        self.shared_fs = shared_fs
+        self.provision = provision
+        # execution_id -> {"procs": [(host, Popen, logfile)], "targets": [...]}
+        self._procs: Dict[str, Dict[str, Any]] = {}
+        # (host, app_version) pairs already pushed by THIS process: execute()
+        # after deploy() skips re-pushing the identical tree (incl. wheels)
+        self._pushed: set = set()
+
+    # ---------- transport primitives (monkeypatch points for tests) ----------
 
     def _ssh(self, host: str, command: str, **popen_kwargs):
+        """Streaming remote command (non-blocking Popen)."""
         return subprocess.Popen(
             ["ssh", "-o", "StrictHostKeyChecking=no", f"{self.ssh_user}@{host}", command],
             **popen_kwargs,
         )
 
+    def _run_ssh(self, host: str, command: str) -> subprocess.CompletedProcess:
+        """Blocking remote command with captured output."""
+        return subprocess.run(
+            ["ssh", "-o", "StrictHostKeyChecking=no", f"{self.ssh_user}@{host}", command],
+            capture_output=True, text=True,
+        )
+
+    def _run_ssh_checked(self, host: str, command: str):
+        """Blocking remote command; raises with stderr on failure."""
+        proc = self._run_ssh(host, command)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"remote command failed on {host} (rc={proc.returncode}): "
+                f"{command}\n{(proc.stderr or '').strip()[-500:]}"
+            )
+        return proc
+
+    def _scp_to(self, host: str, src: str, dst: str):
+        subprocess.run(
+            ["scp", "-r", "-q", "-o", "StrictHostKeyChecking=no", src,
+             f"{self.ssh_user}@{host}:{dst}"],
+            check=True,
+        )
+
+    def _scp_from(self, host: str, src: str, dst: str):
+        subprocess.run(
+            ["scp", "-r", "-q", "-o", "StrictHostKeyChecking=no",
+             f"{self.ssh_user}@{host}:{src}", dst],
+            check=True,
+        )
+
+    # ---------- deploy + environment provisioning ----------
+
+    def deploy(self, model, *, app_version: str, patch: bool = False) -> Path:
+        """Package source; build + install the pinned environment.
+
+        Full deploys build an environment bundle (framework wheel +
+        ``requirements.lock`` pinned to the versions running here) and
+        pip-install it on every host, so the remote env is reproducible —
+        the reference's image build/push (remote.py:69-108). Patch deploys
+        skip provisioning, mirroring fast registration (remote.py:126-138).
+        """
+        dest = super().deploy(model, app_version=app_version, patch=patch)
+        if self.provision and not patch:
+            from unionml_tpu.remote import packaging
+
+            packaging.build_environment_bundle(dest)
+            errors = []
+            for host in self.hosts:
+                target = self._push(host, dest, app_version)
+                proc = self._run_ssh(
+                    host,
+                    f"python -m pip install -q -r {target}/_env/requirements.lock "
+                    f"--no-index --find-links {target}/_env && "
+                    f"python -m pip install -q --no-deps --force-reinstall "
+                    f"{target}/_env/*.whl || "
+                    # no local wheel cache for the pinned deps (fresh VM with
+                    # network): fall back to a plain pinned install
+                    f"(python -m pip install -q -r {target}/_env/requirements.lock && "
+                    f"python -m pip install -q --no-deps --force-reinstall "
+                    f"{target}/_env/*.whl)",
+                )
+                if proc.returncode != 0:
+                    errors.append(f"{host}: {proc.stderr.strip()[-500:]}")
+            if errors:
+                raise RuntimeError(
+                    "environment provisioning failed on "
+                    f"{len(errors)}/{len(self.hosts)} hosts:\n" + "\n".join(errors)
+                )
+            logger.info(
+                f"provisioned pinned environment on {len(self.hosts)} hosts"
+            )
+        return dest
+
     def _push(self, host: str, src: Path, app_version: str) -> str:
         """Push the deployment to a per-version dir so repeated deploys never
-        nest inside (or silently reuse) a previous version's workdir."""
+        nest inside (or silently reuse) a previous version's workdir.
+
+        Idempotent within one process: a version already pushed to a host
+        (e.g. by deploy(), or a previous execute()) is not re-transferred.
+        """
         target = f"{self.workdir}/{app_version}"
-        subprocess.run(
-            ["ssh", "-o", "StrictHostKeyChecking=no", f"{self.ssh_user}@{host}",
-             f"rm -rf {target} && mkdir -p {target}"],
-            check=True,
-        )
-        subprocess.run(
-            ["scp", "-r", "-q", "-o", "StrictHostKeyChecking=no", f"{src}/.",
-             f"{self.ssh_user}@{host}:{target}"],
-            check=True,
-        )
+        if (host, app_version) in self._pushed:
+            return target
+        self._run_ssh_checked(host, f"rm -rf {target} && mkdir -p {target}")
+        self._scp_to(host, f"{src}/.", target)
+        self._pushed.add((host, app_version))
         return target
+
+    # ---------- launch / wait ----------
+
+    def _stage_model_registry(self, model_version):
+        """Copy the resolved train execution to every host's local registry.
+
+        Without a shared filesystem the hosts cannot see this machine's
+        execution history, so predict workflows could never resolve a
+        trained model: stage the one SUCCEEDED train execution the runner
+        will ask for (latest or pinned) into ``{root}/executions`` on each
+        host — the runner's ``_load_model_artifact`` then finds it through
+        the same registry layout it uses locally.
+        """
+        src = self.get_model_execution(None, model_version=model_version or "latest")
+        remote_dir = f"{self.root}/executions/{self.project}/{src.execution_id}"
+        for host in self.hosts:
+            self._run_ssh_checked(host, f"mkdir -p {remote_dir}")
+            self._scp_to(host, f"{src.exec_dir}/.", remote_dir)
 
     def _launch(self, record, dep_dir, manifest, *, model_version):
         targets = [self._push(host, dep_dir, record.app_version) for host in self.hosts]
         coordinator = f"{self.hosts[0]}:{self.coordinator_port}"
+        if not self.shared_fs and record.workflow != "train":
+            self._stage_model_registry(model_version)
         procs = []
         for i, host in enumerate(self.hosts):
+            if self.shared_fs:
+                remote_exec = record.exec_dir
+            else:
+                # private filesystems: stage inputs+record into a
+                # per-execution dir in the pushed workdir; host 0's copy is
+                # fetched back in wait()
+                remote_exec = f"{targets[i]}/_exec/{record.execution_id}"
+                self._run_ssh_checked(host, f"mkdir -p {remote_exec}")
+                self._scp_to(host, f"{record.exec_dir}/.", remote_exec)
             env_prefix = (
-                f"JAX_COORDINATOR_ADDRESS={coordinator} "
-                f"JAX_NUM_PROCESSES={len(self.hosts)} JAX_PROCESS_ID={i} "
                 f"UNIONML_TPU_HOME={self.root} UNIONML_TPU_PROJECT={self.project} "
             )
+            if len(self.hosts) > 1:
+                # single-host VMs skip jax.distributed entirely
+                env_prefix = (
+                    f"JAX_COORDINATOR_ADDRESS={coordinator} "
+                    f"JAX_NUM_PROCESSES={len(self.hosts)} JAX_PROCESS_ID={i} "
+                ) + env_prefix
             cmd = (
                 f"cd {targets[i]} && {env_prefix}"
                 f"python -m unionml_tpu.remote.runner --app {manifest['app']} "
-                f"--workflow {record.workflow} --exec-dir {record.exec_dir}"
+                f"--workflow {record.workflow} --exec-dir {remote_exec}"
                 + (f" --model-version {model_version}" if model_version else "")
             )
-            log = open(Path(record.exec_dir) / f"runner.host{i}.log", "w")
-            procs.append(self._ssh(host, cmd, stdout=log, stderr=log))
-        # host 0 writes outputs back over a shared filesystem; the record
-        # status is updated by the runner on host 0.
+            log_path = Path(record.exec_dir) / f"runner.host{i}.log"
+            log = open(log_path, "w")
+            procs.append((host, self._ssh(host, cmd, stdout=log, stderr=log), log))
+        self._procs[record.execution_id] = {"procs": procs, "targets": targets}
+
+    def wait(self, execution: ExecutionRecord, timeout: float = 3600.0, poll: float = 0.2) -> ExecutionRecord:
+        """Join every host's SSH process, aggregate failures, fetch outputs.
+
+        Unlike the base class (which only polls the record file), a dead
+        or non-zero host process fails the execution with that host's rc
+        and log tail — per-host failures propagate instead of hanging the
+        poll loop until timeout.
+        """
+        launched = self._procs.pop(execution.execution_id, None)
+        if launched is None:
+            # not launched by this process: shared-FS record polling only
+            return super().wait(execution, timeout=timeout, poll=poll)
+        deadline = time.time() + timeout
+        failures = []
+        # poll ALL hosts concurrently: a crashed worker is detected
+        # immediately even while host 0 blocks in a collective waiting for
+        # the dead peer — the survivors are then killed rather than letting
+        # them hang until the deadline
+        pending = {i: hp for i, hp in enumerate(launched["procs"])}
+        while pending and time.time() < deadline and not failures:
+            for i in sorted(pending):
+                host, proc, log = pending[i]
+                rc = proc.poll()
+                if rc is None:
+                    continue
+                del pending[i]
+                log.close()
+                if rc != 0:
+                    failures.append((i, host, f"rc={rc}"))
+            if pending and not failures:
+                time.sleep(poll)
+        for i in sorted(pending):  # first failure or deadline: reap survivors
+            host, proc, log = pending[i]
+            proc.kill()
+            proc.wait()
+            log.close()
+            why = ("killed after another host failed" if failures
+                   else f"timeout after {timeout}s")
+            failures.append((i, host, why))
+        if not self.shared_fs and not failures:
+            # host 0 holds the authoritative record + outputs
+            self._scp_from(
+                self.hosts[0],
+                f"{launched['targets'][0]}/_exec/{execution.execution_id}/.",
+                execution.exec_dir,
+            )
+        if failures:
+            detail = []
+            for i, host, why in failures:
+                log_path = Path(execution.exec_dir) / f"runner.host{i}.log"
+                tail = log_path.read_text()[-1000:] if log_path.exists() else "<no log>"
+                detail.append(f"host {i} ({host}): {why}\n{tail}")
+            execution.status = "FAILED"
+            execution.save()
+            raise RuntimeError(
+                f"execution {execution.execution_id} FAILED on "
+                f"{len(failures)}/{len(self.hosts)} hosts:\n" + "\n".join(detail)
+            )
+        return super().wait(execution, timeout=max(1.0, deadline - time.time()), poll=poll)
 
 
 def get_backend(
@@ -354,6 +540,8 @@ def get_backend(
                 ssh_user=backend_cfg.get("ssh_user", "root"),
                 workdir=backend_cfg.get("workdir", "/tmp/unionml_tpu_app"),
                 coordinator_port=backend_cfg.get("coordinator_port", 8476),
+                shared_fs=backend_cfg.get("shared_fs", True),
+                provision=backend_cfg.get("provision", True),
                 project=project,
                 domain=domain,
                 root=backend_cfg.get("root"),

@@ -66,6 +66,79 @@ def iter_source_files(src: Path) -> Iterable[Path]:
             yield path
 
 
+def framework_root() -> Path:
+    """Repo root of the installed-from-source framework (has pyproject.toml)."""
+    return Path(__file__).resolve().parents[2]
+
+
+def pinned_requirements() -> str:
+    """``name==version`` lines for the framework's runtime dependencies.
+
+    The pins come from the versions importable HERE, so a provisioned host
+    reproduces the deploying machine's environment — the role the
+    reference's docker image plays (reference: remote.py:69-108). Deps
+    that aren't installed locally fall back to the unpinned spec.
+    """
+    import re
+    import tomllib
+    from importlib import metadata
+
+    try:
+        with open(framework_root() / "pyproject.toml", "rb") as f:
+            specs = tomllib.load(f)["project"]["dependencies"]
+    except (FileNotFoundError, KeyError):
+        specs = []
+    lines = []
+    for spec in specs:
+        name = re.split(r"[><=!~\[;]", spec, 1)[0].strip()
+        try:
+            lines.append(f"{name}=={metadata.version(name)}")
+        except metadata.PackageNotFoundError:
+            lines.append(spec)
+    return "\n".join(lines) + "\n"
+
+
+def build_environment_bundle(dest_dir) -> Path:
+    """Build the deployable environment under ``{dest}/_env``.
+
+    Contents: the framework wheel (built offline via ``pip wheel
+    --no-deps --no-build-isolation``) and ``requirements.lock`` (pinned
+    runtime deps). :class:`~unionml_tpu.remote.backend.TPUVMBackend`
+    pip-installs the bundle on every host at deploy time — the analog of
+    the reference's image build+push (remote.py:69-108) without a
+    container registry in the loop.
+    """
+    import subprocess
+    import sys
+    import tempfile
+
+    env_dir = Path(dest_dir) / "_env"
+    env_dir.mkdir(parents=True, exist_ok=True)
+    root = framework_root()
+    with tempfile.TemporaryDirectory(prefix="unionml_tpu_wheel_") as tmp:
+        # build from a minimal copy: setuptools writes build/ + *.egg-info
+        # into the source dir, which would dirty the git tree and trip the
+        # get_app_version dirty-tree guard on the next deploy
+        stage = Path(tmp) / "src"
+        stage.mkdir()
+        for name in ("pyproject.toml", "README.md"):
+            if (root / name).exists():
+                shutil.copy2(root / name, stage / name)
+        shutil.copytree(
+            root / "unionml_tpu", stage / "unionml_tpu",
+            ignore=shutil.ignore_patterns("__pycache__", "*.pyc", "*.so"),
+        )
+        proc = subprocess.run(
+            [sys.executable, "-m", "pip", "wheel", "--no-deps",
+             "--no-build-isolation", "-w", str(env_dir), str(stage)],
+            capture_output=True, text=True,
+        )
+    if proc.returncode != 0:
+        raise RuntimeError(f"framework wheel build failed:\n{proc.stderr[-1000:]}")
+    (env_dir / "requirements.lock").write_text(pinned_requirements())
+    return env_dir
+
+
 def package_source(src_dir, dest_dir, *, patch: bool = False) -> int:
     """Copy the app source tree into a deployment directory.
 
