@@ -20,6 +20,7 @@ import math
 import os
 import sys
 import time
+from functools import partial
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
@@ -30,6 +31,11 @@ def serving_config(preset: str):
 
     if preset == "tiny":
         return LlamaConfig.tiny(vocab_size=256)
+    if preset == "serve_8b":
+        # the BASELINE.json config #5 model: full Llama-3-8B geometry.
+        # bf16 (16 GB) exceeds one v5e chip's HBM; int8 weights (~8.6 GB)
+        # fit with room for bucketed KV caches -> int8-only legs.
+        return LlamaConfig.llama3_8b()
     if preset == "serve_moe":
         # ~1.1B-total-param 8-expert top-2 MoE (~0.4B active per token)
         return LlamaConfig(
@@ -42,6 +48,55 @@ def serving_config(preset: str):
         vocab_size=128_256, hidden_dim=2048, num_layers=20, num_heads=16,
         num_kv_heads=8, mlp_dim=5632, max_len=2048,
     )
+
+
+def random_quantized_params(qmodule, seed: int = 0):
+    """Synthetic weights with the quantized module's exact tree/dtypes.
+
+    The 8B bf16 master tree (16 GB) cannot be materialized on one v5e
+    chip to run ``quantize_params`` over, and decode latency is
+    weight-VALUE-independent (HBM traffic + MXU work depend only on
+    shapes/dtypes — TPUs have no denormal slow paths), so the 8B bench
+    fills each leaf directly on device: random int8 kernels, lecun-scaled
+    fp32 scales, N(0, 0.02) embeddings, ones for norm gains. Leaves are
+    created one at a time — peak transient memory is one leaf's int32
+    sample buffer, never a second full tree.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    shapes = jax.eval_shape(
+        qmodule.init, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
+
+    @partial(jax.jit, static_argnums=(1,))
+    def int8_leaf(key, shape):
+        return jax.random.randint(key, shape, -127, 128, jnp.int32).astype(jnp.int8)
+
+    @partial(jax.jit, static_argnums=(1, 2))
+    def embed_leaf(key, shape, dtype):
+        return (0.02 * jax.random.normal(key, shape)).astype(dtype)
+
+    key = jax.random.PRNGKey(seed)
+    leaves = []
+    for path, s in flat:
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        key, sub = jax.random.split(key)
+        if s.dtype == jnp.int8:
+            leaves.append(int8_leaf(sub, s.shape))
+        elif name == "scale" or name.endswith("_scale"):
+            # uniform int8 in [-127,127] has std ~73; scale so the
+            # effective weight std lands near lecun 1/sqrt(K)
+            k_in = qmodule.config.hidden_dim
+            leaves.append(
+                jnp.full(s.shape, 1.0 / (73.0 * math.sqrt(k_in)), jnp.float32)
+            )
+        elif name == "embedding":
+            leaves.append(embed_leaf(sub, s.shape, s.dtype))
+        else:
+            leaves.append(jnp.ones(s.shape, s.dtype))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def main() -> None:
@@ -77,19 +132,28 @@ def main() -> None:
     cfg = serving_config(preset)
     rng = np.random.default_rng(0)
 
-    module = Llama(cfg)
-    tokens0 = jnp.zeros((1, 8), jnp.int32)
-    fp_params = jax.jit(module.init)(jax.random.PRNGKey(0), tokens0)["params"]
-    # serving residency: one-time bf16 cast (decode re-reads weights per token)
-    params = serving_params(fp_params)
+    if preset == "serve_8b":
+        # bf16 8B exceeds single-chip HBM: int8-only, synthetic weights
+        legs = (True,)
+        module, params, fp_params = None, None, None
+    else:
+        legs = (False, True)
+        module = Llama(cfg)
+        tokens0 = jnp.zeros((1, 8), jnp.int32)
+        fp_params = jax.jit(module.init)(jax.random.PRNGKey(0), tokens0)["params"]
+        # serving residency: one-time bf16 cast (decode re-reads weights per token)
+        params = serving_params(fp_params)
 
-    for quantized in (False, True):
+    for quantized in legs:
         if quantized:
             qcfg = LlamaConfig(**{**cfg.__dict__, "quantized": True})
             qmodule = Llama(qcfg)
-            # quantize from the fp32 masters (the production path), not the
-            # bf16 serving copy: scales from bf16 weights double-round
-            qparams = quantize_params(fp_params, LLAMA_QUANT_PATTERNS)
+            if preset == "serve_8b":
+                qparams = random_quantized_params(qmodule)
+            else:
+                # quantize from the fp32 masters (the production path), not
+                # the bf16 serving copy: scales from bf16 weights double-round
+                qparams = quantize_params(fp_params, LLAMA_QUANT_PATTERNS)
             run_module, run_params = qmodule, qparams
         else:
             run_module, run_params = module, params

@@ -309,3 +309,39 @@ def test_environment_bundle_builds_offline(tmp_path):
     assert len(wheels) == 1
     lock = (env_dir / "requirements.lock").read_text()
     assert "jax==" in lock and "flax==" in lock and "optax==" in lock
+
+
+def test_tpuvm_registry_staging_rewrites_exec_dir(tpuvm_model, monkeypatch):
+    """The record staged to a no-shared-FS host must carry the HOST-side
+    exec_dir, not the deployer-local one — the runner's fetch_outputs
+    follows record.exec_dir, which doesn't exist on a separate FS."""
+    import json as _json
+
+    model, tmp_path = tpuvm_model
+    backend = _make_tpuvm_backend(tmp_path, ["hostA"], shared_fs=False)
+    _fake_transport(monkeypatch, backend)
+    model._backend = backend
+
+    model.remote_deploy(app_version="v1")
+    model.remote_train(app_version="v1", hyperparameters={"max_iter": 200}, n=200)
+
+    staged = {}
+    orig_scp = backend._scp_to
+
+    def spy_scp(host, src, dst):
+        if "/executions/" in dst:
+            rec = _json.loads(
+                (Path(src.rstrip(".").rstrip("/")) / "record.json").read_text()
+            )
+            staged["exec_dir"] = rec["exec_dir"]
+            staged["dst"] = dst
+        orig_scp(host, src, dst)
+
+    monkeypatch.setattr(backend, "_scp_to", spy_scp)
+    preds = model.remote_predict(
+        app_version="v1",
+        features=[{"x1": 5.0, "x2": 5.0}, {"x1": -5.0, "x2": -5.0}],
+    )
+    assert preds == [1.0, 0.0]
+    assert staged, "registry staging never happened"
+    assert staged["exec_dir"] == staged["dst"]

@@ -36,6 +36,13 @@ from unionml_tpu.ops.flash_attention import NEG_INF, _interpret
 # stops fitting comfortably in VMEM; callers should use flash_attention.
 MAX_FUSED_SEQ = 1024
 
+# Scores are computed in log2 space: log2(e) is folded into the q
+# pre-scale outside the kernel, softmax uses exp2 (the VPU-native op exp
+# lowers to anyway, minus the input multiply), and the backward folds the
+# compensating ln(2) into its existing 1/z row factor.
+LOG2E = 1.4426950408889634
+LN2 = 0.6931471805599453
+
 
 def _causal_mask(s_len):
     q_pos = jax.lax.broadcasted_iota(jnp.int32, (s_len, s_len), 0)
@@ -43,65 +50,103 @@ def _causal_mask(s_len):
     return q_pos >= kv_pos
 
 
-def _softmax_fp32(s):
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    return p / jnp.sum(p, axis=-1, keepdims=True)
+def _raw_scores(q, k, causal):
+    """[S, S] fp32 scores; q is pre-scaled by the caller (the 1/sqrt(D)
+    and log2(e) factors ride the [S, D] tensor outside the kernel — XLA
+    fuses them into the projection — instead of an [S, S] multiply here).
+    """
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )                                              # [S, S] fp32
+    if causal:
+        s = jnp.where(_causal_mask(s.shape[0]), s, NEG_INF)
+    return s
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, num_heads):
-    for h in range(num_heads):
-        q = q_ref[0, h]                            # [S, D] input dtype
-        k = k_ref[0, h]
-        v = v_ref[0, h]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale                                  # [S, S] fp32
-        if causal:
-            s = jnp.where(_causal_mask(s.shape[0]), s, NEG_INF)
-        p = _softmax_fp32(s)
-        o_ref[0, h] = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, num_heads):
+    # software-pipelined head loop: head h's QK^T (MXU) is emitted before
+    # head h-1's softmax (VPU) + PV (MXU), so the two heads' independent
+    # MXU/VPU work sits adjacent for the scheduler to overlap. (Writing
+    # the softmax max/denominator out as [B, H, S] residuals for the
+    # backward was tried and measured SLOWER — the lane-major stat writes
+    # force in-kernel relayouts that cost more than the two [S, S]
+    # reductions they save.)
+    def finish(h, s):
+        m = jnp.max(s, axis=-1, keepdims=True)
+        e = jnp.exp2(s - m)                        # scores are log2-scaled
+        z = jnp.sum(e, axis=-1, keepdims=True)
+        o = jax.lax.dot_general(
+            e.astype(v_ref.dtype), v_ref[0, h], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ).astype(o_ref.dtype)
+        )                                          # [S, D] fp32
+        o_ref[0, h] = (o / z).astype(o_ref.dtype)  # deferred normalization
+
+    s_prev = _raw_scores(q_ref[0, 0], k_ref[0, 0], causal)
+    for h in range(1, num_heads):
+        s_next = _raw_scores(q_ref[0, h], k_ref[0, h], causal)
+        finish(h - 1, s_prev)
+        s_prev = s_next
+    finish(num_heads - 1, s_prev)
 
 
-def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref, *,
-                scale, causal, num_heads):
-    for h in range(num_heads):
-        q = q_ref[0, h]
-        k = k_ref[0, h]
-        v = v_ref[0, h]
-        do = do_ref[0, h]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
-            s = jnp.where(_causal_mask(s.shape[0]), s, NEG_INF)
-        p = _softmax_fp32(s)                       # [S, S] fp32
-        p_cast = p.astype(do.dtype)
-        dv_ref[0, h] = jax.lax.dot_general(
-            p_cast, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        ).astype(dv_ref.dtype)
+def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, dq_ref, dk_ref, dv_ref, *,
+                causal, num_heads):
+    # same software pipelining as the forward: head h's two big MXU
+    # products (scores recompute + dp) are emitted before head h-1's
+    # VPU-heavy softmax/ds work
+    def start(h):
+        s = _raw_scores(q_ref[0, h], k_ref[0, h], causal)
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            do_ref[0, h], v_ref[0, h], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )                                          # [S, S]
-        delta = jnp.sum(p * dp, axis=-1, keepdims=True)
-        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        return s, dp
+
+    def finish(h, s, dp):
+        q = q_ref[0, h]
+        do = do_ref[0, h]
+        m = jnp.max(s, axis=-1, keepdims=True)
+        e = jnp.exp2(s - m)                        # [S, S] fp32, log2 space
+        z = jnp.sum(e, axis=-1, keepdims=True)
+        # dv = p^T do = e^T (do / z): row-scale the [S, D] side, not p
+        do_n = (do.astype(jnp.float32) / z).astype(do.dtype)
+        dv_ref[0, h] = jax.lax.dot_general(
+            e.astype(do.dtype), do_n, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(dv_ref.dtype)
+        # delta = sum(p * dp) = sum(do * o) — the flash-attention identity
+        # (sum_j p_ij (do_i . v_j) = do_i . o_i) turns an [S, S] multiply
+        # + reduce into an [S, D] one over the saved forward output
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o_ref[0, h].astype(jnp.float32),
+            axis=-1, keepdims=True,
+        )
+        # ds = p * (dp - delta) * ln2: the ln2 compensates d(exp2)/dx and
+        # cancels against the caller's log2(e) pre-scale in dq/dk; q came
+        # in pre-scaled so the chain rule's scale factor also lives outside
+        ds = (e * (dp - delta) * (LN2 / z)).astype(q.dtype)
         dq_ref[0, h] = jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds, k_ref[0, h], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         ).astype(dq_ref.dtype)
         dk_ref[0, h] = jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         ).astype(dk_ref.dtype)
 
+    s_prev, dp_prev = start(0)
+    for h in range(1, num_heads):
+        s_next, dp_next = start(h)
+        finish(h - 1, s_prev, dp_prev)
+        s_prev, dp_prev = s_next, dp_next
+    finish(num_heads - 1, s_prev, dp_prev)
 
-def _fwd_bhsd(q, k, v, *, causal, scale):
+
+def _fwd_bhsd(q, k, v, *, causal):
     """q,k,v: [B, H, S, D] → out [B, H, S, D]."""
     b, h, s, d = q.shape
     spec = pl.BlockSpec((1, h, s, d), lambda i: (i, 0, 0, 0))
     return pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal, num_heads=h),
+        functools.partial(_fwd_kernel, causal=causal, num_heads=h),
         grid=(b,),
         in_specs=[spec, spec, spec],
         out_specs=spec,
@@ -110,13 +155,13 @@ def _fwd_bhsd(q, k, v, *, causal, scale):
     )(q, k, v)
 
 
-def _bwd_bhsd(q, k, v, do, *, causal, scale):
+def _bwd_bhsd(q, k, v, do, o, *, causal):
     b, h, s, d = q.shape
     spec = pl.BlockSpec((1, h, s, d), lambda i: (i, 0, 0, 0))
     return pl.pallas_call(
-        functools.partial(_bwd_kernel, scale=scale, causal=causal, num_heads=h),
+        functools.partial(_bwd_kernel, causal=causal, num_heads=h),
         grid=(b,),
-        in_specs=[spec, spec, spec, spec],
+        in_specs=[spec, spec, spec, spec, spec],
         out_specs=[spec, spec, spec],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
@@ -124,28 +169,30 @@ def _bwd_bhsd(q, k, v, do, *, causal, scale):
             jax.ShapeDtypeStruct((b, h, s, d), v.dtype),
         ],
         interpret=_interpret(),
-    )(q, k, v, do)
+    )(q, k, v, do, o)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _fused(q, k, v, causal, scale):
-    out, _ = _fused_fwd(q, k, v, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused(q, k, v, causal):
+    out, _ = _fused_fwd(q, k, v, causal)
     return out
 
 
-def _fused_fwd(q, k, v, causal, scale):
-    """q,k,v: [B, S, H, D] with equal head counts (GQA handled by caller)."""
+def _fused_fwd(q, k, v, causal):
+    """q,k,v: [B, S, H, D], q pre-scaled, equal head counts (GQA by caller)."""
     q_t = q.transpose(0, 2, 1, 3)                  # [B, H, S, D]
     k_t = k.transpose(0, 2, 1, 3)
     v_t = v.transpose(0, 2, 1, 3)
-    out = _fwd_bhsd(q_t, k_t, v_t, causal=causal, scale=scale)
-    return out.transpose(0, 2, 1, 3), (q_t, k_t, v_t)
+    out = _fwd_bhsd(q_t, k_t, v_t, causal=causal)
+    # the [B, H, S, D] output is a residual: the backward's delta term
+    # needs only rowsum(do * o), not the [S, S] probability tile
+    return out.transpose(0, 2, 1, 3), (q_t, k_t, v_t, out)
 
 
-def _fused_bwd(causal, scale, residuals, g):
-    q_t, k_t, v_t = residuals
+def _fused_bwd(causal, residuals, g):
+    q_t, k_t, v_t, o_t = residuals
     do = g.transpose(0, 2, 1, 3)
-    dq, dk, dv = _bwd_bhsd(q_t, k_t, v_t, do, causal=causal, scale=scale)
+    dq, dk, dv = _bwd_bhsd(q_t, k_t, v_t, do, o_t, causal=causal)
     return (
         dq.transpose(0, 2, 1, 3),
         dk.transpose(0, 2, 1, 3),
@@ -191,4 +238,8 @@ def fused_attention(
 
         k = _repeat_kv(k, num_heads)
         v = _repeat_kv(v, num_heads)
-    return _fused(q, k, v, causal, scale)
+    # scale (and the exp2 log2(e) base change) rides the [B, S, H, D] q
+    # (fused into the projection by XLA) rather than the [S, S] score tile
+    # inside the kernel; the VJP factor on dq is handled by autodiff here,
+    # outside the custom_vjp
+    return _fused(q * jnp.asarray(scale * LOG2E, q.dtype), k, v, causal)

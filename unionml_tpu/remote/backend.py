@@ -359,12 +359,17 @@ class TPUVMBackend(BaseBackend):
         skip provisioning, mirroring fast registration (remote.py:126-138).
         """
         dest = super().deploy(model, app_version=app_version, patch=patch)
+        # a re-deploy of the same version string (e.g. a second '-dirty'
+        # deploy after edits) must re-push: drop its push-dedup entries
+        self._pushed = {p for p in self._pushed if p[1] != app_version}
         if self.provision and not patch:
+            from concurrent.futures import ThreadPoolExecutor
+
             from unionml_tpu.remote import packaging
 
             packaging.build_environment_bundle(dest)
-            errors = []
-            for host in self.hosts:
+
+            def provision_host(host: str) -> Optional[str]:
                 target = self._push(host, dest, app_version)
                 proc = self._run_ssh(
                     host,
@@ -379,7 +384,13 @@ class TPUVMBackend(BaseBackend):
                     f"{target}/_env/*.whl)",
                 )
                 if proc.returncode != 0:
-                    errors.append(f"{host}: {proc.stderr.strip()[-500:]}")
+                    return f"{host}: {proc.stderr.strip()[-500:]}"
+                return None
+
+            # hosts are independent: provision concurrently so deploy time
+            # is max(host), not sum(hosts), on big slices
+            with ThreadPoolExecutor(max_workers=min(16, len(self.hosts))) as pool:
+                errors = [e for e in pool.map(provision_host, self.hosts) if e]
             if errors:
                 raise RuntimeError(
                     "environment provisioning failed on "
@@ -415,13 +426,25 @@ class TPUVMBackend(BaseBackend):
         trained model: stage the one SUCCEEDED train execution the runner
         will ask for (latest or pinned) into ``{root}/executions`` on each
         host — the runner's ``_load_model_artifact`` then finds it through
-        the same registry layout it uses locally.
+        the same registry layout it uses locally. The staged record's
+        ``exec_dir`` is rewritten to the HOST-side path first: the
+        deployer-local path inside record.json would send the runner's
+        ``fetch_outputs`` to a directory that doesn't exist over there.
         """
+        import shutil
+        import tempfile
+
         src = self.get_model_execution(None, model_version=model_version or "latest")
         remote_dir = f"{self.root}/executions/{self.project}/{src.execution_id}"
-        for host in self.hosts:
-            self._run_ssh_checked(host, f"mkdir -p {remote_dir}")
-            self._scp_to(host, f"{src.exec_dir}/.", remote_dir)
+        with tempfile.TemporaryDirectory(prefix="unionml_tpu_stage_") as tmp:
+            stage = Path(tmp) / src.execution_id
+            shutil.copytree(src.exec_dir, stage)
+            data = json.loads((stage / "record.json").read_text())
+            data["exec_dir"] = remote_dir
+            (stage / "record.json").write_text(json.dumps(data))
+            for host in self.hosts:
+                self._run_ssh_checked(host, f"mkdir -p {remote_dir}")
+                self._scp_to(host, f"{stage}/.", remote_dir)
 
     def _launch(self, record, dep_dir, manifest, *, model_version):
         targets = [self._push(host, dep_dir, record.app_version) for host in self.hosts]

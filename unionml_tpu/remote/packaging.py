@@ -90,7 +90,7 @@ def pinned_requirements() -> str:
         specs = []
     lines = []
     for spec in specs:
-        name = re.split(r"[><=!~\[;]", spec, 1)[0].strip()
+        name = re.split(r"[><=!~\[;]", spec, maxsplit=1)[0].strip()
         try:
             lines.append(f"{name}=={metadata.version(name)}")
         except metadata.PackageNotFoundError:
@@ -115,6 +115,13 @@ def build_environment_bundle(dest_dir) -> Path:
     env_dir = Path(dest_dir) / "_env"
     env_dir.mkdir(parents=True, exist_ok=True)
     root = framework_root()
+    if not (root / "pyproject.toml").exists():
+        raise RuntimeError(
+            "environment provisioning requires a source checkout of "
+            f"unionml_tpu (no pyproject.toml at {root}); for a pip-installed "
+            "framework, pre-provision the hosts and set provision: false in "
+            "the backend config"
+        )
     with tempfile.TemporaryDirectory(prefix="unionml_tpu_wheel_") as tmp:
         # build from a minimal copy: setuptools writes build/ + *.egg-info
         # into the source dir, which would dirty the git tree and trip the
