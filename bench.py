@@ -66,7 +66,12 @@ def main() -> None:
     # jax.block_until_ready alone does not block on tunneled TPU backends
     for _ in range(warmup):
         state, metrics = step(state, (images, labels))
-    float(metrics["loss"])
+    # drain with a param element — a loss readback does not gate through
+    # the tunnel and would leave warmup backlog inside window 1 (window 2
+    # was already protected: it starts after window 1's param readback)
+    from benchmarks._timing import drain
+
+    drain(state)
 
     # best of two windows: the tunneled backend occasionally hits external
     # contention that halves a single window's throughput (observed 658
@@ -83,7 +88,7 @@ def main() -> None:
             state, metrics = step(state, (images, labels))
         # read back a post-update param element: data-dependent on the
         # final step's bwd+adamw, which chains through every donated state
-        _ = float(jax.tree_util.tree_leaves(state.params)[0].ravel()[0])
+        drain(state)
         dt = time.perf_counter() - t0
         best_dt = dt if best_dt is None else min(best_dt, dt)
 

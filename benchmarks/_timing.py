@@ -1,0 +1,18 @@
+"""Shared timing discipline for every benchmark script (BASELINE.md
+"timing methodology" + "scalar-readback hazard").
+
+Through the tunneled TPU backend, neither ``jax.block_until_ready`` nor
+a scalar METRIC readback (``float(metrics["loss"])``) actually gates on
+the enqueued work — a loss-drained warmup under-reported BERT-base by
+~30% in round 1. The only trustworthy fence is reading a post-update
+PARAM element, which chains through every donated training step.
+"""
+
+from __future__ import annotations
+
+
+def drain(state) -> float:
+    """Fence: block until the step chain producing ``state`` is done."""
+    import jax
+
+    return float(jax.tree_util.tree_leaves(state.params)[0].ravel()[0])

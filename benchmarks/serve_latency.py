@@ -69,6 +69,15 @@ def random_quantized_params(qmodule, seed: int = 0):
         qmodule.init, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
     )["params"]
     flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
+    # leaf-name -> sibling-names map: a "scale" leaf is quant metadata only
+    # next to its int8 kernel (RMSNorm gains are ALSO named "scale" and
+    # must get ones, not the tiny dequant constant)
+    sibling_names = {}
+    for path, _ in flat:
+        parent = tuple(p.key if hasattr(p, "key") else str(p) for p in path[:-1])
+        sibling_names.setdefault(parent, set()).add(
+            path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        )
 
     @partial(jax.jit, static_argnums=(1,))
     def int8_leaf(key, shape):
@@ -82,10 +91,15 @@ def random_quantized_params(qmodule, seed: int = 0):
     leaves = []
     for path, s in flat:
         name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        parent = tuple(p.key if hasattr(p, "key") else str(p) for p in path[:-1])
+        siblings = sibling_names[parent]
+        is_quant_scale = (name == "scale" and "kernel_q" in siblings) or (
+            name.endswith("_scale") and f"{name[: -len('_scale')]}_q" in siblings
+        )
         key, sub = jax.random.split(key)
         if s.dtype == jnp.int8:
             leaves.append(int8_leaf(sub, s.shape))
-        elif name == "scale" or name.endswith("_scale"):
+        elif is_quant_scale:
             # uniform int8 in [-127,127] has std ~73; scale so the
             # effective weight std lands near lecun 1/sqrt(K)
             k_in = qmodule.config.hidden_dim

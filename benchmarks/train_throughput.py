@@ -25,15 +25,15 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 
 def _time_steps(step, state, batch, steps, warmup):
-    import jax
+    from benchmarks._timing import drain
 
     for _ in range(warmup):
         state, metrics = step(state, batch)
-    _ = float(metrics["loss"])
+    drain(state)
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, batch)
-    _ = float(jax.tree_util.tree_leaves(state.params)[0].ravel()[0])
+    drain(state)
     return time.perf_counter() - t0
 
 

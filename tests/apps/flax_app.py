@@ -1,0 +1,68 @@
+"""Fixture app with a JAX TrainState model object (remote-transport test:
+train states are not picklable — optax closures — so the backend moves
+them as saver bytes, remote/artifacts.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from unionml_tpu import Dataset, Model
+from unionml_tpu.models import Mlp, MlpConfig, classification_step, create_train_state
+from unionml_tpu.models.train import TrainState
+
+dataset = Dataset(name="flax_fixture_data", test_size=0.25)
+model = Model(name="flax_fixture_model", dataset=dataset)
+
+_module = Mlp(MlpConfig(num_classes=2, hidden_dims=(16,)))
+
+
+@dataset.reader
+def reader(n: int = 64) -> dict:
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    return {"features": x, "targets": y}
+
+
+@dataset.splitter
+def splitter(data: dict, test_size: float, shuffle: bool, random_state: int):
+    k = int(len(data["features"]) * (1 - test_size))
+    return (
+        {"features": data["features"][:k], "targets": data["targets"][:k]},
+        {"features": data["features"][k:], "targets": data["targets"][k:]},
+    )
+
+
+@dataset.parser
+def parser(data: dict, features, targets):
+    return (data["features"], data["targets"])
+
+
+@model.init
+def init(hyperparameters: dict) -> TrainState:
+    return create_train_state(
+        _module, jnp.zeros((1, 8)),
+        learning_rate=hyperparameters.get("learning_rate", 1e-2),
+    )
+
+
+@model.trainer
+def trainer(state: TrainState, features: np.ndarray, targets: np.ndarray,
+            *, epochs: int = 30) -> TrainState:
+    step = jax.jit(classification_step(_module))
+    batch = (jnp.asarray(features), jnp.asarray(targets))
+    for _ in range(epochs):
+        state, _ = step(state, batch)
+    return state
+
+
+@model.predictor
+def predictor(state: TrainState, features: np.ndarray) -> list:
+    logits = state.apply_fn({"params": state.params}, jnp.asarray(features))
+    return [int(i) for i in jnp.argmax(logits, axis=-1)]
+
+
+@model.evaluator
+def evaluator(state: TrainState, features: np.ndarray, targets: np.ndarray) -> float:
+    logits = state.apply_fn({"params": state.params}, jnp.asarray(features))
+    return float((jnp.argmax(logits, -1) == jnp.asarray(targets)).mean())

@@ -5,6 +5,8 @@ LocalBackend subprocess sandbox standing in for the Flyte sandbox)."""
 import sys
 from pathlib import Path
 
+import numpy as np
+
 import pytest
 
 APPS_DIR = Path(__file__).parent.parent / "apps"
@@ -345,3 +347,32 @@ def test_tpuvm_registry_staging_rewrites_exec_dir(tpuvm_model, monkeypatch):
     assert preds == [1.0, 0.0]
     assert staged, "registry staging never happened"
     assert staged["exec_dir"] == staged["dst"]
+
+
+def test_remote_train_with_jax_train_state_artifact(monkeypatch, tmp_path):
+    """TrainState model objects cross the execution boundary: they are not
+    picklable (optax closures), so the runner encodes them as the app's
+    saver bytes and remote_load/_load_model_artifact decode them back
+    (remote/artifacts.py). Covers remote_train AND remote_predict."""
+    monkeypatch.setenv("UNIONML_TPU_HOME", str(tmp_path / "backend"))
+    sys.path.insert(0, str(APPS_DIR))
+    try:
+        import flax_app
+
+        flax_app.model._backend = None
+        flax_app.model.remote(project="flax-fixture")
+        flax_app.model.remote_deploy(app_version="v1")
+        artifact = flax_app.model.remote_train(
+            app_version="v1", hyperparameters={"learning_rate": 1e-2}, n=64
+        )
+        import jax
+
+        assert jax.tree_util.tree_leaves(artifact.model_object.params)
+        assert artifact.metrics["test"] >= 0.8
+
+        preds = flax_app.model.remote_predict(
+            features=np.ones((4, 8), dtype=np.float32)
+        )
+        assert preds == [1, 1, 1, 1]
+    finally:
+        sys.path.remove(str(APPS_DIR))

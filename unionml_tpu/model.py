@@ -937,10 +937,12 @@ class Model(TrackedInstance):
     def remote_load(self, execution):
         """Load the model artifact from an execution
         (reference: model.py:872-894)."""
+        from unionml_tpu.remote.artifacts import decode_model_object
+
         execution = self._remote.wait(execution)
         outputs = self._remote.fetch_outputs(execution)
         self.artifact = ModelArtifact(
-            outputs.get("model_object"),
+            decode_model_object(self, outputs.get("model_object")),
             outputs.get("hyperparameters"),
             outputs.get("metrics"),
         )

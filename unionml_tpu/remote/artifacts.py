@@ -1,0 +1,55 @@
+"""Model-object transport across the execution boundary.
+
+The backend moves workflow outputs as a pickle (the reference moves them
+through Flyte's object store with a FlytePickle fallback —
+reference: model.py:884-894, __init__.py:26-28). JAX training states are
+NOT picklable: the optax ``GradientTransformation`` inside a TrainState
+closes over local functions. When direct pickling fails, the model
+object rides as the app's own saved-artifact bytes (``Model._saver`` —
+msgpack for pytrees, pytree_io.py) and is rehydrated on the consuming
+side with ``Model._loader``, which rebuilds the structure through the
+app's ``init`` exactly like ``Model.load`` does.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any
+
+SAVED_KEY = "__unionml_tpu_saved_artifact__"
+
+
+def encode_model_object(model, model_object: Any, hyperparameters: Any = None) -> Any:
+    """Saved-artifact stand-in for an unpicklable ``model_object``."""
+    buf = io.BytesIO()
+    model._saver(model_object, hyperparameters, buf)
+    return {SAVED_KEY: buf.getvalue()}
+
+
+def dump_outputs(model, outputs: dict, file) -> None:
+    """Pickle workflow outputs, falling back to saver-encoded model bytes.
+
+    The success path serializes exactly once (no throwaway picklability
+    probe of a possibly multi-hundred-MB object); only when the whole
+    outputs dict fails to pickle is the model object re-encoded through
+    the app's saver and the dump retried.
+    """
+    try:
+        blob = pickle.dumps(outputs)
+    except Exception:
+        outputs = {
+            **outputs,
+            "model_object": encode_model_object(
+                model, outputs.get("model_object"), outputs.get("hyperparameters")
+            ),
+        }
+        blob = pickle.dumps(outputs)
+    file.write(blob)
+
+
+def decode_model_object(model, obj: Any) -> Any:
+    """Inverse of :func:`encode_model_object` on the consuming side."""
+    if isinstance(obj, dict) and SAVED_KEY in obj:
+        return model._loader(io.BytesIO(obj[SAVED_KEY]))
+    return obj

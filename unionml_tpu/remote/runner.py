@@ -42,9 +42,12 @@ def _load_model_artifact(model, exec_dir: Path, model_version: str):
     record = backend.get_model_execution(model, model_version=model_version)
     outputs = backend.fetch_outputs(record)
     from unionml_tpu.model import ModelArtifact
+    from unionml_tpu.remote.artifacts import decode_model_object
 
     model.artifact = ModelArtifact(
-        outputs["model_object"], outputs.get("hyperparameters"), outputs.get("metrics")
+        decode_model_object(model, outputs["model_object"]),
+        outputs.get("hyperparameters"),
+        outputs.get("metrics"),
     )
 
 
@@ -102,8 +105,12 @@ def main(argv=None) -> int:
         # only process 0 writes outputs on multi-host runs
         process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
         if process_id == 0:
+            from unionml_tpu.remote.artifacts import dump_outputs
+
             with open(exec_dir / "outputs.pkl", "wb") as f:
-                pickle.dump(outputs, f)
+                # JAX train states aren't picklable (optax closures):
+                # dump falls back to the app's saver bytes
+                dump_outputs(model, outputs, f)
             _set_status(exec_dir, "SUCCEEDED")
         return 0
     except Exception:
