@@ -1,7 +1,10 @@
 # Developer entry points (reference analog: the upstream Makefile).
 # Tests force the CPU-simulated 8-device mesh via tests/conftest.py.
 
-.PHONY: test lint bench bench-all notebooks dryrun
+.PHONY: test lint docs bench bench-all notebooks dryrun
+
+docs:
+	python scripts/gen_api_reference.py
 
 test:
 	python -m pytest tests/ -x -q
@@ -22,7 +25,9 @@ bench-all: bench
 	python benchmarks/train_throughput.py
 	python benchmarks/serve_latency.py
 	UNIONML_TPU_BENCH_PRESET=serve_moe python benchmarks/serve_latency.py
+	UNIONML_TPU_BENCH_PRESET=serve_8b python benchmarks/serve_latency.py
 	python benchmarks/attn_kernels.py
+	PYTHONPATH=.:$$PYTHONPATH python benchmarks/remote_bert/app.py
 
 notebooks:
 	python scripts/myst_to_ipynb.py docs/tutorials/*.md

@@ -196,3 +196,145 @@ def test_elastic_fresh_run_no_checkpoint(tmp_path):
     from unionml_tpu.checkpoint.sharded import CheckpointManager
 
     assert CheckpointManager(str(tmp_path / "fresh")).latest_step() == 2
+
+
+def _stream_batches(start, stop):
+    """Deterministic step-indexed batch stream (batch = f(step index))."""
+    for i in range(start, stop):
+        rng = np.random.default_rng(1000 + i)
+        xb = rng.normal(size=(16, 4)).astype(np.float32)
+        yb = (xb.sum(axis=1) > 0).astype(np.int32)
+        yield (xb, yb)
+
+
+def test_elastic_stream_seekable_resume_identical(tmp_path):
+    """Streaming elastic resume, seekable form: stream(start_step) is
+    called with the resume position; killed+resumed == uninterrupted."""
+    from unionml_tpu.elastic import Preemption, run_elastic_trainer
+
+    step, state0, *_ = _make_problem()
+
+    ref_state, ref_steps = run_elastic_trainer(
+        step_fn=step, state=state0, stream=lambda start: _stream_batches(start, 8),
+        num_steps=8, checkpoint_dir=str(tmp_path / "ref"), checkpoint_every=3,
+    )
+    assert ref_steps == 8
+
+    step2, state2, *_ = _make_problem()
+    seek_calls = []
+
+    def seekable(start):
+        seek_calls.append(start)
+        return _stream_batches(start, 8)
+
+    with pytest.raises(Preemption):
+        run_elastic_trainer(
+            step_fn=step2, state=state2, stream=seekable, num_steps=8,
+            checkpoint_dir=str(tmp_path / "pre"), checkpoint_every=3,
+            fault_hook=lambda s: (_ for _ in ()).throw(Preemption())
+            if s == 4 else None,
+        )
+    step3, state3, *_ = _make_problem()
+    resumed, steps = run_elastic_trainer(
+        step_fn=step3, state=state3, stream=seekable, num_steps=8,
+        checkpoint_dir=str(tmp_path / "pre"), checkpoint_every=3,
+    )
+    assert steps == 8
+    assert seek_calls == [0, 3]  # resumed from the step-3 checkpoint, sought
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref_state.params),
+        jax.tree_util.tree_leaves(resumed.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_elastic_stream_replay_skip_resume_identical(tmp_path):
+    """Zero-arg (replayable) streams resume by skipping consumed batches."""
+    from unionml_tpu.elastic import Preemption, run_elastic_trainer
+
+    step, state0, *_ = _make_problem()
+    ref_state, _ = run_elastic_trainer(
+        step_fn=step, state=state0, stream=lambda start: _stream_batches(start, 6),
+        num_steps=6, checkpoint_dir=str(tmp_path / "ref"), checkpoint_every=2,
+    )
+
+    step2, state2, *_ = _make_problem()
+    with pytest.raises(Preemption):
+        run_elastic_trainer(
+            step_fn=step2, state=state2, stream=lambda: _stream_batches(0, 6),
+            num_steps=6, checkpoint_dir=str(tmp_path / "pre"), checkpoint_every=2,
+            fault_hook=lambda s: (_ for _ in ()).throw(Preemption())
+            if s == 3 else None,
+        )
+    step3, state3, *_ = _make_problem()
+    resumed, steps = run_elastic_trainer(
+        step_fn=step3, state=state3, stream=lambda: _stream_batches(0, 6),
+        num_steps=6, checkpoint_dir=str(tmp_path / "pre"), checkpoint_every=2,
+    )
+    assert steps == 6
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref_state.params),
+        jax.tree_util.tree_leaves(resumed.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_elastic_stream_exhaustion_checkpoints_terminal_step(tmp_path):
+    from unionml_tpu.checkpoint.sharded import CheckpointManager
+    from unionml_tpu.elastic import run_elastic_trainer
+
+    step, state0, *_ = _make_problem()
+    _, steps = run_elastic_trainer(
+        step_fn=step, state=state0, stream=lambda start: _stream_batches(start, 5),
+        checkpoint_dir=str(tmp_path / "ex"), checkpoint_every=100,
+    )
+    assert steps == 5
+    assert CheckpointManager(str(tmp_path / "ex")).latest_step() == 5
+    # a restart resumes at 5 and trains nothing further
+    step2, state2, *_ = _make_problem()
+    _, steps2 = run_elastic_trainer(
+        step_fn=step2, state=state2, stream=lambda start: _stream_batches(start, 5),
+        checkpoint_dir=str(tmp_path / "ex"), checkpoint_every=100,
+    )
+    assert steps2 == 5
+
+
+def test_elastic_rejects_ambiguous_sources(tmp_path):
+    from unionml_tpu.elastic import run_elastic_trainer
+
+    step, state0, x, y = _make_problem()
+    with pytest.raises(ValueError, match="exactly one"):
+        run_elastic_trainer(
+            step_fn=step, state=state0, arrays=[x, y],
+            stream=lambda: iter(()), checkpoint_dir=str(tmp_path / "z"),
+        )
+    with pytest.raises(ValueError, match="exactly one"):
+        run_elastic_trainer(
+            step_fn=step, state=state0, checkpoint_dir=str(tmp_path / "z")
+        )
+
+
+def test_elastic_stream_guards_truncated_replay_and_bad_signature(tmp_path):
+    from unionml_tpu.elastic import run_elastic_trainer
+
+    step, state0, *_ = _make_problem()
+    # run 4 steps, checkpoint at 2 and 4
+    run_elastic_trainer(
+        step_fn=step, state=state0, stream=lambda start: _stream_batches(start, 4),
+        checkpoint_dir=str(tmp_path / "t"), checkpoint_every=2,
+    )
+    # replayable resume whose stream now yields fewer batches than consumed
+    step2, state2, *_ = _make_problem()
+    with pytest.raises(RuntimeError, match="before the resume position"):
+        run_elastic_trainer(
+            step_fn=step2, state=state2, stream=lambda: _stream_batches(0, 2),
+            checkpoint_dir=str(tmp_path / "t"), checkpoint_every=2,
+        )
+    # required keyword-only param fits neither contract -> named error
+    step3, state3, *_ = _make_problem()
+    with pytest.raises(ValueError, match="positional"):
+        run_elastic_trainer(
+            step_fn=step3, state=state3,
+            stream=lambda *, start: _stream_batches(start, 4),
+            checkpoint_dir=str(tmp_path / "t2"),
+        )

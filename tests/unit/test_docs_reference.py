@@ -1,0 +1,20 @@
+"""Generated API/CLI references must match the committed files
+(reference analog: Sphinx builds docs in CI, build.yml)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).parent.parent.parent
+
+
+def test_generated_references_are_current():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "gen_api_reference.py"), "--check"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, (
+        f"docs/api_reference.md or docs/cli_reference.md is stale — "
+        f"regenerate with `python scripts/gen_api_reference.py`\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )

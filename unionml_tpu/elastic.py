@@ -37,7 +37,9 @@ def run_elastic_trainer(
     *,
     step_fn: Callable,
     state: Any,
-    arrays: Sequence[np.ndarray],
+    arrays: Optional[Sequence[np.ndarray]] = None,
+    stream: Optional[Callable] = None,
+    num_steps: Optional[int] = None,
     checkpoint_dir: str,
     num_epochs: int = 1,
     batch_size: int = 32,
@@ -59,7 +61,27 @@ def run_elastic_trainer(
     Global step indexes the stream ``epoch * steps_per_epoch + batch``;
     checkpoints are written under ``checkpoint_dir/step_{global_step}``
     where the state has already consumed batch ``global_step - 1``.
+
+    **Streaming sources** (the execution.py streaming-trainer contract,
+    made resumable): pass ``stream`` instead of ``arrays`` — a callable
+    producing ready batches, treated as ONE step-indexed sequence
+    (``num_epochs`` does not apply; bound it with ``num_steps`` or let it
+    run to exhaustion). Resume semantics depend on the callable's
+    signature:
+
+    - ``stream(start_step)`` — seekable: called with the resume step, it
+      must yield the batches from that position (e.g. reopen a file at a
+      record offset). The cheap path.
+    - ``stream()`` — replayable: called from the top and the first
+      ``resume_step`` batches are SKIPPED host-side. Correct for any
+      deterministic stream, but resume cost grows with position — prefer
+      the seekable form for long runs.
+
+    A final checkpoint is always written at exhaustion, so a finished
+    stream run restores at its last step like an array run.
     """
+    if (arrays is None) == (stream is None):
+        raise ValueError("pass exactly one of arrays= or stream=")
     if sharding is not None:
         from unionml_tpu.parallel import compile_step
 
@@ -68,6 +90,14 @@ def run_elastic_trainer(
         from unionml_tpu.execution import _jitted
 
         step = _jitted(step_fn, donate_state)
+
+    if stream is not None:
+        return _run_stream(
+            step, state, stream,
+            checkpoint_dir=checkpoint_dir, num_steps=num_steps,
+            checkpoint_every=checkpoint_every, max_to_keep=max_to_keep,
+            fault_hook=fault_hook,
+        )
 
     loader = BatchLoader(
         list(arrays), batch_size=batch_size, seed=seed, shuffle=True,
@@ -114,4 +144,91 @@ def run_elastic_trainer(
         manager.close()
 
     logger.info(f"elastic trainer: finished at step {global_step}/{total_steps}")
+    return state, global_step
+
+
+def _run_stream(
+    step: Callable,
+    state: Any,
+    stream: Callable,
+    *,
+    checkpoint_dir: str,
+    num_steps: Optional[int],
+    checkpoint_every: int,
+    max_to_keep: int,
+    fault_hook: Optional[Callable[[int], None]],
+) -> Tuple[Any, int]:
+    """Step-indexed resumable loop over a streaming batch source."""
+    import inspect
+
+    manager = CheckpointManager(checkpoint_dir, max_to_keep=max_to_keep)
+    global_step = 0
+    resume_step = manager.latest_step()
+    if resume_step is not None:
+        state = manager.restore(state, step=resume_step)
+        global_step = resume_step
+        logger.info(f"elastic trainer: resuming stream from step {global_step}")
+    if num_steps is not None and global_step >= num_steps:
+        manager.close()
+        return state, global_step
+
+    params = inspect.signature(stream).parameters.values()
+    required = [p for p in params if p.default is inspect.Parameter.empty
+                and p.kind is not inspect.Parameter.VAR_KEYWORD
+                and p.kind is not inspect.Parameter.VAR_POSITIONAL]
+    if any(p.kind is inspect.Parameter.KEYWORD_ONLY for p in required):
+        raise ValueError(
+            "stream callables take the resume step as ONE positional "
+            "argument (seekable form) or no required arguments (replayable "
+            "form); a required keyword-only parameter fits neither — see "
+            "run_elastic_trainer's streaming contract"
+        )
+    seekable = bool(required)
+    if seekable:
+        batches = stream(global_step)
+        skip = 0
+    else:
+        batches = stream()
+        skip = global_step
+        if skip:
+            logger.info(
+                f"elastic trainer: replaying stream, skipping {skip} "
+                "consumed batches (pass stream(start_step) to seek instead)"
+            )
+    trained = 0
+    try:
+        for batch in batches:
+            if skip:
+                skip -= 1
+                continue
+            state, _metrics = step(state, batch)
+            global_step += 1
+            trained += 1
+            at_bound = num_steps is not None and global_step >= num_steps
+            if global_step % checkpoint_every == 0 or at_bound:
+                manager.save(global_step, state)
+            if fault_hook is not None:
+                fault_hook(global_step)
+            if at_bound:
+                break
+        else:
+            if skip:
+                # the replayed stream ended BEFORE the resume position:
+                # returning "finished" would silently bless a truncated or
+                # non-deterministic source
+                raise RuntimeError(
+                    f"stream exhausted {skip} batches before the resume "
+                    f"position (step {global_step}): the replayed stream "
+                    "must reproduce at least the batches already consumed"
+                )
+            # stream exhausted: persist the terminal position so a restart
+            # resumes AFTER the last consumed batch instead of re-training
+            # — unless nothing ran since resume (the state is unchanged and
+            # a terminal checkpoint for it already exists)
+            if trained and global_step % checkpoint_every != 0:
+                manager.save(global_step, state)
+    finally:
+        manager.close()
+
+    logger.info(f"elastic trainer: stream finished at step {global_step}")
     return state, global_step
