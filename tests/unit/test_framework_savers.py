@@ -1,0 +1,134 @@
+"""Per-framework artifact savers/loaders (reference: model.py:931-988 and
+the sklearn/pytorch/keras app matrix in tests/integration). The sklearn
+and JAX-pytree paths are covered elsewhere (test_model.py,
+test_train_step.py); THIS file covers the torch state_dict and keras
+.save/load_model dispatch with full train -> save -> wipe -> load ->
+predict roundtrips."""
+
+import numpy as np
+import pytest
+
+from unionml_tpu import Dataset, Model
+
+
+def _make_dataset(name):
+    dataset = Dataset(name=name, test_size=0.25)
+
+    @dataset.reader
+    def reader(n: int = 48) -> dict:
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(n, 4)).astype(np.float32)
+        y = (x.sum(axis=1) > 0).astype(np.int64)
+        return {"features": x, "targets": y}
+
+    @dataset.splitter
+    def splitter(data: dict, test_size: float, shuffle: bool, random_state: int):
+        k = int(len(data["features"]) * (1 - test_size))
+        return (
+            {"features": data["features"][:k], "targets": data["targets"][:k]},
+            {"features": data["features"][k:], "targets": data["targets"][k:]},
+        )
+
+    @dataset.parser
+    def parser(data: dict, features, targets):
+        return (data["features"], data["targets"])
+
+    return dataset
+
+
+def test_pytorch_artifact_roundtrip(tmp_path):
+    import torch
+
+    class Net(torch.nn.Module):
+        def __init__(self, hidden: int = 8):
+            super().__init__()
+            self.fc1 = torch.nn.Linear(4, hidden)
+            self.fc2 = torch.nn.Linear(hidden, 2)
+
+        def forward(self, x):
+            return self.fc2(torch.relu(self.fc1(x)))
+
+    model = Model(name="pt_model", init=Net, dataset=_make_dataset("pt_data"))
+
+    @model.trainer
+    def trainer(net: Net, features: np.ndarray, targets: np.ndarray) -> Net:
+        opt = torch.optim.SGD(net.parameters(), lr=0.1)
+        x = torch.as_tensor(features)
+        y = torch.as_tensor(targets)
+        for _ in range(30):
+            opt.zero_grad()
+            loss = torch.nn.functional.cross_entropy(net(x), y)
+            loss.backward()
+            opt.step()
+        return net
+
+    @model.predictor
+    def predictor(net: Net, features: np.ndarray) -> list:
+        with torch.no_grad():
+            return [int(i) for i in net(torch.as_tensor(features)).argmax(-1)]
+
+    @model.evaluator
+    def evaluator(net: Net, features: np.ndarray, targets: np.ndarray) -> float:
+        with torch.no_grad():
+            preds = net(torch.as_tensor(features)).argmax(-1).numpy()
+        return float((preds == targets).mean())
+
+    _, metrics = model.train(hyperparameters={"hidden": 8}, n=48)
+    assert metrics["train"] > 0.7
+    probe = np.array([[2.0, 2.0, 2.0, 2.0], [-2.0, -2.0, -2.0, -2.0]], np.float32)
+    before = model.predict(features=probe)
+
+    path = tmp_path / "model.pt"
+    model.save(str(path))
+    model.artifact = None
+    with pytest.raises(RuntimeError, match="ModelArtifact not found"):
+        model.predict(features=probe)
+    # default loader rebuilds Net from the SAVED hyperparameters, then
+    # load_state_dict (reference: model.py:965-980)
+    loaded = model.load(str(path))
+    import torch as _t
+
+    assert isinstance(loaded, Net)
+    assert model.predict(features=probe) == before == [1, 0]
+
+
+def test_keras_artifact_roundtrip(tmp_path):
+    keras = pytest.importorskip("tensorflow.keras", reason="keras not installed")
+
+    # the return annotation IS the framework dispatch: model_type comes
+    # from init (reference: model.py:920-922) and routes the keras saver
+    def build(hidden: int = 8) -> keras.Model:
+        m = keras.Sequential([
+            keras.layers.Input((4,)),
+            keras.layers.Dense(hidden, activation="relu"),
+            keras.layers.Dense(2),
+        ])
+        m.compile(optimizer="adam",
+                  loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True))
+        return m
+
+    model = Model(name="keras_model", init=build, dataset=_make_dataset("keras_data"))
+
+    @model.trainer
+    def trainer(net: keras.Model, features: np.ndarray, targets: np.ndarray) -> keras.Model:
+        net.fit(features, targets, epochs=20, verbose=0)
+        return net
+
+    @model.predictor
+    def predictor(net: keras.Model, features: np.ndarray) -> list:
+        return [int(i) for i in net.predict(features, verbose=0).argmax(-1)]
+
+    @model.evaluator
+    def evaluator(net: keras.Model, features: np.ndarray, targets: np.ndarray) -> float:
+        preds = net.predict(features, verbose=0).argmax(-1)
+        return float((preds == targets).mean())
+
+    model.train(hyperparameters={"hidden": 8}, n=48)
+    probe = np.array([[2.0, 2.0, 2.0, 2.0], [-2.0, -2.0, -2.0, -2.0]], np.float32)
+    before = model.predict(features=probe)
+
+    path = tmp_path / "model.keras"
+    model.save(str(path))
+    model.artifact = None
+    model.load(str(path))
+    assert model.predict(features=probe) == before

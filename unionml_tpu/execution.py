@@ -28,10 +28,19 @@ from unionml_tpu._logging import logger
 @functools.lru_cache(maxsize=128)
 def _jitted(fn: Callable, donate_state: bool):
     """Per-function jit cache (bounded: entries pin user closures + XLA
-    executables, which can be large for big models)."""
+    executables, which can be large for big models). Interactive sessions
+    that re-define step functions churn entries that pin executables until
+    eviction — call :func:`clear_jit_cache` to drop them eagerly."""
     import jax
 
     return jax.jit(fn, donate_argnums=(0,) if donate_state else ())
+
+
+def clear_jit_cache() -> None:
+    """Drop every cached jit wrapper (and the XLA executables + user
+    closures it pins). Useful in long-lived interactive sessions after
+    re-defining step functions or models."""
+    _jitted.cache_clear()
 
 
 def jit_predictor(fn: Callable) -> Callable:
