@@ -37,7 +37,7 @@ def _make_dataset(name):
 
 
 def test_pytorch_artifact_roundtrip(tmp_path):
-    import torch
+    torch = pytest.importorskip("torch", reason="torch not installed")
 
     class Net(torch.nn.Module):
         def __init__(self, hidden: int = 8):
@@ -52,6 +52,9 @@ def test_pytorch_artifact_roundtrip(tmp_path):
 
     @model.trainer
     def trainer(net: Net, features: np.ndarray, targets: np.ndarray) -> Net:
+        torch.manual_seed(0)  # deterministic init -> stable assertions
+        for layer in (net.fc1, net.fc2):
+            layer.reset_parameters()
         opt = torch.optim.SGD(net.parameters(), lr=0.1)
         x = torch.as_tensor(features)
         y = torch.as_tensor(targets)
@@ -86,8 +89,6 @@ def test_pytorch_artifact_roundtrip(tmp_path):
     # default loader rebuilds Net from the SAVED hyperparameters, then
     # load_state_dict (reference: model.py:965-980)
     loaded = model.load(str(path))
-    import torch as _t
-
     assert isinstance(loaded, Net)
     assert model.predict(features=probe) == before == [1, 0]
 
