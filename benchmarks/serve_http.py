@@ -1,0 +1,164 @@
+"""End-to-end HTTP serving p50 (the BASELINE.json north-star metric at
+its true boundary: "FastAPI predictor p50 latency").
+
+serve_latency.py times ``generate()`` directly; THIS script measures the
+full request path — HTTP transport -> ServingApp -> row-list
+micro-batcher -> bucketed jitted prefill+decode -> response — for a
+single client (pure latency) and for concurrent clients (the
+micro-batcher coalescing window). One JSON line per scenario.
+
+Usage (on the TPU)::
+
+    python benchmarks/serve_http.py [--requests 20] [--clients 8]
+    UNIONML_TPU_BENCH_PRESET=tiny JAX_PLATFORMS=cpu python benchmarks/serve_http.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--requests", type=int, default=20)
+    parser.add_argument("--clients", type=int, default=8)
+    parser.add_argument("--prompt-len", type=int, default=64)
+    parser.add_argument("--new-tokens", type=int, default=32)
+    args = parser.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu import Dataset, Model
+    from unionml_tpu.models import (
+        LLAMA_QUANT_PATTERNS,
+        Llama,
+        LlamaConfig,
+        make_lm_predictor,
+        quantize_params,
+    )
+    from unionml_tpu.serving.http import ServingApp
+    from benchmarks.serve_latency import serving_config
+
+    preset = os.environ.get(
+        "UNIONML_TPU_BENCH_PRESET",
+        "tiny" if jax.default_backend() == "cpu" else "serve_1p5b",
+    )
+    if preset == "tiny":
+        args.requests = min(args.requests, 3)
+    cfg = serving_config(preset)
+    qcfg = LlamaConfig(**{**cfg.__dict__, "quantized": True})
+    qmodule = Llama(qcfg)
+
+    # int8 artifact, exactly the serve_latency production path
+    fp_params = jax.jit(Llama(cfg).init)(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    qparams = quantize_params(fp_params, LLAMA_QUANT_PATTERNS)
+
+    dataset = Dataset(name="http_bench_data", targets=[])
+
+    @dataset.reader
+    def reader() -> list:
+        return []
+
+    model = Model(name="http_bench_lm", init=lambda: qparams, dataset=dataset)
+
+    predict = make_lm_predictor(
+        qmodule, max_new_tokens=args.new_tokens,
+        bucket_lens=(args.prompt_len,),
+    )
+
+    @model.trainer
+    def trainer(params: dict, features: list) -> dict:
+        return params
+
+    @model.predictor
+    def predictor(params: dict, prompts: list) -> list:
+        return predict(params, prompts)
+
+    from unionml_tpu.model import ModelArtifact
+
+    model.artifact = ModelArtifact(qparams, {}, {})
+
+    serving = ServingApp(
+        model, batch=True, row_lists=True, max_wait_ms=3.0,
+        # pre-compile every (bucket, batch-power) executable: without
+        # this, first-hit shapes stall live requests behind ~20 s XLA
+        # compiles (measured 17.9 s p95 under 8 concurrent clients)
+        warmup=lambda params: predict.warmup(params, max_batch=args.clients),
+    )
+    host, port = serving.serve(port=0, blocking=False)
+
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, cfg.vocab_size, size=(args.prompt_len,)).tolist()
+    body = json.dumps({"features": [prompt]}).encode()
+
+    def request() -> float:
+        req = urllib.request.Request(
+            f"http://{host}:{port}/predict", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            out = json.loads(resp.read())
+        assert isinstance(out, list) and len(out[0]) == args.new_tokens
+        return (time.perf_counter() - t0) * 1e3
+
+    request()  # warmup/compile
+
+    # single client: pure request latency
+    lat = sorted(request() for _ in range(args.requests))
+    p50 = lat[len(lat) // 2]
+    p95 = lat[max(0, math.ceil(0.95 * len(lat)) - 1)]
+    print(json.dumps({
+        "metric": f"{preset}_http_p50_ms", "clients": 1,
+        "value": round(p50, 1), "p95_ms": round(p95, 1), "unit": "ms",
+    }))
+
+    # concurrent clients: the micro-batcher coalesces in-flight requests
+    all_lat: list = []
+    lock = threading.Lock()
+
+    def client():
+        mine = [request() for _ in range(args.requests)]
+        with lock:
+            all_lat.extend(mine)
+
+    threads = [threading.Thread(target=client) for _ in range(args.clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    all_lat.sort()
+    p50 = all_lat[len(all_lat) // 2]
+    p95 = all_lat[max(0, math.ceil(0.95 * len(all_lat)) - 1)]
+    n = args.clients * args.requests
+    print(json.dumps({
+        "metric": f"{preset}_http_p50_ms", "clients": args.clients,
+        "value": round(p50, 1), "p95_ms": round(p95, 1),
+        "requests_per_sec": round(n / wall, 2),
+        "tokens_per_sec": round(n * args.new_tokens / wall, 1),
+        "unit": "ms",
+    }))
+    serving.shutdown()
+
+
+if __name__ == "__main__":
+    main()

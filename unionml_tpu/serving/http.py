@@ -60,14 +60,21 @@ class ServingApp:
         model_version: str = "latest",
         batch: bool = False,
         model_path_env: str = "UNIONML_MODEL_PATH",
+        warmup: Optional[Any] = None,
         **batcher_kwargs,
     ):
+        """``warmup``: optional callable invoked with the loaded model
+        object after ``setup_model`` — pre-compile every serving
+        executable there (e.g. ``make_lm_predictor``'s ``.warmup``), or
+        the first live request per shape stalls behind a multi-second
+        XLA compile."""
         self.model = model
         self.remote = remote
         self.app_version = app_version
         self.model_version = model_version
         self.model_path_env = model_path_env
         self.batch = batch
+        self.warmup = warmup
         self._batcher = None
         self._batcher_kwargs = batcher_kwargs
         self._server: Optional[ThreadingHTTPServer] = None
@@ -102,6 +109,9 @@ class ServingApp:
             self._batcher = MicroBatcher(
                 lambda feats: predictor(model_object, feats), **self._batcher_kwargs
             )
+        if self.warmup is not None:
+            n = self.warmup(self.model.artifact.model_object)
+            logger.info(f"serving warmup done ({n if n is not None else '?'} executables)")
 
     # -- route handlers ---------------------------------------------------
 

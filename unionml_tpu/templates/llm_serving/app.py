@@ -89,6 +89,13 @@ def predictor(params: dict, prompts: list) -> list:
     return _generate(params, prompts)
 
 
+# serve with every executable pre-compiled (first-hit shapes otherwise
+# stall live requests behind multi-second XLA compiles):
+#   serving = ServingApp(model, batch=True, row_lists=True,
+#                        warmup=lambda p: _generate.warmup(p, max_batch=8))
+#   serving.serve()
+
+
 if __name__ == "__main__":
     params, _ = model.train()
     out = model.predict(features=[[1, 5, 9], [2, 4, 6, 8]])
