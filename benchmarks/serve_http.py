@@ -64,11 +64,18 @@ def main() -> None:
     qcfg = LlamaConfig(**{**cfg.__dict__, "quantized": True})
     qmodule = Llama(qcfg)
 
-    # int8 artifact, exactly the serve_latency production path
-    fp_params = jax.jit(Llama(cfg).init)(
-        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
-    )["params"]
-    qparams = quantize_params(fp_params, LLAMA_QUANT_PATTERNS)
+    if preset == "serve_8b":
+        # synthetic int8 weights: an 8B master tree can't be materialized
+        # on-chip to quantize from (see serve_latency.random_quantized_params)
+        from benchmarks.serve_latency import random_quantized_params
+
+        qparams = random_quantized_params(qmodule)
+    else:
+        # int8 artifact, exactly the serve_latency production path
+        fp_params = jax.jit(Llama(cfg).init)(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        qparams = quantize_params(fp_params, LLAMA_QUANT_PATTERNS)
 
     dataset = Dataset(name="http_bench_data", targets=[])
 
