@@ -328,3 +328,15 @@ def test_lm_predictor_warmup_compiles_all_shapes(tiny_llama):
     assert n == 2 * 3  # buckets {8, 16} x batches {1, 2, 4}
     out = pred(params, [[1, 2, 3]])
     assert len(out) == 1 and len(out[0]) == 4
+
+
+def test_warmup_rejects_unusable_bucket(tiny_llama):
+    """A warmup bucket outside the usable set would silently compile the
+    covering bucket instead — callers must get a ValueError, not a false
+    belief that the shape was pre-compiled."""
+    module, params = tiny_llama
+    pred = make_lm_predictor(module, max_new_tokens=4, bucket_lens=(8, 16), max_len=32)
+    with pytest.raises(ValueError, match="not in the usable bucket"):
+        pred.warmup(params, max_batch=1, buckets=(64,))
+    with pytest.raises(ValueError, match="empty bucket tuple"):
+        pred.warmup(params, max_batch=1, buckets=())

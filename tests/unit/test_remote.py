@@ -376,3 +376,40 @@ def test_remote_train_with_jax_train_state_artifact(monkeypatch, tmp_path):
         assert preds == [1, 1, 1, 1]
     finally:
         sys.path.remove(str(APPS_DIR))
+
+
+def test_tpuvm_wait_without_launch_rejected_when_no_shared_fs(tpuvm_model):
+    """wait() from a process that did not launch the execution only sees the
+    record turn terminal when the launcher's scp lands it (shared_fs=False);
+    a timeout must name that cause, not raise a bare TimeoutError."""
+    from unionml_tpu.remote.backend import ExecutionRecord
+
+    model, tmp_path = tpuvm_model
+    backend = _make_tpuvm_backend(tmp_path, ["hostA"], shared_fs=False)
+    exec_dir = tmp_path / "orphan-exec"
+    exec_dir.mkdir()
+    record = ExecutionRecord(
+        execution_id="orphan", project="fixture-project",
+        workflow="train", app_version="v1", exec_dir=str(exec_dir),
+    )
+    record.save()
+    with pytest.raises(TimeoutError, match="shared_fs"):
+        backend.wait(record, timeout=1.0)
+
+
+def test_dump_outputs_names_non_model_offender(fixture_model):
+    """An unpicklable key other than model_object must be named in the
+    error (chained from the original) instead of failing the saver-encoded
+    retry with a second traceback masking the cause."""
+    import io
+
+    from unionml_tpu.remote.artifacts import dump_outputs
+
+    outputs = {
+        "model_object": {"w": 1.0},
+        "hyperparameters": {},
+        "metrics": {"callback": lambda x: x},  # unpicklable, not the model
+    }
+    with pytest.raises(RuntimeError, match="metrics") as err:
+        dump_outputs(fixture_model, outputs, io.BytesIO())
+    assert err.value.__cause__ is not None  # original pickling error chained

@@ -240,11 +240,29 @@ def make_lm_predictor(
         number of executables compiled.
         """
         compiled = 0
+        if buckets is not None:
+            # a bucket outside `usable` (filtered out for leaving no KV-cache
+            # room, or never configured) would silently warm the covering
+            # bucket instead — callers would believe shapes were compiled
+            # that weren't; an empty tuple would silently warm nothing
+            if not buckets:
+                raise ValueError(
+                    "warmup got an empty bucket tuple — pass buckets=None "
+                    "to warm every usable bucket"
+                )
+            unknown = sorted(set(buckets) - set(usable))
+            if unknown:
+                raise ValueError(
+                    f"warmup buckets {unknown} are not in the usable bucket "
+                    f"set {usable} (bucket_lens filtered to those leaving "
+                    f"room for max_new_tokens={max_new_tokens} within "
+                    f"max_len {total_len})"
+                )
         # the predictor pads batches to the next power of two, so warm up
         # through max_batch ROUNDED UP — warmup(max_batch=6) must compile
         # batch 8, the shape a 5- or 6-row request actually runs
         top = 1 << (max(1, max_batch) - 1).bit_length()
-        for b in buckets or usable:
+        for b in usable if buckets is None else buckets:
             n = 1
             while n <= top:
                 predictor(state, np.zeros((n, b), np.int32))

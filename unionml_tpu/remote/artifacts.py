@@ -37,14 +37,49 @@ def dump_outputs(model, outputs: dict, file) -> None:
     """
     try:
         blob = pickle.dumps(outputs)
-    except Exception:
+    except Exception as e:
+        # name the actual offender before retrying: the saver fallback only
+        # helps when model_object is what failed — if some other key is
+        # unpicklable the retry would fail again with a second traceback
+        # masking the original cause. Probe the cheap keys FIRST: serializing
+        # a possibly multi-hundred-MB model_object is pointless whenever any
+        # other key is already known bad.
+        bad = []
+        for k, v in sorted(outputs.items(), key=lambda kv: kv[0] == "model_object"):
+            if bad and k == "model_object":
+                break  # another offender already decides the outcome
+            try:
+                pickle.dumps(v)
+            except Exception:
+                bad.append(k)
+        if bad != ["model_object"]:
+            # bad == []: the failure isn't attributable to any single value
+            # (unpicklable dict key, cross-value cycle) — re-encoding the
+            # model object can't help and would misdirect the diagnosis
+            raise RuntimeError(
+                "workflow outputs are not picklable: "
+                + (
+                    f"offending key(s) {bad}; only 'model_object' has a "
+                    "saver-encoded fallback"
+                    if bad
+                    else "no single value is at fault (every value pickles "
+                    "alone) — likely an unpicklable key or a cycle spanning "
+                    "values"
+                )
+            ) from e
         outputs = {
             **outputs,
             "model_object": encode_model_object(
                 model, outputs.get("model_object"), outputs.get("hyperparameters")
             ),
         }
-        blob = pickle.dumps(outputs)
+        try:
+            blob = pickle.dumps(outputs)
+        except Exception as e2:
+            raise RuntimeError(
+                "model_object could not be pickled directly and its "
+                "saver-encoded fallback also failed to pickle"
+            ) from e2
     file.write(blob)
 
 

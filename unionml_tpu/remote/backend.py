@@ -492,8 +492,26 @@ class TPUVMBackend(BaseBackend):
         """
         launched = self._procs.pop(execution.execution_id, None)
         if launched is None:
-            # not launched by this process: shared-FS record polling only
-            return super().wait(execution, timeout=timeout, poll=poll)
+            # not launched by this process: record polling. With
+            # shared_fs: false the local record only turns terminal when
+            # the LAUNCHING process's wait() scp's it back — a re-wait
+            # after that fetch, or a monitor process on the launcher's
+            # machine, still succeeds; on timeout, append the likely cause
+            # (keeping the TimeoutError type so retry loops still work)
+            try:
+                return super().wait(execution, timeout=timeout, poll=poll)
+            except TimeoutError as e:
+                if not self.shared_fs:
+                    raise TimeoutError(
+                        f"{e} — note: this backend has shared_fs: false and "
+                        "this process did not launch the execution, so the "
+                        "local record only updates when the launching "
+                        "process's wait() fetches it back. If the launcher "
+                        "is gone, this wait can never succeed; call wait() "
+                        "from the process that called execute(), or enable "
+                        "shared_fs."
+                    ) from e
+                raise
         deadline = time.time() + timeout
         failures = []
         # poll ALL hosts concurrently: a crashed worker is detected
