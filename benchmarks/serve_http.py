@@ -2,14 +2,22 @@
 its true boundary: "FastAPI predictor p50 latency").
 
 serve_latency.py times ``generate()`` directly; THIS script measures the
-full request path — HTTP transport -> ServingApp -> row-list
-micro-batcher -> bucketed jitted prefill+decode -> response — for a
-single client (pure latency) and for concurrent clients (the
-micro-batcher coalescing window). One JSON line per scenario.
+full request path — HTTP transport -> ServingApp -> batching layer ->
+device -> response — for a single client (pure latency) and for
+concurrent clients. Two batching modes:
+
+- ``--mode batcher``: the row-list micro-batcher (full-batch generate;
+  a late request waits out the whole in-flight decode),
+- ``--mode engine`` (default): the continuous-batching DecodeEngine
+  (requests join at chunk boundaries — the p95 fix).
+
+Each scenario prints one JSON line; the concurrent line includes the
+``/stats`` split (queue-wait vs prefill vs decode) so tail latency is
+attributable.
 
 Usage (on the TPU)::
 
-    python benchmarks/serve_http.py [--requests 20] [--clients 8]
+    python benchmarks/serve_http.py [--requests 20] [--clients 8] [--mode engine|batcher]
     UNIONML_TPU_BENCH_PRESET=tiny JAX_PLATFORMS=cpu python benchmarks/serve_http.py
 """
 
@@ -17,7 +25,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import math
 import os
 import sys
 import threading
@@ -34,6 +41,20 @@ def main() -> None:
     parser.add_argument("--clients", type=int, default=8)
     parser.add_argument("--prompt-len", type=int, default=64)
     parser.add_argument("--new-tokens", type=int, default=32)
+    parser.add_argument("--mode", choices=("engine", "batcher"), default="engine")
+    parser.add_argument("--chunk-steps", type=int, default=8)
+    parser.add_argument(
+        "--pipeline-depth", type=int, default=None,
+        help="decode chunks in flight; default scales to cover ~120 ms of "
+        "round-trip with this model's chunk compute (big models need "
+        "shallow pipelines or joins queue behind the chunk backlog)",
+    )
+    parser.add_argument(
+        "--open-rate", type=float, default=0.0,
+        help="also run an open-loop scenario: Poisson arrivals at this "
+        "rate (req/s) — the workload where step-boundary joins beat the "
+        "full-batch barrier. 0 skips it.",
+    )
     args = parser.parse_args()
 
     import jax
@@ -85,30 +106,59 @@ def main() -> None:
 
     model = Model(name="http_bench_lm", init=lambda: qparams, dataset=dataset)
 
-    predict = make_lm_predictor(
-        qmodule, max_new_tokens=args.new_tokens,
-        bucket_lens=(args.prompt_len,),
-    )
-
     @model.trainer
     def trainer(params: dict, features: list) -> dict:
         return params
 
-    @model.predictor
-    def predictor(params: dict, prompts: list) -> list:
-        return predict(params, prompts)
+    if args.mode == "engine":
+        from unionml_tpu.serving.engine import DecodeEngine
+
+        depth = args.pipeline_depth
+        if depth is None:
+            # cover one ~120 ms RTT of backlog, no more: deeper pipelines
+            # make joining prefills queue behind the whole chunk backlog
+            per_step_ms = {"serve_8b": 11.0}.get(preset, 3.3)
+            depth = max(2, int(round(120.0 / (args.chunk_steps * per_step_ms))))
+        engine = DecodeEngine(
+            qmodule, slots=args.clients, max_new_tokens=args.new_tokens,
+            prompt_buckets=(args.prompt_len,), chunk_steps=args.chunk_steps,
+            pipeline_depth=depth,
+        )
+
+        @model.predictor
+        def predictor(params: dict, prompts: list) -> list:
+            return engine.generate(params, prompts)
+
+        serving_kwargs = dict(
+            warmup=lambda params: engine.warmup(params), stats=engine.stats
+        )
+    else:
+        predict = make_lm_predictor(
+            qmodule, max_new_tokens=args.new_tokens,
+            bucket_lens=(args.prompt_len,),
+        )
+
+        @model.predictor
+        def predictor(params: dict, prompts: list) -> list:
+            return predict(params, prompts)
+
+        serving_kwargs = dict(
+            batch=True, row_lists=True, max_wait_ms=3.0,
+            # never coalesce beyond the warmed shapes: an open-loop burst
+            # can queue more than `clients` rows, and an unwarmed bucket
+            # stalls the batch behind a ~20-40 s XLA compile
+            max_batch_size=args.clients,
+            # pre-compile every (bucket, batch-power) executable: without
+            # this, first-hit shapes stall live requests behind ~20 s XLA
+            # compiles (measured 17.9 s p95 under 8 concurrent clients)
+            warmup=lambda params: predict.warmup(params, max_batch=args.clients),
+        )
 
     from unionml_tpu.model import ModelArtifact
 
     model.artifact = ModelArtifact(qparams, {}, {})
 
-    serving = ServingApp(
-        model, batch=True, row_lists=True, max_wait_ms=3.0,
-        # pre-compile every (bucket, batch-power) executable: without
-        # this, first-hit shapes stall live requests behind ~20 s XLA
-        # compiles (measured 17.9 s p95 under 8 concurrent clients)
-        warmup=lambda params: predict.warmup(params, max_batch=args.clients),
-    )
+    serving = ServingApp(model, **serving_kwargs)
     host, port = serving.serve(port=0, blocking=False)
 
     rng = np.random.default_rng(0)
@@ -128,14 +178,36 @@ def main() -> None:
 
     request()  # warmup/compile
 
+    from unionml_tpu.serving._stats import percentile_summary
+
+    def reset_stats():
+        # each scenario's /stats must describe only that scenario, not
+        # dilute its queue-wait/occupancy with warmup or earlier phases
+        if args.mode == "engine":
+            engine.reset_stats()
+        else:
+            serving.reset_stats()
+
+    def fetch_stats() -> dict:
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/stats", timeout=30
+        ) as resp:
+            stats = json.loads(resp.read())
+        return {
+            k: stats[k]
+            for k in ("queue_wait_ms", "prefill_ms", "decode_ms",
+                      "device_ms", "slot_occupancy")
+            if k in stats
+        }
+
     # single client: pure request latency
-    lat = sorted(request() for _ in range(args.requests))
-    p50 = lat[len(lat) // 2]
-    p95 = lat[max(0, math.ceil(0.95 * len(lat)) - 1)]
+    lat = [request() for _ in range(args.requests)]
+    s = percentile_summary(lat)
     print(json.dumps({
-        "metric": f"{preset}_http_p50_ms", "clients": 1,
-        "value": round(p50, 1), "p95_ms": round(p95, 1), "unit": "ms",
+        "metric": f"{preset}_http_p50_ms", "mode": args.mode, "clients": 1,
+        "value": s["p50"], "p95_ms": s["p95"], "unit": "ms",
     }))
+    reset_stats()
 
     # concurrent clients: the micro-batcher coalesces in-flight requests
     all_lat: list = []
@@ -153,18 +225,53 @@ def main() -> None:
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
-    all_lat.sort()
-    p50 = all_lat[len(all_lat) // 2]
-    p95 = all_lat[max(0, math.ceil(0.95 * len(all_lat)) - 1)]
+    s = percentile_summary(all_lat)
     n = args.clients * args.requests
     print(json.dumps({
-        "metric": f"{preset}_http_p50_ms", "clients": args.clients,
-        "value": round(p50, 1), "p95_ms": round(p95, 1),
+        "metric": f"{preset}_http_p50_ms", "mode": args.mode,
+        "clients": args.clients,
+        "value": s["p50"], "p95_ms": s["p95"],
         "requests_per_sec": round(n / wall, 2),
         "tokens_per_sec": round(n * args.new_tokens / wall, 1),
         "unit": "ms",
+        "stats": fetch_stats(),
     }))
+    if args.open_rate > 0:
+        # open loop: arrivals are scheduled, not gated on completions —
+        # a late arrival during an in-flight decode exposes the batcher's
+        # full-batch barrier (it waits the whole generation out) vs the
+        # engine's chunk-boundary join
+        reset_stats()
+        n_open = args.clients * args.requests
+        gaps = np.random.default_rng(1).exponential(1.0 / args.open_rate, n_open)
+        arrivals = np.cumsum(gaps)
+        open_lat: list = []
+
+        def timed_request(delay: float):
+            time.sleep(max(0.0, delay))
+            open_lat.append(request())
+
+        start = time.perf_counter()
+        threads = [
+            threading.Thread(target=timed_request, args=(a - (time.perf_counter() - start),))
+            for a in arrivals
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - start
+        s = percentile_summary(open_lat)
+        print(json.dumps({
+            "metric": f"{preset}_http_open_p50_ms", "mode": args.mode,
+            "offered_rps": args.open_rate,
+            "value": s["p50"], "p95_ms": s["p95"],
+            "requests_per_sec": round(n_open / wall, 2), "unit": "ms",
+            "stats": fetch_stats(),
+        }))
     serving.shutdown()
+    if args.mode == "engine":
+        engine.close()
 
 
 if __name__ == "__main__":

@@ -187,3 +187,47 @@ def test_microbatcher_ragged_prompt_lists():
         assert results == [[[3, 4]], [[5, 6]], [[9, 10]], [[11]]]
     finally:
         batcher.close()
+
+
+def test_stats_endpoint_direct_and_batched(trained_model):
+    """GET /stats: 'direct' when no batcher, queue/device split after
+    batched traffic, and a custom stats callable (the engine hook)."""
+    app = ServingApp(trained_model)
+    host, port = app.serve(port=0, blocking=False)
+    try:
+        r = httpx.get(f"http://{host}:{port}/stats")
+        assert r.status_code == 200 and r.json()["engine"] == "direct"
+    finally:
+        app.shutdown()
+
+    app = ServingApp(trained_model, batch=True, max_wait_ms=5.0)
+    host, port = app.serve(port=0, blocking=False)
+    url = f"http://{host}:{port}"
+    try:
+        httpx.post(f"{url}/predict", json={"features": [[5.0, 5.0]]})
+        s = httpx.get(f"{url}/stats").json()
+        assert s["engine"] == "micro-batch"
+        assert s["completed_requests"] >= 1 and s["batches"] >= 1
+        assert s["queue_wait_ms"]["p50"] >= 0
+        assert s["device_ms"]["p50"] > 0
+    finally:
+        app.shutdown()
+
+    app = ServingApp(trained_model, stats=lambda: {"engine": "continuous", "x": 1})
+    host, port = app.serve(port=0, blocking=False)
+    try:
+        s = httpx.get(f"http://{host}:{port}/stats").json()
+        assert s == {"engine": "continuous", "x": 1}
+    finally:
+        app.shutdown()
+
+
+def test_fastapi_stats_route_parity(trained_model):
+    fastapi = pytest.importorskip("fastapi")
+    from fastapi.testclient import TestClient
+
+    app = fastapi.FastAPI()
+    trained_model.serve(app)
+    with TestClient(app) as client:
+        s = client.get("/stats")
+        assert s.status_code == 200 and s.json()["engine"] == "direct"

@@ -95,6 +95,7 @@ def run_step_trainer(
     seed: int = 0,
     sharding: Any = None,
     donate_state: bool = True,
+    accumulate_steps: int = 1,
     profile_dir: Optional[str] = None,
 ) -> Any:
     """Synthesized trainer loop around a jittable per-batch step.
@@ -102,6 +103,15 @@ def run_step_trainer(
     ``step_fn(state, batch) -> (state, metrics)`` where ``batch`` is
     ``(features, targets)`` sliced along the leading axis (or just
     ``features`` when no targets exist, e.g. self-supervised LM batches).
+
+    ``accumulate_steps=N`` (gradient accumulation): each fed batch holds
+    ``N * batch_size`` examples reshaped to a leading microbatch axis
+    ``[N, batch_size, ...]``, and the step must scan it with ONE
+    optimizer update (the zoo factories' ``accumulate_steps`` builds
+    such steps — :func:`unionml_tpu.models.train.accumulated_value_and_grad`).
+    Under a ``sharding`` config the microbatch axis stays unsharded
+    (each device scans its own microbatch shards); streams must yield
+    batches of ``N * batch_size`` rows.
 
     With a ``sharding`` config (:class:`unionml_tpu.parallel.ShardingConfig`)
     the step is compiled under its mesh: state placed per the config's param
@@ -142,6 +152,36 @@ def run_step_trainer(
     n = 0 if streaming else _num_examples(features)
     has_targets = targets is not None
 
+    if accumulate_steps < 1:
+        raise ValueError(f"accumulate_steps must be >= 1, got {accumulate_steps}")
+    feed_rows = batch_size * accumulate_steps
+    if accumulate_steps > 1:
+        if not streaming and n < feed_rows:
+            raise ValueError(
+                f"gradient accumulation needs at least accumulate_steps * "
+                f"batch_size = {feed_rows} examples per step, got {n}"
+            )
+        if sharding is not None:
+            sharding = sharding.microbatched()
+
+        def _to_microbatches(batch: Any) -> Any:
+            import jax
+
+            def reshape(x):
+                if not hasattr(x, "reshape"):
+                    # list-like leaf: materialize once; device-resident
+                    # arrays reshape in place (a np.asarray here would
+                    # round-trip them device->host->device every step)
+                    x = np.asarray(x)
+                if x.shape[0] != feed_rows:
+                    raise ValueError(
+                        f"accumulation batch has leading dim {x.shape[0]}, "
+                        f"expected accumulate_steps * batch_size = {feed_rows}"
+                    )
+                return x.reshape((accumulate_steps, batch_size) + x.shape[1:])
+
+            return jax.tree_util.tree_map(reshape, batch)
+
     if sharding is not None:
         from unionml_tpu.parallel import compile_step
 
@@ -163,7 +203,7 @@ def run_step_trainer(
                 got = 0
                 for item in stream:
                     got += 1
-                    yield item
+                    yield _to_microbatches(item) if accumulate_steps > 1 else item
                 if got == 0:
                     # silent zero-batch epochs under-train with no signal:
                     # an already-exhausted iterator, or a callable returning
@@ -184,7 +224,7 @@ def run_step_trainer(
         if (
             _is_plain_array(features)
             and (not has_targets or _is_plain_array(targets))
-            and n >= batch_size
+            and n >= feed_rows
         ):
             from unionml_tpu.data.native import BatchLoader
 
@@ -192,20 +232,22 @@ def run_step_trainer(
             if has_targets:
                 arrays.append(np.asarray(targets))
             loader = BatchLoader(
-                arrays, batch_size=batch_size, seed=seed, shuffle=True,
+                arrays, batch_size=feed_rows, seed=seed, shuffle=True,
                 drop_remainder=True, copy=True,
             )
             try:
                 for epoch in range(num_epochs):
                     for batch in loader.epoch(epoch):
-                        yield batch if has_targets else batch[0]
+                        out = batch if has_targets else batch[0]
+                        yield _to_microbatches(out) if accumulate_steps > 1 else out
             finally:
                 loader.close()
             return
         for epoch in range(num_epochs):
-            for idx in batch_indices(n, batch_size, shuffle=True, seed=seed + epoch):
+            for idx in batch_indices(n, feed_rows, shuffle=True, seed=seed + epoch):
                 xb = _slice_batch(features, idx)
-                yield (xb, _slice_batch(targets, idx)) if has_targets else xb
+                out = (xb, _slice_batch(targets, idx)) if has_targets else xb
+                yield _to_microbatches(out) if accumulate_steps > 1 else out
 
     from unionml_tpu.diagnostics import StepTimer, trace
 
@@ -223,10 +265,13 @@ def run_step_trainer(
                 leaves = jax.tree_util.tree_leaves(metrics)
                 if leaves:
                     np.asarray(leaves[0])
-            # actual leading dim (streamed batches may differ from batch_size)
+            # actual leading dim (streamed batches may differ from batch_size);
+            # with accumulation the example count spans the two leading axes
             rows = next(
                 (
-                    leaf.shape[0]
+                    leaf.shape[0] * leaf.shape[1]
+                    if accumulate_steps > 1 and getattr(leaf, "ndim", 0) >= 2
+                    else leaf.shape[0]
                     for leaf in jax.tree_util.tree_leaves(batch)
                     if getattr(leaf, "ndim", 0) >= 1
                 ),

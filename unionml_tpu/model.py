@@ -286,6 +286,7 @@ class Model(TrackedInstance):
         *,
         sharding: Any = None,
         donate_state: bool = True,
+        accumulate_steps: int = 1,
         **train_task_kwargs,
     ):
         """Register a TPU-native, jittable per-batch training step.
@@ -297,16 +298,28 @@ class Model(TrackedInstance):
         step with ``jax.jit`` under the mesh/shardings described by
         ``sharding`` (a :class:`unionml_tpu.parallel.ShardingConfig`).
 
+        ``accumulate_steps=N``: gradient accumulation — the trainer feeds
+        ``[N, batch_size, ...]`` microbatched batches and the step must
+        scan them into one optimizer update (build it with a zoo factory's
+        ``accumulate_steps`` or
+        :func:`unionml_tpu.models.train.accumulated_value_and_grad`).
+        The HBM knob for effective batch at long context.
+
         No reference counterpart — this is the north-star TPU path
         (BASELINE.json: "trainer bodies compile to pjit'd XLA computations").
         """
         if fn is None:
             return lambda f: self.train_step(
-                f, sharding=sharding, donate_state=donate_state, **train_task_kwargs
+                f, sharding=sharding, donate_state=donate_state,
+                accumulate_steps=accumulate_steps, **train_task_kwargs
             )
         type_guards.guard_train_step(fn)
         self._train_step = fn
-        self._train_step_options = {"sharding": sharding, "donate_state": donate_state}
+        self._train_step_options = {
+            "sharding": sharding,
+            "donate_state": donate_state,
+            "accumulate_steps": accumulate_steps,
+        }
         self._trainer = self._make_step_trainer()
         self._train_task_kwargs = {"resources": DEFAULT_RESOURCES, **train_task_kwargs}
         self._train_task = None
@@ -338,6 +351,7 @@ class Model(TrackedInstance):
                 seed=seed,
                 sharding=model._train_step_options.get("sharding"),
                 donate_state=model._train_step_options.get("donate_state", True),
+                accumulate_steps=model._train_step_options.get("accumulate_steps", 1),
             )
 
         trainer.__name__ = "synthesized_step_trainer"

@@ -39,6 +39,50 @@ import jax.numpy as jnp
 from unionml_tpu.models.llama import Llama, LlamaConfig, init_cache
 
 
+def make_sampler(
+    *,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> Callable:
+    """Build ``sample(logits[B, V], key) -> token[B]``.
+
+    Greedy at ``temperature == 0``; otherwise categorical over
+    temperature-scaled logits, optionally filtered by ``top_k`` and/or
+    nucleus ``top_p`` (keep the smallest prefix of probability-descending
+    tokens whose mass reaches ``top_p``; the filters compose — top_k
+    first, then top_p over the survivors). Shared by the scan generator
+    and the continuous-batching decode engine so both sample identically.
+    """
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+    def sample(logits: jnp.ndarray, key) -> jnp.ndarray:
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits / temperature
+        if top_k is not None:
+            top_vals, _ = jax.lax.top_k(scaled, top_k)
+            cutoff = top_vals[:, -1:]
+            scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+        if top_p is not None and top_p < 1.0:
+            probs = jax.nn.softmax(scaled, axis=-1)
+            sort_idx = jnp.argsort(probs, axis=-1)[:, ::-1]        # descending
+            sorted_probs = jnp.take_along_axis(probs, sort_idx, axis=-1)
+            cum = jnp.cumsum(sorted_probs, axis=-1)
+            # keep the smallest prefix whose mass reaches top_p: a sorted
+            # position survives iff the mass BEFORE it is < top_p. Masking
+            # by position (not probability value) keeps the nucleus
+            # bounded even when many tokens tie at the cutoff.
+            keep_sorted = (cum - sorted_probs) < top_p
+            inv = jnp.argsort(sort_idx, axis=-1)
+            keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+            scaled = jnp.where(keep, scaled, -jnp.inf)
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+    return sample
+
+
 def make_generator(
     module: Llama,
     *,
@@ -64,32 +108,7 @@ def make_generator(
     """
     cfg: LlamaConfig = module.config
     total_len = max_len or cfg.max_len
-    if top_p is not None and not 0.0 < top_p <= 1.0:
-        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-
-    def sample(logits: jnp.ndarray, key) -> jnp.ndarray:
-        """logits [B, V] -> token [B]."""
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        scaled = logits / temperature
-        if top_k is not None:
-            top_vals, _ = jax.lax.top_k(scaled, top_k)
-            cutoff = top_vals[:, -1:]
-            scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
-        if top_p is not None and top_p < 1.0:
-            probs = jax.nn.softmax(scaled, axis=-1)
-            sort_idx = jnp.argsort(probs, axis=-1)[:, ::-1]        # descending
-            sorted_probs = jnp.take_along_axis(probs, sort_idx, axis=-1)
-            cum = jnp.cumsum(sorted_probs, axis=-1)
-            # keep the smallest prefix whose mass reaches top_p: a sorted
-            # position survives iff the mass BEFORE it is < top_p. Masking
-            # by position (not probability value) keeps the nucleus
-            # bounded even when many tokens tie at the cutoff.
-            keep_sorted = (cum - sorted_probs) < top_p
-            inv = jnp.argsort(sort_idx, axis=-1)
-            keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
-            scaled = jnp.where(keep, scaled, -jnp.inf)
-        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    sample = make_sampler(temperature=temperature, top_k=top_k, top_p=top_p)
 
     def generate(params, tokens: jnp.ndarray, key=None, prompt_mask=None) -> jnp.ndarray:
         """``prompt_mask``: bool [B, prompt_len], False marks left-padding

@@ -192,7 +192,10 @@ class Attention(nn.Module):
         """Returns ``out`` or ``(out, new_cache)`` when a cache is given.
 
         ``cache``: (k, v) of shape (batch, max_len, kv_heads, head_dim);
-        ``cache_index``: scalar int — current fill position (decode step);
+        ``cache_index``: current fill position (decode step) — a scalar
+        int shared by every row, or an int vector ``[batch]`` of per-row
+        fill positions (continuous-batching decode, where in-flight
+        sequences sit at different depths);
         ``kv_mask``: optional bool (batch, max_len) — False slots are
         never attended to (left-padded prompts in generation).
         """
@@ -208,7 +211,9 @@ class Attention(nn.Module):
         v = dense((kv_heads, head_dim), "v")(x)
 
         if positions is None:
-            base = cache_index if cache_index is not None else 0
+            base = jnp.asarray(cache_index if cache_index is not None else 0)
+            if base.ndim == 1:
+                base = base[:, None]  # per-row fill positions (slot decode)
             positions = base + jnp.arange(seq)[None, :]
         if self.rope:
             q = rotary_embedding(q, positions, theta=self.rope_theta)
@@ -217,21 +222,39 @@ class Attention(nn.Module):
         new_cache = None
         if cache is not None:
             ck, cv = cache
-            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
+            index = jnp.asarray(cache_index)
+            if index.ndim == 1:
+                # per-row fill positions: a vmapped dynamic_update_slice
+                # lowers to one scatter — the continuous-batching decode
+                # step where each slot writes at its own depth
+                upd = lambda c, new, i: jax.lax.dynamic_update_slice(  # noqa: E731
+                    c, new, (i,) + (0,) * (c.ndim - 1)
+                )
+                ck = jax.vmap(upd)(ck, k.astype(ck.dtype), index)
+                cv = jax.vmap(upd)(cv, v.astype(cv.dtype), index)
+            else:
+                ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, index, 0, 0))
+                cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, index, 0, 0))
             new_cache = (ck, cv)
             # attend over the filled prefix only: kv slot j is visible to
             # query i iff j <= cache_index + i (covers decode seq=1 and
             # cached prefill seq>1; unwritten slots are masked out)
             kv_pos = jnp.arange(ck.shape[1])[None, :]
-            q_pos = cache_index + jnp.arange(seq)[:, None]
-            visible = kv_pos <= q_pos                       # (seq, max_len)
-            if kv_mask is not None:
-                # (batch, 1, seq, max_len): padded slots stay invisible
-                visible = visible[None] & kv_mask[:, None, :]
+            if index.ndim == 1:
+                q_pos = index[:, None, None] + jnp.arange(seq)[None, :, None]
+                visible = kv_pos[None] <= q_pos             # (batch, seq, max_len)
+                if kv_mask is not None:
+                    visible = visible & kv_mask[:, None, :]
                 bias = jnp.where(visible, 0.0, -1e30)[:, None]
             else:
-                bias = jnp.where(visible, 0.0, -1e30)[None, None]
+                q_pos = index + jnp.arange(seq)[:, None]
+                visible = kv_pos <= q_pos                   # (seq, max_len)
+                if kv_mask is not None:
+                    # (batch, 1, seq, max_len): padded slots stay invisible
+                    visible = visible[None] & kv_mask[:, None, :]
+                    bias = jnp.where(visible, 0.0, -1e30)[:, None]
+                else:
+                    bias = jnp.where(visible, 0.0, -1e30)[None, None]
             out = xla_attention(
                 q, ck.astype(self.dtype), cv.astype(self.dtype), bias=bias
             )

@@ -165,7 +165,10 @@ class Llama(nn.Module):
         dtype = jnp.dtype(cfg.dtype)
         x = nn.Embed(cfg.vocab_size, cfg.hidden_dim, dtype=dtype, name="embed")(tokens)
         if positions is None and cache_index is not None:
-            positions = cache_index + jnp.arange(tokens.shape[1])[None, :]
+            index = jnp.asarray(cache_index)
+            if index.ndim == 1:  # per-row fill positions (slot decode)
+                index = index[:, None]
+            positions = index + jnp.arange(tokens.shape[1])[None, :]
         new_cache = []
         # remat: recompute block activations in the backward instead of
         # storing them — O(sqrt)-style memory for long-context training.

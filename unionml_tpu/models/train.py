@@ -70,6 +70,52 @@ def _accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
 
 
+def accumulated_value_and_grad(
+    loss_fn: Callable, params: Any, batch: Any
+) -> Tuple[Tuple[jnp.ndarray, Any], Any]:
+    """Mean (loss, aux) and grads of ``loss_fn(params, microbatch)`` over
+    the leading microbatch axis of ``batch``, via one ``lax.scan``.
+
+    The gradient-accumulation core (SURVEY.md §7 layer 3): ``batch``
+    leaves are ``[n_micro, micro_batch, ...]``; each scan step runs one
+    microbatch forward+backward and adds into an fp32 grad accumulator,
+    so HBM holds one microbatch's activations at a time while the
+    *effective* batch is ``n_micro`` times larger. With equal microbatch
+    sizes and mean-style losses, the averaged grads equal the one-shot
+    big-batch grads up to float summation order (tested). ``aux`` must be
+    a pytree of scalars (metrics) — it is averaged the same way.
+    """
+    vg = jax.value_and_grad(loss_fn, has_aux=True)
+    n = jax.tree_util.tree_leaves(batch)[0].shape[0]
+    first = jax.tree_util.tree_map(lambda x: x[0], batch)
+    # trace-time structure probe: zero accumulators for loss/aux/grads
+    (loss_s, aux_s), grad_s = jax.eval_shape(vg, params, first)
+    zeros = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda s: jnp.zeros(s.shape, jnp.float32), t
+    )
+
+    def body(carry, microbatch):
+        loss_acc, aux_acc, grad_acc = carry
+        (loss, aux), grads = vg(params, microbatch)
+        loss_acc = loss_acc + loss.astype(jnp.float32)
+        aux_acc = jax.tree_util.tree_map(
+            lambda a, b: a + jnp.asarray(b, jnp.float32), aux_acc, aux
+        )
+        grad_acc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32), grad_acc, grads
+        )
+        return (loss_acc, aux_acc, grad_acc), None
+
+    (loss, aux, grads), _ = jax.lax.scan(
+        body, (zeros(loss_s), zeros(aux_s), zeros(grad_s)), batch
+    )
+    mean = lambda t: jax.tree_util.tree_map(lambda x: x / n, t)  # noqa: E731
+    grads = jax.tree_util.tree_map(
+        lambda g, p: (g / n).astype(p.dtype), grads, params
+    )
+    return (loss / n, mean(aux)), grads
+
+
 def masked_cross_entropy(
     logits: jnp.ndarray, targets: jnp.ndarray, *, ignore_id: int = -100
 ) -> jnp.ndarray:
@@ -85,28 +131,44 @@ def masked_cross_entropy(
     return (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
 
-def classification_step(module: nn.Module) -> Callable:
-    """softmax-CE step for (features, int_labels) batches (MLP/ViT/BERT-cls)."""
+def classification_step(module: nn.Module, *, accumulate_steps: int = 1) -> Callable:
+    """softmax-CE step for (features, int_labels) batches (MLP/ViT/BERT-cls).
+
+    ``accumulate_steps > 1``: the step expects batches with a leading
+    microbatch axis (``[n_micro, micro_batch, ...]`` — the trainer's
+    ``accumulate_steps`` feeds this shape) and applies ONE optimizer
+    update from the grad mean over the scan (gradient accumulation).
+    """
+
+    def loss_fn(params, microbatch):
+        features, labels = microbatch
+        logits = module.apply({"params": params}, features)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels
+        ).mean()
+        return loss, {"accuracy": _accuracy(logits, labels)}
 
     def step(state: TrainState, batch: Tuple[Any, Any]):
-        features, labels = batch
-
-        def loss_fn(params):
-            logits = state.apply_fn({"params": params}, features)
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits.astype(jnp.float32), labels
-            ).mean()
-            return loss, logits
-
-        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        if accumulate_steps > 1:
+            (loss, aux), grads = accumulated_value_and_grad(
+                loss_fn, state.params, batch
+            )
+        else:
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, batch
+            )
         state = state.apply_gradients(grads=grads)
-        return state, {"loss": loss, "accuracy": _accuracy(logits, labels)}
+        return state, {"loss": loss, "accuracy": aux["accuracy"]}
 
     return step
 
 
 def lm_step(
-    module: nn.Module, *, ignore_id: int = -100, aux_loss_weight: float = 0.01
+    module: nn.Module,
+    *,
+    ignore_id: int = -100,
+    aux_loss_weight: float = 0.01,
+    accumulate_steps: int = 1,
 ) -> Callable:
     """Next-token LM step: batch is token ids [B, S]; loss over shifted pairs.
 
@@ -117,33 +179,44 @@ def lm_step(
     ``aux_losses`` collection (ops/moe.py); their layer-mean is added to
     the CE loss scaled by ``aux_loss_weight`` and reported as the
     ``aux_loss`` metric (0 for dense models).
+
+    ``accumulate_steps > 1``: gradient accumulation — batches carry a
+    leading microbatch axis ([n_micro, micro_batch, S]), grads are
+    scan-accumulated in fp32, and the optimizer updates once. This is
+    the HBM-bound long-context knob: the 16k-context leg runs microbatch
+    1 per device; accumulation restores the effective batch without the
+    activation memory (BASELINE.md long-context table).
     """
 
-    def step(state: TrainState, batch):
-        if isinstance(batch, tuple):
-            tokens, labels = batch
-            inputs, targets = tokens, labels
+    def loss_fn(params, microbatch):
+        if isinstance(microbatch, tuple):
+            inputs, targets = microbatch
         else:
-            inputs, targets = batch[:, :-1], batch[:, 1:]
-
-        def loss_fn(params):
-            logits, mods = state.apply_fn(
-                {"params": params}, inputs, mutable=["aux_losses"]
-            )
-            ce_loss = masked_cross_entropy(logits, targets, ignore_id=ignore_id)
-            sown = jax.tree_util.tree_leaves(mods.get("aux_losses", {}))
-            aux = (
-                sum(v.astype(jnp.float32) for v in sown) / len(sown)
-                if sown
-                else jnp.float32(0.0)
-            )
-            return ce_loss + aux_loss_weight * aux, (ce_loss, aux)
-
-        (_, (loss, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
+            inputs, targets = microbatch[:, :-1], microbatch[:, 1:]
+        logits, mods = module.apply(
+            {"params": params}, inputs, mutable=["aux_losses"]
         )
+        ce_loss = masked_cross_entropy(logits, targets, ignore_id=ignore_id)
+        sown = jax.tree_util.tree_leaves(mods.get("aux_losses", {}))
+        aux = (
+            sum(v.astype(jnp.float32) for v in sown) / len(sown)
+            if sown
+            else jnp.float32(0.0)
+        )
+        return ce_loss + aux_loss_weight * aux, {"ce": ce_loss, "aux": aux}
+
+    def step(state: TrainState, batch):
+        if accumulate_steps > 1:
+            (_, aux), grads = accumulated_value_and_grad(
+                loss_fn, state.params, batch
+            )
+        else:
+            (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, batch
+            )
         state = state.apply_gradients(grads=grads)
-        return state, {"loss": loss, "perplexity": jnp.exp(loss), "aux_loss": aux}
+        loss, aux_loss = aux["ce"], aux["aux"]
+        return state, {"loss": loss, "perplexity": jnp.exp(loss), "aux_loss": aux_loss}
 
     return step
 

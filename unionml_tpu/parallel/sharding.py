@@ -108,6 +108,20 @@ class ShardingConfig:
 
         return NamedSharding(self.mesh(), self.batch_pspec())
 
+    def microbatched(self) -> "ShardingConfig":
+        """Copy whose batch spec carries a leading UNSHARDED microbatch
+        axis (gradient accumulation: batches are [n_micro, batch, ...] and
+        the scan axis must stay whole on every device while the per-step
+        batch axis keeps the data/fsdp sharding)."""
+        import dataclasses
+
+        if self.batch_spec is not None:
+            spec = (None,) + tuple(self.batch_spec)
+        else:
+            axes = [a for a in ("data", "fsdp") if a in self.axis_sizes()]
+            spec = (None, tuple(axes) if len(axes) > 1 else axes[0] if axes else None)
+        return dataclasses.replace(self, batch_spec=spec)
+
     # -- parameter sharding --------------------------------------------- #
 
     def param_pspec(self, path: str, leaf) -> Any:

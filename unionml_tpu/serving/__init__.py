@@ -6,13 +6,19 @@ predictor call). The TPU-native redesign has two layers:
 - :mod:`unionml_tpu.serving.batcher` — a micro-batcher that coalesces
   concurrent requests into one padded, bucketed device call (XLA compiles
   one executable per bucket; p50 latency amortizes MXU dispatch).
+- :mod:`unionml_tpu.serving.engine` — a continuous-batching decode
+  engine for LLM serving: fixed resident slots, per-slot KV fill,
+  requests join/retire at chunk boundaries instead of waiting out the
+  in-flight generation (the 8-client p95 fix).
 - transport: :mod:`unionml_tpu.serving.http` is a dependency-free stdlib
   HTTP server with the same surface (``GET /``, ``POST /predict``,
-  ``GET /health``); :mod:`unionml_tpu.serving.fastapi` mounts the identical
-  routes on a FastAPI app when that stack is installed.
+  ``GET /health``, ``GET /stats``); :mod:`unionml_tpu.serving.fastapi`
+  mounts the identical routes on a FastAPI app when that stack is
+  installed.
 """
 
 from unionml_tpu.serving.batcher import MicroBatcher
+from unionml_tpu.serving.engine import DecodeEngine
 from unionml_tpu.serving.http import ServingApp, create_app
 
-__all__ = ["MicroBatcher", "ServingApp", "create_app"]
+__all__ = ["DecodeEngine", "MicroBatcher", "ServingApp", "create_app"]

@@ -1,0 +1,23 @@
+"""Shared percentile summary for serving observability surfaces."""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+
+def percentile_summary(values: Sequence[float]) -> dict:
+    """p50/p95/mean of a non-empty sample.
+
+    p95 uses ``ceil(0.95 * n) - 1`` (the same formula as
+    ``benchmarks/serve_http.py``): for small windows ``int(0.95 * n)``
+    indexes the sample MAXIMUM — one cold-compile outlier would be
+    reported as the p95 and misdirect tail-latency attribution.
+    """
+    vals = sorted(values)
+    n = len(vals)
+    return {
+        "p50": round(vals[n // 2], 1),
+        "p95": round(vals[max(0, math.ceil(0.95 * n) - 1)], 1),
+        "mean": round(sum(vals) / n, 1),
+    }

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
@@ -83,6 +84,9 @@ class _Pending:
     event: threading.Event = field(default_factory=threading.Event)
     result: Any = None
     error: Optional[BaseException] = None
+    submitted: float = 0.0
+    queue_wait_ms: float = 0.0
+    device_ms: float = 0.0
 
 
 class MicroBatcher:
@@ -108,6 +112,13 @@ class MicroBatcher:
         self.buckets = tuple(sorted(set(buckets) | {max_batch_size}))
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
         self._stop = threading.Event()
+        self._stats_lock = threading.Lock()
+        # (queue_wait_ms, device_ms) floats only — archiving _Pending
+        # objects would pin every request's features/result payloads
+        self._done: List[Tuple[float, float]] = []
+        self._done_total = 0
+        self._batches = 0
+        self._batched_rows = 0
         self._worker = threading.Thread(target=self._run, daemon=True, name="unionml-tpu-batcher")
         self._worker.start()
 
@@ -120,7 +131,8 @@ class MicroBatcher:
     def submit(self, features: Any, timeout: Optional[float] = 60.0) -> Any:
         """Block until the batched prediction for ``features`` is ready."""
         pending = _Pending(
-            features=features, rows=_leading_dim(features, self.row_lists)
+            features=features, rows=_leading_dim(features, self.row_lists),
+            submitted=time.perf_counter(),
         )
         self._queue.put(pending)
         if not pending.event.wait(timeout):
@@ -128,6 +140,34 @@ class MicroBatcher:
         if pending.error is not None:
             raise pending.error
         return pending.result
+
+    def stats(self) -> dict:
+        """Serving observability: queue-wait vs device-time split."""
+        from unionml_tpu.serving._stats import percentile_summary
+
+        with self._stats_lock:
+            done = list(self._done)
+            total = self._done_total
+            batches, rows = self._batches, self._batched_rows
+        out = {
+            "engine": "micro-batch",
+            "completed_requests": total,
+            "batches": batches,
+            "mean_batch_rows": round(rows / max(1, batches), 2),
+        }
+        if done:
+            for i, name in enumerate(("queue_wait_ms", "device_ms")):
+                out[name] = percentile_summary([rec[i] for rec in done])
+        return out
+
+    def reset_stats(self) -> None:
+        """Zero the observability aggregates (benchmarks call this between
+        scenarios so each phase's /stats describes only that phase)."""
+        with self._stats_lock:
+            self._done.clear()
+            self._done_total = 0
+            self._batches = 0
+            self._batched_rows = 0
 
     def close(self):
         self._stop.set()
@@ -175,6 +215,9 @@ class MicroBatcher:
             if not batch:
                 continue
             try:
+                t_start = time.perf_counter()
+                for p in batch:
+                    p.queue_wait_ms = (t_start - p.submitted) * 1e3
                 rl = self.row_lists
                 feats = _concat([p.features for p in batch], rl)
                 total = sum(p.rows for p in batch)
@@ -196,10 +239,21 @@ class MicroBatcher:
                         out = np.asarray(out)
                     parts.append(_slice_rows(out, 0, stop - start, rl))
                 result = _concat(parts, rl) if len(parts) > 1 else parts[0]
+                device_ms = (time.perf_counter() - t_start) * 1e3
                 offset = 0
                 for p in batch:
                     p.result = _slice_rows(result, offset, offset + p.rows, rl)
+                    p.device_ms = device_ms  # the shared batched call
                     offset += p.rows
+                with self._stats_lock:
+                    self._batches += 1
+                    self._batched_rows += total
+                    self._done.extend(
+                        (p.queue_wait_ms, p.device_ms) for p in batch
+                    )
+                    self._done_total += len(batch)
+                    if len(self._done) > 10_000:
+                        del self._done[:5_000]
             except BaseException as exc:  # surface errors to every waiter
                 logger.info(f"micro-batcher error: {exc!r}")
                 for p in batch:

@@ -1,0 +1,665 @@
+"""Continuous-batching decode engine: step-boundary request joins.
+
+Supersedes the reference's one-predictor-call-per-request loop
+(reference: unionml/fastapi.py:50-64) *and* this package's own
+full-batch micro-batcher for LLM serving: the MicroBatcher drains the
+queue, runs one ``generate()`` to completion, and only then admits the
+next batch — a request arriving one step after a batch launches waits
+the entire in-flight decode plus its own (measured on Llama-3-8B int8,
+one v5e chip: 8-client p95 = 1040 ms vs p50 = 498 ms, BASELINE.md).
+
+This engine holds a **fixed-slot decode batch** resident on device:
+
+- the KV cache is ``[slots, L, kv_heads, head_dim]`` per layer with a
+  per-slot fill index (vector ``cache_index`` — see
+  :class:`unionml_tpu.models.layers.Attention`);
+- a new request's prompt is **prefilled into a free slot** between
+  decode steps (its own small ``[1, bucket]`` program, then one
+  ``dynamic_update_slice`` of the produced KV rows into the slot);
+- decode runs in **chunks of ``chunk_steps`` inside one
+  ``lax.scan``**, and up to ``pipeline_depth`` chunks are **dispatched
+  asynchronously** — the host never blocks on a chunk's tokens before
+  enqueueing the next; readbacks are harvested with a lag via
+  ``jax.Array.is_ready()`` polling. Device-side state donation chains
+  the chunks in dispatch order, so correctness never depends on host
+  timing. This matters enormously when the host↔device round trip is
+  slow (measured here: ~119 ms through the tunneled backend vs ~2 ms of
+  actual decode compute per step — a blocking per-chunk loop would be
+  ~5x slower than one monolithic generate);
+- finished slots (eos / token budget) are retired when their tokens are
+  harvested and immediately reusable; a per-slot **generation counter**
+  keeps tokens from an in-flight chunk dispatched for the *previous*
+  occupant from leaking into the new one. Device-side ``done``/
+  ``active`` masking keeps retired slots from corrupting live cache
+  rows, and ``(pipeline_depth + 1) * chunk_steps`` spare cache rows
+  absorb the decode overshoot between a request's completion and the
+  host noticing it.
+
+TPU-first notes: every program has static shapes (slots, bucket set,
+chunk length are fixed at construction — XLA compiles
+``len(prompt_buckets) + 1`` executables total); the per-slot cache write
+is a vmapped ``dynamic_update_slice`` (one scatter); state is donated
+through both programs so the multi-GB cache never copies.
+
+Prompts are placed **unpadded** at cache rows ``[0, P)`` — per-slot
+positions make left-padding unnecessary, so a slot-decoded sequence is
+token-identical to its solo :func:`~unionml_tpu.models.generate
+.make_generator` run (tested in tests/unit/test_engine.py).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from unionml_tpu._logging import logger
+
+__all__ = ["DecodeEngine"]
+
+
+def _start_host_copy(arr) -> None:
+    """Kick off the device→host transfer early so the later harvest's
+    ``np.asarray`` finds the bytes already local."""
+    try:
+        arr.copy_to_host_async()
+    except AttributeError:
+        pass
+
+
+@dataclass
+class _Request:
+    prompt: np.ndarray                  # int32 [P], truncated to max bucket
+    max_new_tokens: int
+    submitted: float = field(default_factory=time.perf_counter)
+    tokens: List[int] = field(default_factory=list)
+    event: threading.Event = field(default_factory=threading.Event)
+    error: Optional[BaseException] = None
+    # observability (ms). prefill_ms and decode_ms are measured at token
+    # HARVEST, so each includes one in-flight readback lag — honest at
+    # the request boundary, not a pure device timing.
+    queue_wait_ms: float = 0.0
+    prefill_ms: float = 0.0
+    decode_ms: float = 0.0
+    abandoned: bool = False             # waiter gave up (timeout): retire asap
+    _prefill_end: float = 0.0
+    _dispatch_t: float = 0.0
+    _expected: int = 0                  # tokens covered by dispatched work
+
+
+class DecodeEngine:
+    """Continuous-batching generation over a fixed slot batch.
+
+    ``generate(params, prompts)`` is thread-safe and blocking — concurrent
+    callers' requests join the resident decode at chunk boundaries. Use as
+    an ``@model.predictor`` body with ``ServingApp(batch=False)`` (each
+    HTTP thread submits directly; batching happens *here*, not in the
+    transport).
+
+    Args:
+        module: a cache-capable decoder (``unionml_tpu.models.Llama``).
+        slots: resident batch size — the max concurrent decodes.
+        max_new_tokens: per-request generation cap (requests may ask for
+            fewer via ``generate(..., max_new_tokens=n)``).
+        prompt_buckets: prompt lengths to compile prefill programs for;
+            prompts are left-truncated to the largest bucket. The shared
+            cache is sized ``max(buckets) + max_new_tokens +
+            (pipeline_depth + 1) * chunk_steps`` — decode attention reads
+            all of it every step, so keep the bucket set tight for the
+            traffic you serve.
+        chunk_steps: decode steps per dispatched chunk (join granularity).
+        pipeline_depth: max decode chunks in flight before their token
+            readbacks are harvested. Size it so ``depth * chunk compute``
+            covers the host↔device round trip (a tunneled backend here
+            measures ~119 ms RTT vs ~2 ms/step compute, so the default 8
+            keeps the device saturated; on a directly attached host 2 is
+            plenty and the extra depth is harmless).
+        temperature/top_k/top_p/eos_id/pad_id: sampling config, matching
+            :func:`~unionml_tpu.models.generate.make_generator`.
+    """
+
+    def __init__(
+        self,
+        module,
+        *,
+        slots: int = 8,
+        max_new_tokens: int = 32,
+        prompt_buckets: Sequence[int] = (64,),
+        chunk_steps: int = 8,
+        pipeline_depth: int = 8,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_id: Optional[int] = None,
+        pad_id: int = 0,
+        seed: int = 0,
+        submit_timeout: float = 300.0,
+    ):
+        import jax
+
+        from unionml_tpu.models.generate import make_sampler
+
+        if slots < 1:
+            raise ValueError("need at least one slot")
+        if not prompt_buckets:
+            raise ValueError("need at least one prompt bucket")
+        self._jax = jax
+        self.module = module
+        self.cfg = module.config
+        self.slots = slots
+        self.max_new_tokens = max_new_tokens
+        self.buckets = tuple(sorted(set(int(b) for b in prompt_buckets)))
+        self.chunk_steps = chunk_steps
+        self.pipeline_depth = max(1, pipeline_depth)
+        self.eos_id = eos_id
+        self.pad_id = pad_id
+        self.submit_timeout = submit_timeout
+        # spare rows: a slot may overshoot its token budget by up to the
+        # full in-flight window (pipeline_depth chunks dispatched before
+        # the host harvests the completion, plus the chunk being
+        # dispatched) before the host retires it; sparing those rows keeps
+        # the fill invariant (fill always points at a masked-False row)
+        # without per-slot write redirection
+        self.cache_len = (
+            self.buckets[-1]
+            + max_new_tokens
+            + (self.pipeline_depth + 1) * chunk_steps
+        )
+        if self.cache_len > self.cfg.max_len:
+            raise ValueError(
+                f"cache length {self.cache_len} (= max bucket "
+                f"{self.buckets[-1]} + max_new_tokens {max_new_tokens} + "
+                f"(pipeline_depth {self.pipeline_depth} + 1) * chunk_steps "
+                f"{chunk_steps} spare rows) exceeds model max_len "
+                f"{self.cfg.max_len}; lower pipeline_depth/chunk_steps or "
+                "raise max_len"
+            )
+        self._sample = make_sampler(
+            temperature=temperature, top_k=top_k, top_p=top_p
+        )
+        self._key = jax.random.PRNGKey(seed)
+        self._params: Any = None
+        self._state: Any = None
+        self._occupant: List[Optional[_Request]] = [None] * slots
+        # bumped on every (re)admission: an in-flight chunk snapshot with a
+        # stale generation must not credit its tokens to the new occupant
+        self._slot_gen: List[int] = [0] * slots
+        # requests popped from the queue but not yet visible in _occupant
+        # (admission spans the prefill dispatch): bind()'s busy check must
+        # see them or a concurrent swap lands mid-admission
+        self._admitting = 0
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._lock = threading.Lock()
+        # dispatch→harvest pipeline: FIFO of in-flight readbacks; the
+        # semaphore caps chunk entries at pipeline_depth
+        self._inflight: "queue.Queue" = queue.Queue()
+        self._chunk_credits = threading.Semaphore(self.pipeline_depth)
+        # observability aggregates: (queue_wait_ms, prefill_ms, decode_ms)
+        # float tuples only — archiving whole _Request objects would pin
+        # every prompt/token payload for up to 10k requests
+        self._completed: List[tuple] = []
+        self._completed_total = 0
+        self._steps = 0
+        self._chunks = 0
+        self._occupied_slot_steps = 0
+        self._build_programs()
+        self._stop = threading.Event()
+        self._worker = threading.Thread(
+            target=self._run, daemon=True, name="unionml-tpu-decode-engine"
+        )
+        self._harvester = threading.Thread(
+            target=self._harvest_loop, daemon=True,
+            name="unionml-tpu-decode-harvest",
+        )
+        self._worker.start()
+        self._harvester.start()
+
+    # ------------------------------------------------------------------ #
+    # device programs (compiled once per shape)
+    # ------------------------------------------------------------------ #
+
+    def _build_programs(self):
+        import jax
+        import jax.numpy as jnp
+
+        from unionml_tpu.models.llama import init_cache
+
+        cfg, L, B = self.cfg, self.cache_len, self.slots
+        module, sample = self.module, self._sample
+        eos_id, pad_id = self.eos_id, self.pad_id
+
+        def init_state():
+            return {
+                "cache": init_cache(cfg, B, L),
+                "kv_mask": jnp.zeros((B, L), bool),
+                "fill": jnp.zeros((B,), jnp.int32),
+                "last_tok": jnp.zeros((B,), jnp.int32),
+                "done": jnp.ones((B,), bool),
+            }
+
+        self._init_state = jax.jit(init_state)
+
+        def prefill(params, state, slot, tokens, true_len, key):
+            """Run one prompt (padded to its bucket) through a fresh
+            [1, bucket] cache, splice the KV rows into ``slot``."""
+            bucket = tokens.shape[0]
+            fresh = init_cache(cfg, 1, bucket)
+            kv_mask = (jnp.arange(bucket) < true_len)[None, :]
+            logits, filled = module.apply(
+                {"params": params}, tokens[None],
+                positions=jnp.arange(bucket)[None, :],
+                cache=fresh, cache_index=jnp.int32(0), kv_mask=kv_mask,
+            )
+            last = jax.lax.dynamic_slice(
+                logits, (0, true_len - 1, 0), (1, 1, logits.shape[-1])
+            )[:, 0]
+            first = sample(last, key)[0]
+            cache = tuple(
+                tuple(
+                    jax.lax.dynamic_update_slice(
+                        glob, rows.astype(glob.dtype), (slot, 0, 0, 0)
+                    )
+                    for glob, rows in zip(glayer, flayer)
+                )
+                for glayer, flayer in zip(state["cache"], filled)
+            )
+            row_mask = jnp.arange(L) < true_len
+            return {
+                "cache": cache,
+                "kv_mask": state["kv_mask"].at[slot].set(row_mask),
+                "fill": state["fill"].at[slot].set(true_len),
+                "last_tok": state["last_tok"].at[slot].set(first),
+                "done": state["done"].at[slot].set(False),
+            }, first
+
+        self._prefill = jax.jit(prefill, donate_argnums=(1,))
+
+        def decode_chunk(params, state, active, keys):
+            """``chunk_steps`` decode steps for every slot in one scan."""
+
+            def step(state, key):
+                live = active & ~state["done"]
+                fill = state["fill"]
+                # this step writes its k/v at row `fill`; the new token
+                # must see ITSELF, so expose the row before the apply —
+                # for live slots only (dead slots' writes land on
+                # masked-False rows and stay invisible)
+                kv_mask = state["kv_mask"] | (
+                    (jnp.arange(L)[None, :] == fill[:, None]) & live[:, None]
+                )
+                logits, cache = module.apply(
+                    {"params": params}, state["last_tok"][:, None],
+                    cache=state["cache"], cache_index=fill,
+                    kv_mask=kv_mask,
+                )
+                nxt = sample(logits[:, -1], key)
+                nxt = jnp.where(live, nxt, pad_id)
+                done = state["done"]
+                if eos_id is not None:
+                    done = done | (live & (nxt == eos_id))
+                advance = live & (fill + 1 < L)
+                # belt: a live slot at the cache end freezes its fill on a
+                # masked-True row — mark done so it stops writing there
+                done = done | (live & ~advance)
+                return {
+                    "cache": cache,
+                    "kv_mask": kv_mask,
+                    "fill": fill + advance.astype(jnp.int32),
+                    "last_tok": jnp.where(live, nxt, state["last_tok"]),
+                    "done": done,
+                }, nxt
+
+            state, toks = jax.lax.scan(step, state, keys)
+            return state, toks  # toks: [chunk_steps, slots]
+
+        self._decode_chunk = jax.jit(decode_chunk, donate_argnums=(1,))
+
+    # ------------------------------------------------------------------ #
+    # public API
+    # ------------------------------------------------------------------ #
+
+    def generate(
+        self,
+        params,
+        prompts: Sequence[Sequence[int]],
+        *,
+        max_new_tokens: Optional[int] = None,
+    ) -> list:
+        """Generate for a list of token-id prompts; blocks until all done.
+
+        Compatible with the ``make_lm_predictor`` row-lists contract:
+        returns one token list per prompt. ``params`` binds on first call
+        (pass serving-ready weights — cast/quantized).
+        """
+        self.bind(params)
+        n = max_new_tokens if max_new_tokens is not None else self.max_new_tokens
+        if not 1 <= n <= self.max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens {n} outside [1, {self.max_new_tokens}] "
+                "(raise the engine's max_new_tokens)"
+            )
+        reqs = []
+        for p in prompts:
+            row = np.asarray(p, dtype=np.int32).ravel()
+            if row.size == 0:
+                raise ValueError("empty prompt")
+            row = row[-self.buckets[-1]:]  # left-truncate to largest bucket
+            req = _Request(prompt=row, max_new_tokens=n)
+            self._queue.put(req)
+            reqs.append(req)
+        out = []
+        for req in reqs:
+            if not req.event.wait(self.submit_timeout):
+                # abandon the whole call: queued siblings are dropped at
+                # admission and in-slot ones retired at the next harvest,
+                # so orphans stop burning device time and slots
+                for r in reqs:
+                    r.abandoned = True
+                raise TimeoutError("decode engine did not finish in time")
+            if req.error is not None:
+                raise req.error
+            out.append(list(req.tokens))
+        return out
+
+    def bind(self, params):
+        """Set (or swap) the served weights; state allocates lazily.
+
+        Swapping while requests are in flight would mix weights within a
+        decode (later chunks of an in-flight request would run under the
+        new tree against a KV cache built with the old one) — refuse
+        instead of corrupting silently.
+        """
+        if params is self._params:
+            return
+        with self._lock:
+            busy = (
+                any(r is not None for r in self._occupant)
+                or self._admitting > 0
+                or not self._queue.empty()
+            )
+            if self._params is not None and busy:
+                raise RuntimeError(
+                    "cannot swap engine params while requests are in "
+                    "flight — drain the engine (or create a new one) first"
+                )
+            self._params = params
+
+    def warmup(self, params) -> int:
+        """Pre-compile every engine executable (one prefill per bucket +
+        the decode chunk). Returns the number compiled."""
+        self.bind(params)
+        # 2 tokens, not 1: a 1-token request completes at prefill and
+        # would never compile the decode chunk
+        n = min(2, self.max_new_tokens)
+        for b in self.buckets:
+            self.generate(params, [np.zeros(b, np.int32) + 1], max_new_tokens=n)
+        return len(self.buckets) + 1
+
+    def stats(self) -> dict:
+        """Serving observability: request timing splits + slot occupancy."""
+        from unionml_tpu.serving._stats import percentile_summary
+
+        with self._lock:
+            done = list(self._completed)
+            total = self._completed_total
+            steps, chunks = self._steps, self._chunks
+            occupied = self._occupied_slot_steps
+        out = {
+            "engine": "continuous",
+            "slots": self.slots,
+            "chunk_steps": self.chunk_steps,
+            "pipeline_depth": self.pipeline_depth,
+            "completed_requests": total,
+            "decode_steps": steps,
+            "slot_occupancy": round(occupied / max(1, steps * self.slots), 3),
+        }
+        if done:
+            for i, name in enumerate(("queue_wait_ms", "prefill_ms", "decode_ms")):
+                out[name] = percentile_summary([rec[i] for rec in done])
+        return out
+
+    def reset_stats(self) -> None:
+        """Zero the observability aggregates (benchmarks call this between
+        scenarios so each phase's /stats describes only that phase)."""
+        with self._lock:
+            self._completed.clear()
+            self._completed_total = 0
+            self._steps = 0
+            self._chunks = 0
+            self._occupied_slot_steps = 0
+
+    def close(self):
+        self._stop.set()
+        self._worker.join(timeout=5.0)
+        self._harvester.join(timeout=5.0)
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.error = RuntimeError("decode engine closed")
+            req.event.set()
+        for req in self._occupant:
+            if req is not None:
+                req.error = RuntimeError("decode engine closed")
+                req.event.set()
+        self._occupant = [None] * self.slots
+
+    # ------------------------------------------------------------------ #
+    # engine loop
+    # ------------------------------------------------------------------ #
+
+    def _bucket_for(self, length: int) -> int:
+        for b in self.buckets:
+            if length <= b:
+                return b
+        return self.buckets[-1]
+
+    def _next_key(self, num: int = 1):
+        self._key, *subs = self._jax.random.split(self._key, num + 1)
+        return subs
+
+    def _admit(self, req: _Request):
+        """Dispatch ``req``'s prefill into a free slot WITHOUT blocking on
+        the first token (its readback is harvested later, in dispatch
+        order). Dispatcher thread only; occupancy mutates under the lock."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            slot = self._occupant.index(None)
+        t0 = time.perf_counter()
+        req.queue_wait_ms = (t0 - req.submitted) * 1e3
+        req._dispatch_t = t0
+        bucket = self._bucket_for(len(req.prompt))
+        padded = np.full(bucket, self.pad_id, np.int32)
+        padded[: len(req.prompt)] = req.prompt
+        (key,) = self._next_key()
+        self._state, first = self._prefill(
+            self._params, self._state, jnp.int32(slot), jnp.asarray(padded),
+            jnp.int32(len(req.prompt)), key,
+        )
+        _start_host_copy(first)
+        with self._lock:
+            self._occupant[slot] = req
+            self._slot_gen[slot] += 1
+            req._expected = 1
+        self._inflight.put(("prefill", slot, req, first))
+
+    def _finish_if_done(self, slot: int, tok: int) -> bool:
+        """Harvester thread, called with the lock held."""
+        req = self._occupant[slot]
+        if req is None:
+            return True
+        done = (
+            req.abandoned
+            or (self.eos_id is not None and tok == self.eos_id)
+            or len(req.tokens) >= req.max_new_tokens
+        )
+        if done:
+            req.decode_ms = (time.perf_counter() - req._prefill_end) * 1e3
+            if not req.abandoned:
+                self._completed.append(
+                    (req.queue_wait_ms, req.prefill_ms, req.decode_ms)
+                )
+                self._completed_total += 1
+                if len(self._completed) > 10_000:
+                    del self._completed[:5_000]
+            self._occupant[slot] = None
+            req.event.set()
+        return done
+
+    def _process_entry(self, entry) -> None:
+        """Account one readback's tokens (harvester thread). The blocking
+        ``np.asarray`` happened outside the lock; entries arrive in
+        dispatch order, so a slot's prefill token always lands before its
+        decode tokens and before any reuse of the slot."""
+        if entry[0] == "prefill":
+            _, slot, req, first = entry
+            tok = int(np.asarray(first))
+            now = time.perf_counter()  # after the readback: prefill_ms
+            with self._lock:           # includes its in-flight lag
+                req.prefill_ms = (now - req._dispatch_t) * 1e3
+                req._prefill_end = now
+                req.tokens.append(tok)
+                self._finish_if_done(slot, tok)
+            return
+        _, mask, gens, toks = entry
+        toks = np.asarray(toks)
+        with self._lock:
+            for step_toks in toks:
+                for slot in np.flatnonzero(mask):
+                    req = self._occupant[slot]
+                    if req is None or gens[slot] != self._slot_gen[slot]:
+                        continue  # stale: dispatched for a previous occupant
+                    tok = int(step_toks[slot])
+                    req.tokens.append(tok)
+                    if self._finish_if_done(slot, tok):
+                        mask[slot] = False
+
+    def _dispatch_chunk(self) -> bool:
+        """Dispatch one decode chunk if the pipeline has a credit and any
+        occupant still needs tokens beyond already-dispatched work."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            mask = np.array([r is not None for r in self._occupant])
+            needed = any(
+                r is not None and r._expected < r.max_new_tokens
+                for r in self._occupant
+            )
+        if not mask.any() or not needed:
+            return False
+        if not self._chunk_credits.acquire(blocking=False):
+            return False  # pipeline_depth chunks already awaiting harvest
+        try:
+            keys = jnp.stack(self._next_key(self.chunk_steps))
+            self._state, toks = self._decode_chunk(
+                self._params, self._state, jnp.asarray(mask), keys
+            )
+            _start_host_copy(toks)
+        except BaseException:
+            # the credit is only released by the harvester for entries that
+            # were actually enqueued — give it back or the pipeline wedges
+            self._chunk_credits.release()
+            raise
+        with self._lock:
+            for slot in np.flatnonzero(mask):
+                if self._occupant[slot] is not None:
+                    self._occupant[slot]._expected += self.chunk_steps
+            gens = tuple(self._slot_gen)
+            self._chunks += 1
+            self._steps += self.chunk_steps
+            self._occupied_slot_steps += int(mask.sum()) * self.chunk_steps
+        self._inflight.put(("chunk", mask, gens, toks))
+        return True
+
+    def _pop_request(self) -> Optional[_Request]:
+        """Atomically dequeue a request and mark it as mid-admission, so
+        bind()'s busy check never sees a gap where the request is neither
+        queued nor occupying a slot."""
+        with self._lock:
+            if None not in self._occupant:
+                return None
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return None
+            self._admitting += 1
+        return req
+
+    def _admit_or_drop(self, req: _Request) -> None:
+        """Dispatcher: prefill a dequeued request (counted in
+        ``_admitting`` by ``_pop_request``), or drop it if its waiter
+        already timed out (no point burning a slot on it)."""
+        try:
+            if req.abandoned:
+                req.error = TimeoutError("request abandoned before admission")
+                req.event.set()
+                return
+            if self._state is None:
+                self._state = self._init_state()
+            try:
+                self._admit(req)
+            except BaseException as exc:
+                req.error = exc
+                req.event.set()
+        finally:
+            with self._lock:
+                self._admitting -= 1
+
+    def _run(self):
+        """Dispatcher: admit queued requests into free slots and keep up
+        to ``pipeline_depth`` decode chunks in flight. NEVER blocks on a
+        readback — the harvester thread owns those. Through a tunneled
+        backend a readback interaction costs a full round trip (~119 ms
+        measured vs ~2 ms/step of decode compute, BASELINE.md), so
+        overlapping dispatch with harvest is what keeps the chip busy;
+        ``is_ready`` polling is worse than blocking (it serializes the
+        command stream) and is never used.
+        """
+        while not self._stop.is_set():
+            try:
+                progressed = False
+                req = self._pop_request()
+                if req is not None:
+                    self._admit_or_drop(req)
+                    progressed = True
+                if self._dispatch_chunk():
+                    progressed = True
+                if not progressed:
+                    # nothing admittable or dispatchable: arrivals and
+                    # harvest-freed slots are picked up next pass (2 ms
+                    # keeps the 1-core host responsive without spinning)
+                    time.sleep(0.002)
+            except BaseException as exc:  # pragma: no cover - engine crash
+                self._fail_all(exc)
+
+    def _harvest_loop(self):
+        """Harvester: block on the oldest in-flight readback, account its
+        tokens, retire finished requests, release the pipeline credit."""
+        while not self._stop.is_set():
+            try:
+                entry = self._inflight.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                self._process_entry(entry)
+            except BaseException as exc:  # pragma: no cover - engine crash
+                self._fail_all(exc)
+            finally:
+                if entry[0] == "chunk":
+                    self._chunk_credits.release()
+
+    def _fail_all(self, exc: BaseException) -> None:
+        logger.info(f"decode engine error: {exc!r}")
+        with self._lock:
+            for slot, req in enumerate(self._occupant):
+                if req is not None:
+                    req.error = exc
+                    req.event.set()
+                    self._occupant[slot] = None
+        self._state = None

@@ -72,5 +72,9 @@ def serving_app(
     async def health():  # reference: fastapi.py:66-70
         return core.health()
 
+    @app.get("/stats")
+    async def stats():  # no reference counterpart: latency attribution
+        return core.stats()
+
     app.state.unionml_tpu = core
     return app

@@ -7,7 +7,11 @@ Same endpoint surface as the reference's FastAPI app
 - ``POST /predict`` — body ``{"inputs": {reader kwargs}}`` or
   ``{"features": ...}``; features flow through
   ``dataset.get_features`` then the (optionally micro-batched) predictor,
-- ``GET /health`` — ``{"status": "ok", "model_loaded": bool}``.
+- ``GET /health`` — ``{"status": "ok", "model_loaded": bool}``,
+- ``GET /stats`` — serving observability: per-request queue-wait /
+  prefill / decode (or device) time splits from the active batcher or
+  decode engine (no reference counterpart — needed to attribute tail
+  latency between transport queueing and device time).
 
 Startup model loading mirrors fastapi.py:22-34: ``UNIONML_MODEL_PATH``
 env first, then the remote registry when ``remote=True``.
@@ -61,13 +65,19 @@ class ServingApp:
         batch: bool = False,
         model_path_env: str = "UNIONML_MODEL_PATH",
         warmup: Optional[Any] = None,
+        stats: Optional[Any] = None,
         **batcher_kwargs,
     ):
         """``warmup``: optional callable invoked with the loaded model
         object after ``setup_model`` — pre-compile every serving
         executable there (e.g. ``make_lm_predictor``'s ``.warmup``), or
         the first live request per shape stalls behind a multi-second
-        XLA compile."""
+        XLA compile.
+
+        ``stats``: optional zero-arg callable whose dict is served at
+        ``GET /stats`` (e.g. ``DecodeEngine.stats`` when the predictor
+        wraps a continuous-batching engine); defaults to the
+        micro-batcher's stats when ``batch=True``."""
         self.model = model
         self.remote = remote
         self.app_version = app_version
@@ -75,6 +85,7 @@ class ServingApp:
         self.model_path_env = model_path_env
         self.batch = batch
         self.warmup = warmup
+        self._stats_fn = stats
         self._batcher = None
         self._batcher_kwargs = batcher_kwargs
         self._server: Optional[ThreadingHTTPServer] = None
@@ -121,6 +132,19 @@ class ServingApp:
     def health(self) -> dict:
         return {"status": "ok", "model_loaded": self.model.artifact is not None}
 
+    def stats(self) -> dict:
+        if self._stats_fn is not None:
+            return dict(self._stats_fn())
+        if self._batcher is not None:
+            return self._batcher.stats()
+        return {"engine": "direct"}  # per-request predictor calls: no queue
+
+    def reset_stats(self) -> None:
+        """Zero the batcher's observability window (no-op for direct or
+        custom-stats serving — reset the custom source directly)."""
+        if self._batcher is not None:
+            self._batcher.reset_stats()
+
     def predict(self, payload: dict) -> Any:
         if self.model.artifact is None:
             self.setup_model()
@@ -163,6 +187,8 @@ class ServingApp:
                     self._send(200, app.root(), content_type="text/html")
                 elif self.path == "/health":
                     self._send(200, app.health())
+                elif self.path == "/stats":
+                    self._send(200, app.stats())
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 

@@ -15,10 +15,13 @@ model = Model(name="{{app_name}}", init=LogisticRegression, dataset=dataset)
 
 
 @dataset.reader
-def reader() -> pd.DataFrame:
+def reader(sample_frac: float = 1.0, random_state: int = 42) -> pd.DataFrame:
     from sklearn.datasets import load_digits
 
-    return load_digits(as_frame=True).frame
+    frame = load_digits(as_frame=True).frame
+    if sample_frac >= 1.0:
+        return frame  # sample(frac=1.0) would shuffle the canonical order
+    return frame.sample(frac=sample_frac, random_state=random_state)
 
 
 @model.trainer

@@ -1,0 +1,15 @@
+"""Scaffolded smoke test: the spec trains and predicts end to end."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import app
+
+
+def test_train_and_predict():
+    estimator, metrics = app.model.train(hyperparameters={"max_iter": 200})
+    assert metrics["test"] > 0.8
+    preds = app.model.predict(sample_frac=0.05, random_state=1)
+    assert isinstance(preds, list) and preds

@@ -1,0 +1,20 @@
+"""Scaffolded smoke test: the jit train_step trains and predicts."""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import app
+
+
+def test_train_step_and_jit_predictor():
+    state, metrics = app.model.train(
+        hyperparameters={"hidden": 32, "learning_rate": 1e-3},
+        trainer_kwargs={"num_epochs": 2, "batch_size": 64},
+    )
+    assert metrics["test"] > 0.5
+    preds = app.model.predict(features=np.zeros((2, 64), np.float32))
+    assert np.asarray(preds).shape == (2,)

@@ -1,0 +1,33 @@
+"""Scaffolded smoke test: both serverless handlers answer their events."""
+
+import json
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).parent.parent
+sys.path.insert(0, str(ROOT))
+
+import app
+
+
+def test_gateway_and_upload_handlers(tmp_path):
+    app.model.train(hyperparameters={"max_iter": 200})
+    health = app.handler({"httpMethod": "GET", "path": "/health"})
+    assert health["statusCode"] == 200
+
+    event = json.loads((ROOT / "events" / "gateway_predict.json").read_text())
+    resp = app.handler(event)
+    assert resp["statusCode"] == 200
+    assert json.loads(resp["body"])
+
+    # object-store upload event (fixture: events/object_upload.json)
+    store = app.LocalObjectStore(str(tmp_path))
+    frame = app.reader().drop(columns=["target"]).head(2)
+    store.put("uploads", "batch-001.json",
+              json.dumps(frame.to_dict(orient="records")).encode())
+    on_upload = app.object_event_handler(app.model, store)
+    upload_event = json.loads((ROOT / "events" / "object_upload.json").read_text())
+    out = on_upload(upload_event)
+    assert out["statusCode"] == 200
+    written = json.loads(store.get("uploads", "batch-001.json.predictions.json"))
+    assert len(written) == 2

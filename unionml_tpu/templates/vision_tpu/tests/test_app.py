@@ -1,0 +1,27 @@
+"""Scaffolded smoke test: cached reader + ViT train_step + file-loader
+prediction path."""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import app
+
+
+def test_train_and_predict_array_and_file(tmp_path):
+    state, metrics = app.model.train(
+        hyperparameters={"learning_rate": 1e-3},
+        trainer_kwargs={"num_epochs": 1, "batch_size": 64},
+        n=256,
+    )
+    assert "test" in metrics
+    image = np.zeros((app.IMAGE_SIZE, app.IMAGE_SIZE, 3), np.float32)
+    preds = app.model.predict(features=image[None])
+    assert np.asarray(preds).shape == (1,)
+    npy = tmp_path / "img.npy"
+    np.save(npy, image)
+    preds2 = app.model.predict(features=str(npy))
+    assert np.asarray(preds2).shape == (1,)
