@@ -1,0 +1,202 @@
+"""Fused LayerNorm/RMSNorm kernels: values and grads must match the
+plain XLA implementations (interpret mode on CPU), including through the
+model-level switch (same params, same outputs)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from unionml_tpu.ops.fused_norm import (
+    fused_add_layer_norm,
+    fused_layer_norm,
+    fused_rms_norm,
+)
+
+
+def _ref_ln(x, g, b, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, -1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), -1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * g + b).astype(x.dtype)
+
+
+def _ref_rms(x, g, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    return (x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + eps) * g).astype(x.dtype)
+
+
+@pytest.mark.parametrize("shape", [(4, 17, 128), (256, 256)])
+def test_layer_norm_values_and_grads(shape):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    g = jnp.asarray(rng.normal(size=shape[-1]) + 1.0, jnp.float32)
+    b = jnp.asarray(rng.normal(size=shape[-1]), jnp.float32)
+
+    np.testing.assert_allclose(
+        np.asarray(fused_layer_norm(x, g, b)), np.asarray(_ref_ln(x, g, b)),
+        rtol=1e-5, atol=1e-5,
+    )
+
+    def loss_fused(x, g, b):
+        return jnp.sum(jnp.sin(fused_layer_norm(x, g, b)))
+
+    def loss_ref(x, g, b):
+        return jnp.sum(jnp.sin(_ref_ln(x, g, b)))
+
+    for got, want in zip(
+        jax.grad(loss_fused, argnums=(0, 1, 2))(x, g, b),
+        jax.grad(loss_ref, argnums=(0, 1, 2))(x, g, b),
+    ):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_rms_norm_values_and_grads():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(6, 9, 128)), jnp.float32)
+    g = jnp.asarray(rng.normal(size=128) + 1.0, jnp.float32)
+
+    np.testing.assert_allclose(
+        np.asarray(fused_rms_norm(x, g)), np.asarray(_ref_rms(x, g)),
+        rtol=1e-5, atol=1e-5,
+    )
+    got = jax.grad(lambda x, g: jnp.sum(jnp.cos(fused_rms_norm(x, g))), argnums=(0, 1))(x, g)
+    want = jax.grad(lambda x, g: jnp.sum(jnp.cos(_ref_rms(x, g))), argnums=(0, 1))(x, g)
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+
+def test_add_layer_norm_matches_unfused():
+    """(s, y) = add+LN fused == the two-op reference, values and grads —
+    including the residual gradient folding (ds flows to both inputs)."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(8, 16, 128)), jnp.float32)
+    r = jnp.asarray(rng.normal(size=(8, 16, 128)), jnp.float32)
+    g = jnp.asarray(rng.normal(size=128) + 1.0, jnp.float32)
+    b = jnp.asarray(rng.normal(size=128), jnp.float32)
+
+    s, y = fused_add_layer_norm(x, r, g, b)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(x + r), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(_ref_ln(x + r, g, b)), rtol=1e-5, atol=1e-5
+    )
+
+    def loss_fused(x, r, g, b):
+        s, y = fused_add_layer_norm(x, r, g, b)
+        return jnp.sum(jnp.sin(y)) + jnp.sum(jnp.cos(s))  # both outputs used
+
+    def loss_ref(x, r, g, b):
+        s = x + r
+        return jnp.sum(jnp.sin(_ref_ln(s, g, b))) + jnp.sum(jnp.cos(s))
+
+    for got, want in zip(
+        jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, r, g, b),
+        jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, r, g, b),
+    ):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_bf16_inputs_fp32_statistics():
+    """bf16 activations: statistics in fp32, output cast once."""
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(32, 256)), jnp.bfloat16)
+    g = jnp.asarray(rng.normal(size=256) + 1.0, jnp.float32)
+    b = jnp.zeros(256, jnp.float32)
+    got = fused_layer_norm(x, g, b)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(_ref_ln(x, g, b), np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_vit_fused_norm_matches_xla_impl():
+    """The model-level switch: same params, same loss/grads either way."""
+    from unionml_tpu.models import ViT, ViTConfig
+
+    rng = np.random.default_rng(4)
+    images = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+    # fp32 end to end so the comparison isolates the kernel math from
+    # bf16 rounding-order differences
+    cfg_x = ViTConfig(**{**ViTConfig.tiny().__dict__, "dtype": "float32"})
+    cfg_f = ViTConfig(**{**cfg_x.__dict__, "norm_impl": "fused"})
+    params = ViT(cfg_x).init(jax.random.PRNGKey(0), images)["params"]
+
+    out_x = ViT(cfg_x).apply({"params": params}, images)
+    out_f = ViT(cfg_f).apply({"params": params}, images)  # same param tree
+    np.testing.assert_allclose(np.asarray(out_x), np.asarray(out_f), rtol=1e-4, atol=1e-4)
+
+    def loss(cfg):
+        def f(p):
+            return jnp.sum(ViT(cfg).apply({"params": p}, images) ** 2)
+        return jax.grad(f)(params)
+
+    gx, gf = loss(cfg_x), loss(cfg_f)
+    for a, b in zip(jax.tree_util.tree_leaves(gx), jax.tree_util.tree_leaves(gf)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), rtol=1e-3, atol=1e-3
+        )
+
+
+def test_llama_fused_rms_norm_matches():
+    """RMSNorm impl switch on the Llama stack: same logits."""
+    from unionml_tpu.models import Llama, LlamaConfig
+
+    cfg_x = LlamaConfig.tiny(vocab_size=64)
+    cfg_f = LlamaConfig(**{**cfg_x.__dict__, "norm_impl": "fused"})
+    toks = jnp.asarray(np.arange(1, 17).reshape(2, 8), jnp.int32)
+    params = Llama(cfg_x).init(jax.random.PRNGKey(0), toks)["params"]
+    out_x = Llama(cfg_x).apply({"params": params}, toks)
+    out_f = Llama(cfg_f).apply({"params": params}, toks)
+    np.testing.assert_allclose(
+        np.asarray(out_x), np.asarray(out_f), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_non_divisible_row_counts():
+    """Rows not divisible by the 256-row block (e.g. ViT's 64*197): the
+    trailing partial block must not corrupt values or dgamma/dbeta."""
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(197 * 3, 128)), jnp.float32)
+    g = jnp.asarray(rng.normal(size=128) + 1.0, jnp.float32)
+    b = jnp.asarray(rng.normal(size=128), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(fused_layer_norm(x, g, b)), np.asarray(_ref_ln(x, g, b)),
+        rtol=1e-5, atol=1e-5,
+    )
+    got = jax.grad(lambda *a: jnp.sum(jnp.sin(fused_layer_norm(*a))), argnums=(0, 1, 2))(x, g, b)
+    want = jax.grad(lambda *a: jnp.sum(jnp.sin(_ref_ln(*a))), argnums=(0, 1, 2))(x, g, b)
+    for a, b_ in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=2e-4, atol=2e-4)
+
+
+def test_add_variant_non_divisible_rows():
+    """The fused add+LN kernel on rows that leave a trailing partial
+    block (the ViT-B production shape, B*197): both outputs and all
+    grads must survive the masking."""
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.normal(size=(197, 128)), jnp.float32)
+    r = jnp.asarray(rng.normal(size=(197, 128)), jnp.float32)
+    g = jnp.asarray(rng.normal(size=128) + 1.0, jnp.float32)
+    b = jnp.asarray(rng.normal(size=128), jnp.float32)
+    s, y = fused_add_layer_norm(x, r, g, b)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(x + r), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(_ref_ln(x + r, g, b)), rtol=1e-5, atol=1e-5
+    )
+
+    def loss_fused(x, r, g, b):
+        s, y = fused_add_layer_norm(x, r, g, b)
+        return jnp.sum(jnp.sin(y)) + jnp.sum(jnp.cos(s))
+
+    def loss_ref(x, r, g, b):
+        s = x + r
+        return jnp.sum(jnp.sin(_ref_ln(s, g, b))) + jnp.sum(jnp.cos(s))
+
+    for got, want in zip(
+        jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, r, g, b),
+        jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, r, g, b),
+    ):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)

@@ -42,6 +42,8 @@ class LlamaConfig:
     rope_theta: float = 500_000.0
     max_len: int = 8192
     attn_impl: str = "xla"
+    # "fused" = Pallas RMSNorm kernel pair (ops/fused_norm.py)
+    norm_impl: str = "xla"
     sequence_axis: Optional[str] = None
     quantized: bool = False  # int8 weight-only matmuls (serving path)
     remat: bool = False  # gradient checkpointing per block (long-context training)
@@ -107,7 +109,7 @@ class LlamaBlock(nn.Module):
             dtype=dtype,
             name="attn",
         )
-        h = RMSNorm(dtype=dtype, name="attn_norm")(x)
+        h = RMSNorm(dtype=dtype, impl=cfg.norm_impl, name="attn_norm")(x)
         if cache is not None:
             a, new_cache = attn(
                 h, positions=positions, cache=cache, cache_index=cache_index,
@@ -124,7 +126,7 @@ class LlamaBlock(nn.Module):
                 )
             a, new_cache = attn(h, positions=positions), None
         x = x + a
-        h = RMSNorm(dtype=dtype, name="mlp_norm")(x)
+        h = RMSNorm(dtype=dtype, impl=cfg.norm_impl, name="mlp_norm")(x)
         if cfg.num_experts:
             mlp_out, aux = MoEMlp(
                 num_experts=cfg.num_experts, num_selected=cfg.num_selected,
@@ -185,7 +187,7 @@ class Llama(nn.Module):
                 kv_mask=kv_mask,
             )
             new_cache.append(c)
-        x = RMSNorm(dtype=dtype, name="final_norm")(x)
+        x = RMSNorm(dtype=dtype, impl=cfg.norm_impl, name="final_norm")(x)
         logits = make_dense(
             quantized=cfg.quantized, features=cfg.vocab_size,
             dtype=jnp.float32, name="lm_head",

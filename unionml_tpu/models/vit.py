@@ -16,7 +16,7 @@ from typing import Optional
 import jax.numpy as jnp
 from flax import linen as nn
 
-from unionml_tpu.models.layers import Attention, MlpBlock
+from unionml_tpu.models.layers import Attention, LayerNorm, MlpBlock
 from unionml_tpu.parallel.sharding import PartitionRule
 
 
@@ -30,6 +30,9 @@ class ViTConfig:
     num_heads: int = 12
     mlp_dim: int = 3072
     attn_impl: str = "xla"
+    # "fused" = Pallas LayerNorm kernel pair incl. residual-add fusion
+    # (ops/fused_norm.py); "xla" = plain fp32-stats LayerNorm
+    norm_impl: str = "xla"
     dtype: str = "bfloat16"
 
     @staticmethod
@@ -53,12 +56,45 @@ class ViTBlock(nn.Module):
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
-        ln = lambda name: nn.LayerNorm(dtype=dtype, name=name)  # noqa: E731
-        x = x + Attention(
+        # default path stays plain nn.LayerNorm (identical graph/numerics
+        # to pre-norm_impl builds); the fused module shares its param
+        # names so either impl loads the other's checkpoints
+        ln = lambda name: (  # noqa: E731
+            LayerNorm(dtype=dtype, name=name)
+            if cfg.norm_impl == "fused"
+            else nn.LayerNorm(dtype=dtype, name=name)
+        )
+        attn = Attention(
             num_heads=cfg.num_heads, attn_impl=cfg.attn_impl, dtype=dtype, name="attn"
-        )(ln("ln1")(x))
-        x = x + MlpBlock(hidden_dim=cfg.mlp_dim, dtype=dtype, name="mlp")(ln("ln2")(x))
+        )
+        mlp = MlpBlock(hidden_dim=cfg.mlp_dim, dtype=dtype, name="mlp")
+        if cfg.norm_impl == "fused":
+            # fuse the mid-block residual add into ln2's pass (one fewer
+            # [B*S, D] HBM round trip each way); param tree unchanged
+            h1 = ln("ln1")(x)
+            s, h2 = _AddLayerNorm(dtype=cfg.dtype, name="ln2")(x, attn(h1))
+            return s + mlp(h2)
+        x = x + attn(ln("ln1")(x))
+        x = x + mlp(ln("ln2")(x))
         return x
+
+
+class _AddLayerNorm(nn.Module):
+    """``s = x + branch; y = LayerNorm(s)`` through the fused kernel,
+    parameter-compatible with :class:`LayerNorm` (``scale``/``bias``)."""
+
+    eps: float = 1e-6
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, branch: jnp.ndarray):
+        from unionml_tpu.ops.fused_norm import fused_add_layer_norm
+
+        d = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (d,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (d,), jnp.float32)
+        s, y = fused_add_layer_norm(x, branch, scale, bias, self.eps)
+        return s, y.astype(jnp.dtype(self.dtype))
 
 
 class ViT(nn.Module):
@@ -89,7 +125,10 @@ class ViT(nn.Module):
         x = x + pos.astype(dtype)
         for i in range(cfg.num_layers):
             x = ViTBlock(cfg, name=f"block_{i}")(x)
-        x = nn.LayerNorm(dtype=dtype, name="ln_final")(x)
+        if cfg.norm_impl == "fused":
+            x = LayerNorm(dtype=dtype, name="ln_final")(x)
+        else:
+            x = nn.LayerNorm(dtype=dtype, name="ln_final")(x)
         return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(x[:, 0])
 
 
