@@ -1,0 +1,177 @@
+"""Build the static documentation site from docs/*.md.
+
+The reference ships a Sphinx site (reference: docs/source/conf.py +
+14 .md/.rst sources with nav). This repo's docs are plain markdown kept
+current by tests (test_docs_reference.py, test_tutorials.py); this
+script renders them into a browsable site with a navigation sidebar
+using only the stdlib + the `markdown` package (no Sphinx/mkdocs in the
+image — `mkdocs.yml` at the repo root carries the same nav for
+environments that have mkdocs installed).
+
+Usage::
+
+    python scripts/build_docs_site.py [--out site] [--check]
+
+``--check`` exits non-zero if any nav entry is missing or any internal
+.md link would 404 in the rendered site (CI runs this).
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import shutil
+import sys
+from pathlib import Path
+
+import markdown
+
+ROOT = Path(__file__).resolve().parents[1]
+DOCS = ROOT / "docs"
+
+# nav order (mirrored in mkdocs.yml — keep in sync)
+NAV = [
+    ("Overview", "index.md"),
+    ("Quickstart", "quickstart.md"),
+    ("Dataset", "dataset.md"),
+    ("Model", "model.md"),
+    ("Parallelism", "parallelism.md"),
+    ("Serving", "serving.md"),
+    ("Remote deployment", "remote.md"),
+    ("Reliability", "reliability.md"),
+    ("Performance", "performance.md"),
+    ("CLI", "cli.md"),
+    ("Tutorial: MNIST", "tutorials/mnist.md"),
+    ("Tutorial: Vision", "tutorials/vision.md"),
+    ("Tutorial: LLM serving", "tutorials/llm_serving.md"),
+    ("API reference", "api_reference.md"),
+    ("CLI reference", "cli_reference.md"),
+]
+
+TEMPLATE = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>{title} — unionml-tpu</title>
+<style>
+body {{ margin: 0; font: 16px/1.6 system-ui, sans-serif; color: #1a1a2e; }}
+.wrap {{ display: flex; min-height: 100vh; }}
+nav {{ width: 240px; flex: none; background: #f4f4f8; padding: 1.5rem 1rem;
+      border-right: 1px solid #e0e0e8; }}
+nav h1 {{ font-size: 1.1rem; margin: 0 0 1rem; }}
+nav a {{ display: block; padding: .25rem .5rem; color: #333; border-radius: 4px;
+        text-decoration: none; }}
+nav a:hover {{ background: #e8e8f0; }}
+nav a.active {{ background: #dcdcf0; font-weight: 600; }}
+main {{ flex: 1; max-width: 860px; padding: 2rem 3rem; overflow-x: auto; }}
+pre {{ background: #f6f8fa; padding: .8rem 1rem; border-radius: 6px;
+      overflow-x: auto; font-size: .9rem; }}
+code {{ background: #f6f8fa; padding: .1rem .3rem; border-radius: 3px;
+       font-size: .92em; }}
+pre code {{ padding: 0; background: none; }}
+table {{ border-collapse: collapse; margin: 1rem 0; }}
+th, td {{ border: 1px solid #d8d8e0; padding: .4rem .7rem; text-align: left; }}
+th {{ background: #f4f4f8; }}
+h1, h2, h3 {{ scroll-margin-top: 1rem; }}
+a {{ color: #3146b0; }}
+</style>
+</head>
+<body>
+<div class="wrap">
+<nav>
+<h1>unionml-tpu</h1>
+{nav}
+</nav>
+<main>
+{body}
+</main>
+</div>
+</body>
+</html>
+"""
+
+
+def out_path(md_rel: str) -> str:
+    return md_rel[:-3] + ".html"
+
+
+def render_nav(current: str) -> str:
+    depth = current.count("/")
+    prefix = "../" * depth
+    items = []
+    for title, page in NAV:
+        cls = ' class="active"' if page == current else ""
+        items.append(f'<a href="{prefix}{out_path(page)}"{cls}>{title}</a>')
+    return "\n".join(items)
+
+
+def rewrite_links(html: str, current: str, known: set) -> list:
+    """Point internal .md links at their rendered .html; report breaks."""
+    broken = []
+
+    def sub(m):
+        href = m.group(1)
+        if href.startswith(("http://", "https://", "#", "mailto:")):
+            return m.group(0)
+        target, _, frag = href.partition("#")
+        if not target.endswith(".md"):
+            return m.group(0)
+        resolved = (Path(current).parent / target).as_posix()
+        resolved = re.sub(r"(^|/)\./", r"\1", resolved)
+        while True:  # normalize a/../b; a LEADING ../ escapes docs/ → broken
+            collapsed = re.sub(r"[^/.][^/]*/\.\./", "", resolved, count=1)
+            if collapsed == resolved:
+                break
+            resolved = collapsed
+        if resolved.startswith("../") or resolved not in known:
+            broken.append((current, href))
+            return m.group(0)  # leaves the .md href; reported as broken
+        new = out_path(target) + (f"#{frag}" if frag else "")
+        return f'href="{new}"'
+
+    return re.sub(r'href="([^"]+)"', sub, html), broken
+
+
+def build(out_dir: Path, check: bool) -> int:
+    known = {page for _, page in NAV}
+    missing = [page for page in known if not (DOCS / page).exists()]
+    if missing:
+        print(f"nav entries missing from docs/: {sorted(missing)}")
+        return 1
+    if not check:
+        shutil.rmtree(out_dir, ignore_errors=True)
+        out_dir.mkdir(parents=True, exist_ok=True)
+    md = markdown.Markdown(extensions=["fenced_code", "tables", "toc"])
+    all_broken = []
+    for title, page in NAV:
+        src = (DOCS / page).read_text(encoding="utf-8")
+        body = md.reset().convert(src)
+        body, broken = rewrite_links(body, page, known)
+        all_broken.extend(broken)
+        html = TEMPLATE.format(title=title, nav=render_nav(page), body=body)
+        if not check:
+            dest = out_dir / out_path(page)
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            dest.write_text(html, encoding="utf-8")
+    if all_broken:
+        for page, href in all_broken:
+            print(f"broken internal link in {page}: {href}")
+        return 1
+    if not check:
+        print(f"site built: {out_dir} ({len(NAV)} pages)")
+    else:
+        print(f"docs site check OK ({len(NAV)} pages, links resolve)")
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=str(ROOT / "site"))
+    parser.add_argument("--check", action="store_true")
+    args = parser.parse_args()
+    return build(Path(args.out), args.check)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,189 @@
+"""Dependency-free lint: the high-value correctness subset, stdlib-only.
+
+The reference gates on full flake8/mypy; this image ships neither, so
+this AST-based checker enforces the subset that catches real bugs and
+runs anywhere (CI executes it alongside flake8 — flake8 remains the
+richer gate where installed):
+
+- F401-equivalent: unused imports (module scope, `__init__.py` exempt —
+  package surfaces re-export),
+- mutable default arguments,
+- bare ``except:``,
+- comparisons to ``None``/``True``/``False`` with ``==``/``!=``,
+- f-strings without placeholders,
+- tabs in indentation and trailing whitespace,
+- lines over 110 columns (the codebase targets ~100; 110 is the hard
+  stop so URLs/tables don't nag).
+
+Usage: ``python scripts/lint_basics.py [paths...]`` (default: the
+package, tests, benchmarks, scripts). Exits non-zero on findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+DEFAULT_PATHS = ["unionml_tpu", "tests", "benchmarks", "scripts", "bench.py",
+                 "__graft_entry__.py"]
+MAX_LINE = 110
+
+
+class Checker(ast.NodeVisitor):
+    def __init__(self, path: Path, src: str):
+        self.path = path
+        self.src = src
+        self.problems: list = []
+        self.imports: dict = {}       # name -> (lineno, spelled)
+        self.used: set = set()
+
+    def problem(self, lineno: int, msg: str):
+        self.problems.append(f"{self.path}:{lineno}: {msg}")
+
+    # -- imports ------------------------------------------------------- #
+
+    def visit_Import(self, node: ast.Import):
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            self.imports[name] = (node.lineno, alias.name)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        if node.module == "__future__":
+            return  # compiler directive, never "used"
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            name = alias.asname or alias.name
+            self.imports[name] = (node.lineno, alias.name)
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name):
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        root = node
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        if isinstance(root, ast.Name):
+            self.used.add(root.id)
+        self.generic_visit(node)
+
+    # -- defaults / except / comparisons / f-strings ------------------- #
+
+    def _check_defaults(self, node):
+        for default in list(node.args.defaults) + list(node.args.kw_defaults):
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                self.problem(
+                    default.lineno,
+                    f"mutable default argument in {node.name}()",
+                )
+
+    def visit_FunctionDef(self, node):
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node):
+        if node.type is None:
+            self.problem(node.lineno, "bare except: (catch a class)")
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare):
+        for op, comp in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Eq, ast.NotEq)) and isinstance(
+                comp, ast.Constant
+            ) and (comp.value is None or comp.value is True or comp.value is False):
+                self.problem(
+                    node.lineno,
+                    f"comparison to {comp.value!r} with ==/!= (use is/is not)",
+                )
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node: ast.JoinedStr):
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self.problem(node.lineno, "f-string without placeholders")
+        self.generic_visit(node)
+
+    def visit_FormattedValue(self, node: ast.FormattedValue):
+        # do NOT descend into format_spec: "{x:.2e}" carries a nested
+        # placeholder-free JoinedStr that is not a user f-string
+        self.visit(node.value)
+
+    # -- finish -------------------------------------------------------- #
+
+    def report_unused_imports(self, tree: ast.Module):
+        if self.path.name == "__init__.py":
+            return
+        # names exported via __all__ or re-exported strings count as used
+        exported = set()
+        for node in tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "__all__"
+                    for t in node.targets
+                )
+                and isinstance(node.value, (ast.List, ast.Tuple))
+            ):
+                exported |= {
+                    e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                }
+        for name, (lineno, spelled) in self.imports.items():
+            if name in self.used or name in exported:
+                continue
+            # "import x.y" spells a submodule import for side effects
+            if "." in spelled and name == spelled.split(".")[0]:
+                continue
+            self.problem(lineno, f"unused import: {spelled}")
+
+
+def check_file(path: Path) -> list:
+    src = path.read_text(encoding="utf-8")
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+    checker = Checker(path, src)
+    checker.visit(tree)
+    checker.report_unused_imports(tree)
+    for i, line in enumerate(src.splitlines(), 1):
+        if "\t" in line[: len(line) - len(line.lstrip())]:
+            checker.problem(i, "tab in indentation")
+        if line != line.rstrip():
+            checker.problem(i, "trailing whitespace")
+        if len(line) > MAX_LINE:
+            checker.problem(i, f"line too long ({len(line)} > {MAX_LINE})")
+    return checker.problems
+
+
+def main(argv) -> int:
+    paths = argv or DEFAULT_PATHS
+    files: list = []
+    for p in paths:
+        path = (ROOT / p) if not Path(p).is_absolute() else Path(p)
+        if path.is_dir():
+            files.extend(sorted(path.rglob("*.py")))
+        elif path.suffix == ".py":
+            files.append(path)
+    problems: list = []
+    for f in files:
+        if "__pycache__" in f.parts:
+            continue
+        problems.extend(check_file(f))
+    for p in problems:
+        print(p)
+    print(f"lint_basics: {len(files)} files, {len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -22,7 +22,10 @@ def mock_data() -> pd.DataFrame:
 
 @pytest.fixture
 def dataset(mock_data) -> Dataset:
-    ds = Dataset(name="test_dataset", features=["x", "x2"], targets=["y"], test_size=0.2, shuffle=True, random_state=99)
+    ds = Dataset(
+        name="test_dataset", features=["x", "x2"], targets=["y"],
+        test_size=0.2, shuffle=True, random_state=99,
+    )
 
     @ds.reader
     def reader(sample_frac: float = 1.0, random_state: int = 123) -> pd.DataFrame:

@@ -1,7 +1,7 @@
 """Dataset spec tests (reference: tests/unit/test_dataset.py)."""
 
 import json
-from typing import Dict, List, NamedTuple, Tuple
+from typing import Dict, List, Tuple
 
 import numpy as np
 import pandas as pd

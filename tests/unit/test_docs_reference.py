@@ -18,3 +18,23 @@ def test_generated_references_are_current():
         f"regenerate with `python scripts/gen_api_reference.py`\n"
         f"{proc.stdout}\n{proc.stderr}"
     )
+
+
+def test_docs_site_builds_and_links_resolve():
+    """The static docs site (reference analog: the Sphinx site) must
+    build: every nav entry exists and internal .md links resolve."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "build_docs_site.py"), "--check"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_lint_basics_clean():
+    """The dependency-free correctness lint (unused imports, bare except,
+    mutable defaults, ==None, placeholder-free f-strings) stays clean."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_basics.py")],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

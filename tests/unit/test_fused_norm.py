@@ -7,7 +7,6 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from flax import linen as nn
 
 from unionml_tpu.ops.fused_norm import (
     fused_add_layer_norm,

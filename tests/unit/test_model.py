@@ -4,7 +4,6 @@ import io
 from dataclasses import is_dataclass
 
 import numpy as np
-import pandas as pd
 import pytest
 
 from unionml_tpu import Model, ModelArtifact

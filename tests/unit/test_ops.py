@@ -2,7 +2,6 @@
 against the full-score XLA reference (SURVEY.md §4.3 strategy: numerical
 equivalence on the CPU-simulated mesh)."""
 
-import functools
 
 import jax
 import jax.numpy as jnp

@@ -4,7 +4,6 @@ converted fp weights and generate nearly the same tokens."""
 import dataclasses
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp

@@ -138,6 +138,10 @@ def _fake_transport(monkeypatch, backend, fail_hosts=(), capture=None, stub=Fals
             capture.append(("run_ssh", host, command))
         if stub and "pip install" in command:
             return subprocess.CompletedProcess([], 0, "", "")
+        if "docker pull" in command:
+            # remote docker isn't available in the fake environment in
+            # either mode; the capture records the pull for assertions
+            return subprocess.CompletedProcess([], 0, "", "")
         return subprocess.run(["bash", "-c", command], capture_output=True, text=True)
 
     def fake_scp_to(host, src, dst):
@@ -413,3 +417,104 @@ def test_dump_outputs_names_non_model_offender(fixture_model):
     with pytest.raises(RuntimeError, match="metrics") as err:
         dump_outputs(fixture_model, outputs, io.BytesIO())
     assert err.value.__cause__ is not None  # original pickling error chained
+
+
+def _fake_docker(monkeypatch, backend, capture, *, fail_on=None):
+    """Local docker stand-in: records build/push/pull; `docker run ...`
+    launched over SSH is rewritten to execute the inner runner command
+    directly, so the containerized launch path runs for real."""
+
+    def fake_run_docker(args):
+        capture.append(("docker",) + tuple(args[:2]))
+        if fail_on and args[0] == fail_on:
+            return subprocess.CompletedProcess([], 1, "", f"fake {fail_on} failure")
+        return subprocess.CompletedProcess([], 0, "", "")
+
+    monkeypatch.setattr(backend, "_run_docker", fake_run_docker)
+    return backend
+
+
+def test_tpuvm_image_deploy_builds_pushes_and_pulls(tpuvm_model, monkeypatch):
+    """Image mode: full deploy = docker build + push + per-host pull, NO
+    pip provisioning; patch deploy skips all image work."""
+    model, tmp_path = tpuvm_model
+    backend = _make_tpuvm_backend(
+        tmp_path, ["hostA", "hostB"], provision=True, image="reg.example/app"
+    )
+    capture = []
+    _fake_transport(monkeypatch, backend, capture=capture, stub=True)
+    _fake_docker(monkeypatch, backend, capture)
+
+    backend.deploy(model, app_version="v1")
+    assert ("docker", "build", "-t") in capture
+    assert ("docker", "push", "reg.example/app:v1") in capture
+    pulls = [(e[1], e[2]) for e in capture if e[0] == "run_ssh" and "docker pull" in e[2]]
+    assert {h for h, _ in pulls} == {"hostA", "hostB"}
+    assert all("reg.example/app:v1" in c for _, c in pulls)
+    # image supersedes pip provisioning
+    assert not [e for e in capture if e[0] == "run_ssh" and "pip install" in e[2]]
+
+    capture.clear()
+    backend.deploy(model, app_version="v1-patch123", patch=True)
+    assert not [e for e in capture if e[0] == "docker"]
+    assert not [e for e in capture if e[0] == "run_ssh" and "docker pull" in e[2]]
+
+
+def test_tpuvm_image_deploy_failure_surfaces(tpuvm_model, monkeypatch):
+    model, tmp_path = tpuvm_model
+    backend = _make_tpuvm_backend(tmp_path, ["hostA"], image="reg.example/app")
+    capture = []
+    _fake_transport(monkeypatch, backend, capture=capture, stub=True)
+    _fake_docker(monkeypatch, backend, capture, fail_on="push")
+    with pytest.raises(RuntimeError, match="docker push failed"):
+        backend.deploy(model, app_version="v1")
+
+
+def test_tpuvm_image_execution_runs_in_container(tpuvm_model, monkeypatch):
+    """The launch command wraps the runner in `docker run` with the
+    workdir/registry mounts and env flags; executing it (with the docker
+    prefix stripped by the fake transport) completes the full train
+    lifecycle — proving the in-container command is the real runner
+    invocation."""
+    import re
+
+    model, tmp_path = tpuvm_model
+    backend = _make_tpuvm_backend(
+        tmp_path, ["hostA"], shared_fs=False, image="reg.example/app",
+        image_push=False,
+    )
+    capture = []
+    _fake_transport(monkeypatch, backend, capture=capture)
+    _fake_docker(monkeypatch, backend, capture)
+
+    real_ssh = backend._ssh
+
+    def docker_exec_ssh(host, command, **popen_kwargs):
+        if command.startswith("docker run"):
+            m = re.search(r"reg\.example/app:\S+ (python -m unionml_tpu\.remote\.runner .*)$", command)
+            assert m, command
+            assert f"-v {backend.root}:{backend.root}" in command
+            assert "-e UNIONML_TPU_HOME=" in command and "--network host" in command
+            # single host: no jax.distributed env
+            assert "JAX_COORDINATOR_ADDRESS" not in command
+            envs = dict(
+                kv.split("=", 1)
+                for kv in re.findall(r"-e ([A-Z_]+=\S+)", command)
+            )
+            inner = m.group(1)
+            env = dict(os.environ)
+            env.update(envs)
+            env["PYTHONPATH"] = os.pathsep.join(
+                [str(REPO_ROOT), str(APPS_DIR), env.get("PYTHONPATH", "")]
+            )
+            wd = re.search(r"-w (\S+)", command).group(1)
+            return subprocess.Popen(["bash", "-c", inner], cwd=wd, env=env, **popen_kwargs)
+        return real_ssh(host, command, **popen_kwargs)
+
+    monkeypatch.setattr(backend, "_ssh", docker_exec_ssh)
+    model._backend = backend
+    model.remote_deploy(app_version="v1")
+    artifact = model.remote_train(app_version="v1",
+                                  hyperparameters={"max_iter": 200}, n=200)
+    assert artifact.metrics["test"] > 0.8
+    assert any(e[0] == "docker" and e[1] == "build" for e in capture)

@@ -4,7 +4,6 @@ object I/O against a local store instead of mocked boto3)."""
 
 import json
 
-import numpy as np
 import pytest
 
 from unionml_tpu.serving.serverless import (

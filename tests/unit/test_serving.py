@@ -1,7 +1,6 @@
 """Serving tests over a real socket
 (reference analog: tests/integration/test_fastapi.py, stdlib transport)."""
 
-import json
 import threading
 
 import httpx

@@ -30,7 +30,10 @@ def app():
 @app.command()
 @click.argument("app_name")
 @click.option("--template", "-t", default="basic",
-              type=click.Choice([p.name for p in sorted(TEMPLATES_DIR.iterdir())] if TEMPLATES_DIR.exists() else ["basic"]),
+              type=click.Choice(
+                  [p.name for p in sorted(TEMPLATES_DIR.iterdir())]
+                  if TEMPLATES_DIR.exists() else ["basic"]
+              ),
               help="project template")
 def init(app_name: str, template: str):
     """Scaffold a new app (reference: cli.py:33-51 + cookiecutter hooks)."""

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import collections
 import itertools
-from typing import Any, Iterable, Iterator, Optional
+from typing import Any, Iterable, Iterator
 
 
 class DeviceFeed:

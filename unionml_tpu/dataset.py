@@ -29,12 +29,11 @@ from inspect import Parameter
 
 from unionml_tpu.type_guards import signature
 from pathlib import Path
-from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple, Type, Union, get_args
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union, get_args
 
 import numpy as np
 
 from unionml_tpu import type_guards
-from unionml_tpu.defaults import DEFAULT_RESOURCES, Resources
 from unionml_tpu.stage import Stage, stage_from_fn
 from unionml_tpu.tracking import TrackedInstance
 

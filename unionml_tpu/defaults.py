@@ -5,7 +5,7 @@ mem="1Gi")``). The TPU-native resource model adds an accelerator request:
 ``chips`` is the number of TPU chips a stage asks for (0 = host-only stage).
 """
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
-from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+from typing import Any, Callable, Iterable, Optional
 
 import numpy as np
 
@@ -145,7 +145,7 @@ def run_step_trainer(
             )
         if hasattr(features, "__next__") and num_epochs != 1:
             raise ValueError(
-                f"a one-shot batch iterator cannot be replayed for "
+                "a one-shot batch iterator cannot be replayed for "
                 f"num_epochs={num_epochs}; pass a callable returning a fresh "
                 "iterable per epoch"
             )
@@ -158,7 +158,7 @@ def run_step_trainer(
     if accumulate_steps > 1:
         if not streaming and n < feed_rows:
             raise ValueError(
-                f"gradient accumulation needs at least accumulate_steps * "
+                "gradient accumulation needs at least accumulate_steps * "
                 f"batch_size = {feed_rows} examples per step, got {n}"
             )
         if sharding is not None:
@@ -209,7 +209,7 @@ def run_step_trainer(
                     # an already-exhausted iterator, or a callable returning
                     # the SAME exhausted iterator each epoch
                     raise ValueError(
-                        f"streaming source yielded no batches in epoch "
+                        "streaming source yielded no batches in epoch "
                         f"{epoch + 1}/{num_epochs}. A callable must return a "
                         "FRESH iterable per call (a lambda closing over one "
                         "generator replays an exhausted stream); an iterator "

@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import inspect
 import os
-from collections import OrderedDict
 from dataclasses import asdict, field, is_dataclass, make_dataclass
 from inspect import Parameter
 
@@ -35,7 +34,7 @@ from typing import IO, Any, Callable, Dict, List, NamedTuple, Optional, Tuple, T
 from unionml_tpu import type_guards
 from unionml_tpu._logging import logger
 from unionml_tpu.dataset import Dataset
-from unionml_tpu.defaults import DEFAULT_RESOURCES, Resources
+from unionml_tpu.defaults import DEFAULT_RESOURCES
 from unionml_tpu.stage import Stage, Workflow, stage_from_fn
 from unionml_tpu.tracking import TrackedInstance
 

@@ -22,7 +22,7 @@ Design notes (TPU):
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -83,38 +83,29 @@ class RMSNorm(nn.Module):
 
 
 class LayerNorm(nn.Module):
-    """LayerNorm through the fused Pallas kernel pair, parameter-path
-    compatible with ``nn.LayerNorm`` (``scale``/``bias`` at this module's
-    level — checkpoints interchange freely).
+    """LayerNorm through the fused Pallas kernel pair
+    (:mod:`unionml_tpu.ops.fused_norm`), parameter-path compatible with
+    ``nn.LayerNorm`` (``scale``/``bias`` at this module's level —
+    checkpoints interchange freely).
 
     Model configs select the implementation at the CALL SITE: the
-    default "xla" path uses plain ``nn.LayerNorm`` (identical graph and
-    numerics for existing users — a wrapper here would either nest the
-    param path or re-implement flax's statistics); ``impl="fused"``
-    routes through :mod:`unionml_tpu.ops.fused_norm`. The ``impl``
-    field exists so call sites can instantiate unconditionally; the
-    non-fused value replicates flax's fast-variance math inline.
+    default "xla" norm_impl uses plain ``nn.LayerNorm`` (identical graph
+    and numerics for existing users — a wrapper here would either nest
+    the param path or re-implement flax's statistics), and this module
+    is instantiated only on the fused path.
     """
 
     eps: float = 1e-6
     dtype: Dtype = jnp.bfloat16
-    impl: str = "fused"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        from unionml_tpu.ops.fused_norm import fused_layer_norm
+
         d = x.shape[-1]
         scale = self.param("scale", nn.initializers.ones, (d,), jnp.float32)
         bias = self.param("bias", nn.initializers.zeros, (d,), jnp.float32)
-        if self.impl == "fused":
-            from unionml_tpu.ops.fused_norm import fused_layer_norm
-
-            return fused_layer_norm(x, scale, bias, self.eps).astype(self.dtype)
-        x32 = x.astype(jnp.float32)
-        mu = jnp.mean(x32, axis=-1, keepdims=True)
-        # flax-style fast variance: E[x^2] - E[x]^2, clamped
-        var = jnp.maximum(0.0, jnp.mean(x32 * x32, axis=-1, keepdims=True) - mu * mu)
-        xhat = (x32 - mu) * jax.lax.rsqrt(var + self.eps)
-        return (xhat * scale + bias).astype(self.dtype)
+        return fused_layer_norm(x, scale, bias, self.eps).astype(self.dtype)
 
 
 def rotary_embedding(

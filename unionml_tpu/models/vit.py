@@ -11,7 +11,6 @@ the Megatron 2-collectives-per-block layout via GSPMD.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Optional
 
 import jax.numpy as jnp
 from flax import linen as nn

@@ -16,7 +16,6 @@ Convention (all attention ops in this package): tensors are
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

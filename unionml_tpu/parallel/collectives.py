@@ -10,10 +10,8 @@ communication schedule is the algorithm.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Union
+from typing import Any, Sequence, Union
 
-import jax
-import jax.numpy as jnp
 from jax import lax
 
 AxisName = Union[str, Sequence[str]]

@@ -9,7 +9,7 @@ compiles collectives that ride ICI within a slice and DCN across slices.
 from __future__ import annotations
 
 import os
-from typing import Dict, Optional, Sequence
+from typing import Dict, Optional
 
 import numpy as np
 

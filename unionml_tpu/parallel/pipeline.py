@@ -21,7 +21,6 @@ bubble fraction at ``(n-1)/(m+n-1)``.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Optional
 
 import jax

@@ -16,12 +16,10 @@ tensor-parallel collectives land on adjacent ICI neighbors.
 
 from __future__ import annotations
 
-import functools
 import re
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
-import numpy as np
 
 from unionml_tpu.parallel.mesh import make_mesh
 

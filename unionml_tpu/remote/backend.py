@@ -291,7 +291,20 @@ class TPUVMBackend(BaseBackend):
 
     def __init__(self, *, hosts: List[str], ssh_user: str = "root",
                  workdir: str = "/tmp/unionml_tpu_app", coordinator_port: int = 8476,
-                 shared_fs: bool = True, provision: bool = True, **kwargs):
+                 shared_fs: bool = True, provision: bool = True,
+                 image: Optional[str] = None, image_push: bool = True,
+                 dockerfile: Optional[str] = None, **kwargs):
+        """``image``: optional container repository (e.g.
+        ``gcr.io/proj/unionml-tpu``). When set, full deploys build the
+        framework ``Dockerfile`` tagged ``{image}:{app_version}``, push
+        it (unless ``image_push: false`` — e.g. a registry mirrored to
+        the hosts), and pull it on every host; executions then run the
+        runner INSIDE the container (workdir and registry bind-mounted)
+        so the remote environment is an immutable per-version artifact —
+        the reference's ``docker_build_push`` mode (remote.py:69-108).
+        Patch deploys skip the build/pull, mirroring fast registration.
+        ``dockerfile`` overrides the default (the framework root's).
+        """
         super().__init__(**kwargs)
         if not hosts:
             raise ValueError("TPUVMBackend requires at least one host")
@@ -301,6 +314,9 @@ class TPUVMBackend(BaseBackend):
         self.coordinator_port = coordinator_port
         self.shared_fs = shared_fs
         self.provision = provision
+        self.image = image
+        self.image_push = image_push
+        self.dockerfile = dockerfile
         # execution_id -> {"procs": [(host, Popen, logfile)], "targets": [...]}
         self._procs: Dict[str, Dict[str, Any]] = {}
         # (host, app_version) pairs already pushed by THIS process: execute()
@@ -347,6 +363,62 @@ class TPUVMBackend(BaseBackend):
             check=True,
         )
 
+    def _run_docker(self, args: List[str]) -> subprocess.CompletedProcess:
+        """Local docker invocation (build/push run on the deploying
+        machine; hosts only pull). Monkeypatch point for tests."""
+        return subprocess.run(["docker"] + args, capture_output=True, text=True)
+
+    # ---------- image mode (docker_build_push analog) ----------
+
+    def _image_tag(self, app_version: str) -> str:
+        # patch deploys ("v1-patch3f2a") skip the image build and run in
+        # the BASE version's container — fast registration semantics:
+        # source changes ride the scp push, the environment is pinned
+        return f"{self.image}:{app_version.split('-patch')[0]}"
+
+    def _build_and_distribute_image(self, app_version: str) -> str:
+        """Build the framework image for this version, push it, and pull
+        it on every host. The image pins the ENVIRONMENT; app source
+        still rides the scp push (so patch redeploys stay seconds)."""
+        tag = self._image_tag(app_version)
+        fw_root = Path(__file__).resolve().parents[2]
+        dockerfile = self.dockerfile or str(fw_root / "Dockerfile")
+        if not Path(dockerfile).exists():
+            # a pip-installed package has no Dockerfile next to it — the
+            # default only works from a source checkout
+            raise RuntimeError(
+                f"image mode needs a Dockerfile: {dockerfile} does not "
+                "exist (the framework appears to be installed as a "
+                "package, not a source checkout). Set `dockerfile:` in "
+                "the backend config to your build file."
+            )
+        context = str(Path(dockerfile).parent)
+        proc = self._run_docker(
+            ["build", "-t", tag, "-f", dockerfile, context]
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"docker build failed for {tag}:\n{(proc.stderr or '')[-800:]}"
+            )
+        if self.image_push:
+            proc = self._run_docker(["push", tag])
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"docker push failed for {tag}:\n{(proc.stderr or '')[-800:]}"
+                )
+        errors = []
+        for host in self.hosts:
+            pull = self._run_ssh(host, f"docker pull {tag}")
+            if pull.returncode != 0:
+                errors.append(f"{host}: {(pull.stderr or '').strip()[-300:]}")
+        if errors:
+            raise RuntimeError(
+                f"docker pull failed on {len(errors)}/{len(self.hosts)} "
+                "hosts:\n" + "\n".join(errors)
+            )
+        logger.info(f"image {tag} built and distributed to {len(self.hosts)} hosts")
+        return tag
+
     # ---------- deploy + environment provisioning ----------
 
     def deploy(self, model, *, app_version: str, patch: bool = False) -> Path:
@@ -362,6 +434,12 @@ class TPUVMBackend(BaseBackend):
         # a re-deploy of the same version string (e.g. a second '-dirty'
         # deploy after edits) must re-push: drop its push-dedup entries
         self._pushed = {p for p in self._pushed if p[1] != app_version}
+        if self.image:
+            # image mode supersedes pip provisioning: the environment is
+            # the container, built once per version
+            if not patch:
+                self._build_and_distribute_image(app_version)
+            return dest
         if self.provision and not patch:
             from concurrent.futures import ThreadPoolExecutor
 
@@ -462,21 +540,37 @@ class TPUVMBackend(BaseBackend):
                 remote_exec = f"{targets[i]}/_exec/{record.execution_id}"
                 self._run_ssh_checked(host, f"mkdir -p {remote_exec}")
                 self._scp_to(host, f"{record.exec_dir}/.", remote_exec)
-            env_prefix = (
-                f"UNIONML_TPU_HOME={self.root} UNIONML_TPU_PROJECT={self.project} "
-            )
+            env = {
+                "UNIONML_TPU_HOME": str(self.root),
+                "UNIONML_TPU_PROJECT": self.project,
+            }
             if len(self.hosts) > 1:
                 # single-host VMs skip jax.distributed entirely
-                env_prefix = (
-                    f"JAX_COORDINATOR_ADDRESS={coordinator} "
-                    f"JAX_NUM_PROCESSES={len(self.hosts)} JAX_PROCESS_ID={i} "
-                ) + env_prefix
-            cmd = (
-                f"cd {targets[i]} && {env_prefix}"
+                env.update({
+                    "JAX_COORDINATOR_ADDRESS": coordinator,
+                    "JAX_NUM_PROCESSES": str(len(self.hosts)),
+                    "JAX_PROCESS_ID": str(i),
+                })
+            runner = (
                 f"python -m unionml_tpu.remote.runner --app {manifest['app']} "
                 f"--workflow {record.workflow} --exec-dir {remote_exec}"
                 + (f" --model-version {model_version}" if model_version else "")
             )
+            if self.image:
+                # run the runner inside the per-version container: host
+                # networking for the jax.distributed coordinator,
+                # --privileged for TPU device access, workdir + registry
+                # bind-mounted so pushes/records work exactly as uncontained
+                env_flags = " ".join(f"-e {k}={v}" for k, v in env.items())
+                cmd = (
+                    f"docker run --rm --privileged --network host "
+                    f"-v {targets[i]}:{targets[i]} -v {self.root}:{self.root} "
+                    f"-w {targets[i]} {env_flags} "
+                    f"{self._image_tag(record.app_version)} {runner}"
+                )
+            else:
+                env_prefix = " ".join(f"{k}={v}" for k, v in env.items())
+                cmd = f"cd {targets[i]} && {env_prefix} {runner}"
             log_path = Path(record.exec_dir) / f"runner.host{i}.log"
             log = open(log_path, "w")
             procs.append((host, self._ssh(host, cmd, stdout=log, stderr=log), log))

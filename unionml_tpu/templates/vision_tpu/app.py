@@ -15,7 +15,6 @@ Run: ``python app.py`` (train + save), then
 from pathlib import Path
 from typing import Union
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 from unionml_tpu import Dataset, Model

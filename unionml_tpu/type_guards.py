@@ -17,7 +17,7 @@ from __future__ import annotations
 import inspect
 import typing
 from inspect import Parameter
-from typing import Any, Callable, Dict, Iterable, Mapping, Type
+from typing import Any, Callable, Dict, Iterable, Mapping
 
 
 def signature(fn: Callable) -> inspect.Signature:
