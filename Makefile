@@ -19,7 +19,7 @@ lint:
 		python -m ruff check unionml_tpu tests benchmarks scripts; \
 	elif python -c "import flake8" 2>/dev/null; then \
 		python -m flake8 --max-line-length 110 \
-			--extend-ignore=E203,W503,E731,E741,E501 \
+			--extend-ignore=E203,W503,E731,E741 \
 			unionml_tpu tests benchmarks scripts; \
 	else \
 		echo "flake8/ruff not installed; lint_basics covered the correctness subset"; \

@@ -172,8 +172,12 @@ def main(argv) -> int:
         path = (ROOT / p) if not Path(p).is_absolute() else Path(p)
         if path.is_dir():
             files.extend(sorted(path.rglob("*.py")))
-        elif path.suffix == ".py":
+        elif path.suffix == ".py" and path.exists():
             files.append(path)
+        else:
+            # a typo'd path must not green-light unlinted code
+            print(f"lint_basics: path does not resolve: {p}")
+            return 2
     problems: list = []
     for f in files:
         if "__pycache__" in f.parts:

@@ -219,3 +219,21 @@ def test_stats_archive_is_lightweight(tiny_llama):
         assert s["queue_wait_ms"]["p95"] >= s["queue_wait_ms"]["p50"] >= 0
     finally:
         engine.close()
+
+
+def test_engine_with_moe_llama():
+    """Continuous batching over a MoE decoder: per-slot decode routes
+    tokens through the experts; outputs match solo generation."""
+    cfg = LlamaConfig.tiny(vocab_size=97, num_experts=4, num_selected=2)
+    module = Llama(cfg)
+    params = module.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=6, prompt_buckets=(8,), chunk_steps=3
+    )
+    try:
+        prompts = [[1, 2, 3, 4], [5, 6, 7, 8, 9, 10]]
+        outs = engine.generate(params, prompts)
+        for prompt, out in zip(prompts, outs):
+            assert out == _solo(module, params, prompt, 6)
+    finally:
+        engine.close()

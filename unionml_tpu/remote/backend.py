@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import os
 import pickle
+import re
 import subprocess
 import sys
 import time
@@ -371,10 +372,14 @@ class TPUVMBackend(BaseBackend):
     # ---------- image mode (docker_build_push analog) ----------
 
     def _image_tag(self, app_version: str) -> str:
-        # patch deploys ("v1-patch3f2a") skip the image build and run in
-        # the BASE version's container — fast registration semantics:
-        # source changes ride the scp push, the environment is pinned
-        return f"{self.image}:{app_version.split('-patch')[0]}"
+        # patch deploys skip the image build and run in the BASE
+        # version's container — fast registration semantics: source
+        # changes ride the scp push, the environment is pinned. Only a
+        # TRAILING "-patch<hex>" (the exact suffix deploy() appends) is
+        # stripped; user versions that merely contain "-patch" keep
+        # their own tag.
+        base = re.sub(r"-patch[0-9a-f]+$", "", app_version)
+        return f"{self.image}:{base}"
 
     def _build_and_distribute_image(self, app_version: str) -> str:
         """Build the framework image for this version, push it, and pull
@@ -406,11 +411,18 @@ class TPUVMBackend(BaseBackend):
                 raise RuntimeError(
                     f"docker push failed for {tag}:\n{(proc.stderr or '')[-800:]}"
                 )
-        errors = []
-        for host in self.hosts:
+        from concurrent.futures import ThreadPoolExecutor
+
+        def pull_host(host: str) -> Optional[str]:
             pull = self._run_ssh(host, f"docker pull {tag}")
             if pull.returncode != 0:
-                errors.append(f"{host}: {(pull.stderr or '').strip()[-300:]}")
+                return f"{host}: {(pull.stderr or '').strip()[-300:]}"
+            return None
+
+        # hosts pull independently: multi-GB images at max(host), not
+        # sum(hosts), on big slices (same reason as pip provisioning)
+        with ThreadPoolExecutor(max_workers=min(16, len(self.hosts))) as pool:
+            errors = [e for e in pool.map(pull_host, self.hosts) if e]
         if errors:
             raise RuntimeError(
                 f"docker pull failed on {len(errors)}/{len(self.hosts)} "
