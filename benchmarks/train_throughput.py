@@ -105,20 +105,33 @@ def main() -> None:
     # flash attention keeps memory linear in seq; per-block remat trades
     # recompute for activation memory at 16k+
     if os.environ.get("UNIONML_TPU_BENCH_LC_SCALE") and not tiny:
-        for b, s, remat in ((1, 8192, False), (1, 16384, True)):
+        for b, s, remat, accum in (
+            (1, 8192, False, 1),
+            (1, 16384, True, 1),
+            # HBM caps the 16k config at microbatch 1; gradient
+            # accumulation restores an effective batch of 4 with the
+            # same activation footprint — the accumulate_steps knob's
+            # long-context cost is this row vs the one above
+            (1, 16384, True, 4),
+        ):
             scfg = LlamaConfig(**{**lcfg.__dict__, "max_len": s, "remat": remat})
             lm_s = Llama(scfg)
             toks = jnp.asarray(
-                rng.integers(0, scfg.vocab_size, size=(b, s)), jnp.int32
+                rng.integers(0, scfg.vocab_size, size=(b * accum, s)), jnp.int32
             )
-            st = create_train_state(lm_s, toks[:1, :8], learning_rate=1e-3)
-            stp = jax.jit(lm_step(lm_s), donate_argnums=0)
+            if accum > 1:
+                toks = toks.reshape(accum, b, s)
+            st = create_train_state(
+                lm_s, jnp.zeros((1, 8), jnp.int32), learning_rate=1e-3
+            )
+            stp = jax.jit(lm_step(lm_s, accumulate_steps=accum), donate_argnums=0)
             n_steps = max(20, steps // 4)  # longer steps: fewer suffice
             dt = _time_steps(stp, st, toks, n_steps, max(2, warmup // 2))
             print(json.dumps({
                 "metric": "llama_lc_scale_tokens_per_sec_per_chip",
                 "batch": b, "seq": s, "remat": remat,
-                "value": round(b * (s - 1) * n_steps / dt, 1),
+                "accumulate_steps": accum,
+                "value": round(b * accum * (s - 1) * n_steps / dt, 1),
                 "unit": "tokens/sec/chip",
             }))
 
