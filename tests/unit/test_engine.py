@@ -237,3 +237,37 @@ def test_engine_with_moe_llama():
             assert out == _solo(module, params, prompt, 6)
     finally:
         engine.close()
+
+
+def test_engine_under_tensor_parallel_sharding(tiny_llama):
+    """Continuous batching with TP-sharded weights: GSPMD propagates the
+    `tensor`-axis sharding through prefill and decode chunks, and slot
+    outputs stay token-identical to the unsharded solo run.
+
+    pipeline_depth=1 on the CPU mesh: deeper async pipelines of
+    multi-device programs starve XLA's rendezvous on few-core hosts
+    (same reason compile_step syncs per step there)."""
+    from unionml_tpu.models import LLAMA_PARTITION_RULES
+    from unionml_tpu.parallel import ShardingConfig, shard_pytree
+
+    module, params = tiny_llama
+    sharding = ShardingConfig(data=-1, tensor=2, rules=LLAMA_PARTITION_RULES)
+    tp_params = shard_pytree(params, sharding)
+    # guard against a silent replication fallback: the test must exercise
+    # REAL tensor sharding or it proves nothing
+    specs = [
+        str(tuple(leaf.sharding.spec))
+        for leaf in jax.tree_util.tree_leaves(tp_params)
+    ]
+    assert any("tensor" in s for s in specs), specs
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=6, prompt_buckets=(8,),
+        chunk_steps=3, pipeline_depth=1,
+    )
+    try:
+        prompts = [[1, 2, 3, 4, 5], [6, 7, 8]]
+        outs = engine.generate(tp_params, prompts)
+        for prompt, out in zip(prompts, outs):
+            assert out == _solo(module, params, prompt, 6)
+    finally:
+        engine.close()
