@@ -338,3 +338,57 @@ def test_elastic_stream_guards_truncated_replay_and_bad_signature(tmp_path):
             stream=lambda *, start: _stream_batches(start, 4),
             checkpoint_dir=str(tmp_path / "t2"),
         )
+
+
+def test_elastic_resume_with_accumulation_identical(tmp_path):
+    """Gradient accumulation inside the elastic loop: a hard-killed run
+    resumes to the bit-identical state, with global steps counting
+    optimizer updates (not microbatches)."""
+    from unionml_tpu.elastic import Preemption, run_elastic_trainer
+    from unionml_tpu.models.train import classification_step
+    from unionml_tpu.models import create_train_state
+    import optax
+    from flax import linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(2)(x)
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(128, 4)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    module = Tiny()
+
+    def fresh():
+        return create_train_state(module, jnp.zeros((1, 4)), optimizer=optax.adam(0.01))
+
+    step = classification_step(module, accumulate_steps=2)
+    common = dict(
+        step_fn=step, arrays=[x, y], num_epochs=2, batch_size=16,
+        accumulate_steps=2, seed=7, checkpoint_every=2,
+    )
+    # 128 rows / (2*16) feed = 4 updates/epoch x 2 epochs = 8 global steps
+    ref_state, ref_steps = run_elastic_trainer(
+        state=fresh(), checkpoint_dir=str(tmp_path / "ref"), **common
+    )
+    assert ref_steps == 8
+
+    def bomb(global_step):
+        if global_step == 5:
+            raise Preemption("simulated preemption")
+
+    with pytest.raises(Preemption):
+        run_elastic_trainer(
+            state=fresh(), checkpoint_dir=str(tmp_path / "run"),
+            fault_hook=bomb, **common
+        )
+    out_state, out_steps = run_elastic_trainer(
+        state=fresh(), checkpoint_dir=str(tmp_path / "run"), **common
+    )
+    assert out_steps == 8
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref_state.params),
+        jax.tree_util.tree_leaves(out_state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

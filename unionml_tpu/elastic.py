@@ -48,6 +48,7 @@ def run_elastic_trainer(
     max_to_keep: int = 3,
     sharding: Any = None,
     donate_state: bool = True,
+    accumulate_steps: int = 1,
     fault_hook: Optional[Callable[[int], None]] = None,
 ) -> Tuple[Any, int]:
     """Train with periodic checkpoints, resuming from the newest one.
@@ -57,6 +58,12 @@ def run_elastic_trainer(
     Returns ``(final_state, global_step)``. ``fault_hook(global_step)``
     is a test seam: it runs after each step and may raise to simulate
     preemption.
+
+    ``accumulate_steps=N``: gradient accumulation — each global step
+    consumes ``N * batch_size`` rows reshaped to a leading microbatch
+    axis (the ``run_step_trainer`` contract; build the step with a zoo
+    factory's ``accumulate_steps``). The global step COUNT includes the
+    accumulation, so resume points stay aligned with optimizer updates.
 
     Global step indexes the stream ``epoch * steps_per_epoch + batch``;
     checkpoints are written under ``checkpoint_dir/step_{global_step}``
@@ -82,6 +89,11 @@ def run_elastic_trainer(
     """
     if (arrays is None) == (stream is None):
         raise ValueError("pass exactly one of arrays= or stream=")
+    if accumulate_steps < 1:
+        raise ValueError(f"accumulate_steps must be >= 1, got {accumulate_steps}")
+    feed_rows = batch_size * accumulate_steps
+    if accumulate_steps > 1 and sharding is not None:
+        sharding = sharding.microbatched()
     if sharding is not None:
         from unionml_tpu.parallel import compile_step
 
@@ -90,6 +102,17 @@ def run_elastic_trainer(
         from unionml_tpu.execution import _jitted
 
         step = _jitted(step_fn, donate_state)
+
+    if accumulate_steps > 1:
+        from unionml_tpu.execution import to_microbatches
+
+        _inner = step
+
+        def step(state, batch, _inner=_inner):  # noqa: F811
+            # shared feeding contract with run_step_trainer: clear error
+            # on wrong leading dims (e.g. an un-accumulated stream)
+            micro = to_microbatches(batch, accumulate_steps, batch_size)
+            return _inner(state, micro)
 
     if stream is not None:
         return _run_stream(
@@ -100,7 +123,7 @@ def run_elastic_trainer(
         )
 
     loader = BatchLoader(
-        list(arrays), batch_size=batch_size, seed=seed, shuffle=True,
+        list(arrays), batch_size=feed_rows, seed=seed, shuffle=True,
         drop_remainder=True,
     )
     steps_per_epoch = loader.num_batches
@@ -108,8 +131,8 @@ def run_elastic_trainer(
         loader.close()
         raise ValueError(
             f"elastic trainer needs at least one full batch: {loader.n_rows} "
-            f"rows < batch_size={batch_size} (shapes must be static for the "
-            "jitted step — lower batch_size)"
+            f"rows < accumulate_steps * batch_size = {feed_rows} (shapes "
+            "must be static for the jitted step — lower batch_size)"
         )
     total_steps = steps_per_epoch * num_epochs
 

@@ -67,6 +67,35 @@ def _slice_batch(data: Any, idx: np.ndarray) -> Any:
     return jax.tree_util.tree_map(lambda x: np.asarray(x)[idx], data)
 
 
+def to_microbatches(batch: Any, accumulate_steps: int, batch_size: int) -> Any:
+    """Reshape a fed batch's leaves to ``[accumulate_steps, batch_size, ...]``.
+
+    The gradient-accumulation feeding contract shared by
+    :func:`run_step_trainer` and the elastic trainer: raises a clear
+    error when the leading dim isn't ``accumulate_steps * batch_size``
+    (e.g. a stream still yielding un-accumulated batches), and
+    materializes list-like leaves once.
+    """
+    import jax
+
+    feed_rows = accumulate_steps * batch_size
+
+    def reshape(x):
+        if not hasattr(x, "reshape"):
+            # list-like leaf: materialize once; device-resident arrays
+            # reshape in place (np.asarray here would round-trip them
+            # device->host->device every step)
+            x = np.asarray(x)
+        if x.shape[0] != feed_rows:
+            raise ValueError(
+                f"accumulation batch has leading dim {x.shape[0]}, "
+                f"expected accumulate_steps * batch_size = {feed_rows}"
+            )
+        return x.reshape((accumulate_steps, batch_size) + x.shape[1:])
+
+    return jax.tree_util.tree_map(reshape, batch)
+
+
 def batch_indices(
     n: int, batch_size: int, *, shuffle: bool, seed: int, drop_remainder: bool = True
 ) -> Iterable[np.ndarray]:
@@ -165,22 +194,7 @@ def run_step_trainer(
             sharding = sharding.microbatched()
 
         def _to_microbatches(batch: Any) -> Any:
-            import jax
-
-            def reshape(x):
-                if not hasattr(x, "reshape"):
-                    # list-like leaf: materialize once; device-resident
-                    # arrays reshape in place (a np.asarray here would
-                    # round-trip them device->host->device every step)
-                    x = np.asarray(x)
-                if x.shape[0] != feed_rows:
-                    raise ValueError(
-                        f"accumulation batch has leading dim {x.shape[0]}, "
-                        f"expected accumulate_steps * batch_size = {feed_rows}"
-                    )
-                return x.reshape((accumulate_steps, batch_size) + x.shape[1:])
-
-            return jax.tree_util.tree_map(reshape, batch)
+            return to_microbatches(batch, accumulate_steps, batch_size)
 
     if sharding is not None:
         from unionml_tpu.parallel import compile_step
