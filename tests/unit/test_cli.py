@@ -153,11 +153,10 @@ def test_init_emits_full_project_scaffold(runner, tmp_path, monkeypatch, templat
         assert (root / "events" / "gateway_predict.json").exists()
 
 
-@pytest.mark.parametrize("template", ["basic", "serverless"])
+@pytest.mark.parametrize("template", ALL_TEMPLATES)
 def test_scaffolded_project_tests_pass(runner, tmp_path, monkeypatch, template):
-    """The scaffold's own test suite passes as generated (the slower
-    jax-training templates are covered by the import check above and by
-    the framework's own model-zoo tests)."""
+    """Every scaffold's own test suite passes as generated — `init`
+    output is deployable, not just importable."""
     import os
     import subprocess
 

@@ -167,3 +167,57 @@ def test_llama_tp_sharded_lm_step():
     # params actually sharded over the tensor axis
     k = state.params["block_0"]["attn"]["q"]["kernel"]
     assert len(k.sharding.device_set) >= 2
+
+
+def test_mlm_masking_rule_and_pretraining_step():
+    """The BERT masking rule (15% selected; 80/10/10 mask/random/keep,
+    specials untouched) produces lm_step-compatible batches, and an MLM
+    pretraining step over BertMlm reduces the masked-CE loss."""
+    from unionml_tpu.models import BertConfig, BertMlm, make_mlm_batch
+    from unionml_tpu.models.train import create_train_state, lm_step
+
+    rng = np.random.default_rng(0)
+    vocab, mask_id = 1024, 103
+    tokens = rng.integers(4, vocab, size=(64, 32))
+    tokens[:, 0] = 0  # special position (e.g. [CLS]=0 here) never masked
+    inputs, labels = make_mlm_batch(
+        tokens, mask_id=mask_id, vocab_size=vocab, rng=rng, special_ids=(0,)
+    )
+    selected = labels != -100
+    frac = selected.mean()
+    assert 0.10 < frac < 0.20, frac
+    assert not selected[:, 0].any()                      # specials untouched
+    assert (labels[selected] == tokens[selected]).all()  # labels = originals
+    masked_frac = (inputs[selected] == mask_id).mean()
+    assert 0.65 < masked_frac < 0.92, masked_frac        # ~80% become [MASK]
+    kept = inputs[~selected] == tokens[~selected]
+    assert kept.all()                                    # unselected unchanged
+
+    cfg = BertConfig.tiny(vocab_size=vocab)
+    module = BertMlm(cfg)
+    state = create_train_state(
+        module, jnp.asarray(inputs[:1]), learning_rate=5e-3, seed=1
+    )
+    step = jax.jit(lm_step(module), donate_argnums=0)
+    batch = (jnp.asarray(inputs), jnp.asarray(labels))
+    state, first = step(state, batch)
+    for _ in range(15):
+        state, metrics = step(state, batch)
+    assert float(metrics["loss"]) < float(first["loss"]), (
+        float(first["loss"]), float(metrics["loss"]),
+    )
+
+
+def test_mlm_masking_handles_unsigned_token_dtypes():
+    """uint corpora must not wrap ignore_id to an in-range positive."""
+    from unionml_tpu.models import make_mlm_batch
+
+    rng = np.random.default_rng(2)
+    tokens = rng.integers(4, 1000, size=(8, 16)).astype(np.uint16)
+    inputs, labels = make_mlm_batch(
+        tokens, mask_id=103, vocab_size=1024, rng=rng
+    )
+    assert labels.dtype.kind == "i"
+    assert (labels == -100).any()
+    selected = labels != -100
+    assert (labels[selected] == tokens.astype(np.int64)[selected]).all()

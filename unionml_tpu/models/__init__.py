@@ -13,6 +13,7 @@ from unionml_tpu.models.bert import (
     BertConfig,
     BertEncoder,
     BertMlm,
+    make_mlm_batch,
 )
 from unionml_tpu.models.llama import (
     LLAMA_MOE_PARTITION_RULES,
@@ -50,7 +51,7 @@ from unionml_tpu.models.vit import VIT_PARTITION_RULES, ViT, ViTConfig
 __all__ = [
     "Mlp", "MlpConfig",
     "ViT", "ViTConfig", "VIT_PARTITION_RULES",
-    "BertEncoder", "BertClassifier", "BertMlm", "BertConfig", "BERT_PARTITION_RULES",
+    "BertEncoder", "BertClassifier", "BertMlm", "BertConfig", "BERT_PARTITION_RULES", "make_mlm_batch",
     "Llama", "LlamaConfig", "init_cache", "LLAMA_PARTITION_RULES",
     "LLAMA_QUANT_PARTITION_RULES", "LLAMA_MOE_PARTITION_RULES",
     "TrainState", "create_train_state", "classification_step", "lm_step",

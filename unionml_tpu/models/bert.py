@@ -152,6 +152,44 @@ class BertMlm(nn.Module):
         return nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="mlm_head")(x)
 
 
+def make_mlm_batch(
+    tokens,
+    *,
+    mask_id: int,
+    vocab_size: int,
+    rng,
+    mask_prob: float = 0.15,
+    special_ids: tuple = (0,),
+    ignore_id: int = -100,
+):
+    """BERT masking rule over a token batch: returns ``(inputs, labels)``.
+
+    15% of non-special positions are selected; of those 80% become
+    ``mask_id``, 10% a random token, 10% stay unchanged. ``labels``
+    carry the original ids at selected positions and ``ignore_id``
+    elsewhere — exactly the ``(inputs, labels)`` tuple contract of
+    :func:`unionml_tpu.models.train.lm_step`, so MLM pretraining is
+    ``lm_step(BertMlm(cfg))`` over these batches. Host-side numpy (runs
+    in the data path, not the compiled step); ``rng`` is a
+    ``numpy.random.Generator``.
+    """
+    import numpy as np
+
+    # signed dtype: with uint token arrays (typical tokenized corpora),
+    # ignore_id=-100 would wrap to a huge in-range positive and every
+    # position would be supervised with a garbage label
+    tokens = np.asarray(tokens).astype(np.int64)
+    maskable = ~np.isin(tokens, np.asarray(special_ids))
+    selected = (rng.random(tokens.shape) < mask_prob) & maskable
+    labels = np.where(selected, tokens, ignore_id)
+    roll = rng.random(tokens.shape)
+    inputs = tokens.copy()
+    inputs[selected & (roll < 0.8)] = mask_id
+    random_slots = selected & (roll >= 0.8) & (roll < 0.9)
+    inputs[random_slots] = rng.integers(0, vocab_size, size=int(random_slots.sum()))
+    return inputs, labels
+
+
 BERT_PARTITION_RULES = (
     PartitionRule(r"attn_(q|k|v)/kernel$", (None, "tensor", None)),
     PartitionRule(r"attn_o/kernel$", ("tensor", None, None)),

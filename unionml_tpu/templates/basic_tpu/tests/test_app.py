@@ -12,9 +12,9 @@ import app
 
 def test_train_step_and_jit_predictor():
     state, metrics = app.model.train(
-        hyperparameters={"hidden": 32, "learning_rate": 1e-3},
-        trainer_kwargs={"num_epochs": 2, "batch_size": 64},
+        hyperparameters={"hidden": 128, "learning_rate": 1e-3},
+        trainer_kwargs={"num_epochs": 5, "batch_size": 64},
     )
-    assert metrics["test"] > 0.5
+    assert metrics["test"] > 0.7
     preds = app.model.predict(features=np.zeros((2, 64), np.float32))
     assert np.asarray(preds).shape == (2,)
