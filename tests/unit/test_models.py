@@ -221,3 +221,28 @@ def test_mlm_masking_handles_unsigned_token_dtypes():
     assert (labels == -100).any()
     selected = labels != -100
     assert (labels[selected] == tokens.astype(np.int64)[selected]).all()
+
+
+def test_mlm_step_masks_padding_and_trains():
+    """mlm_step threads the attention mask (pads invisible) and reduces
+    masked CE; lm_step composition stays valid for unpadded batches."""
+    from unionml_tpu.models import BertConfig, BertMlm, make_mlm_batch, mlm_step
+    from unionml_tpu.models.train import create_train_state
+
+    rng = np.random.default_rng(3)
+    vocab = 512
+    cfg = BertConfig.tiny(vocab_size=vocab)
+    module = BertMlm(cfg)
+    tokens = rng.integers(4, vocab, size=(32, 24))
+    tokens[:, 20:] = 0  # right padding
+    inputs, labels = make_mlm_batch(
+        tokens, mask_id=103, vocab_size=vocab, rng=rng, special_ids=(0,)
+    )
+    mask = (tokens != 0).astype(np.int32)
+    state = create_train_state(module, jnp.asarray(inputs[:1]), learning_rate=5e-3)
+    step = jax.jit(mlm_step(module), donate_argnums=0)
+    batch = (jnp.asarray(inputs), jnp.asarray(labels), jnp.asarray(mask))
+    state, first = step(state, batch)
+    for _ in range(10):
+        state, metrics = step(state, batch)
+    assert float(metrics["loss"]) < float(first["loss"])

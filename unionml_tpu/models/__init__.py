@@ -14,6 +14,7 @@ from unionml_tpu.models.bert import (
     BertEncoder,
     BertMlm,
     make_mlm_batch,
+    mlm_step,
 )
 from unionml_tpu.models.llama import (
     LLAMA_MOE_PARTITION_RULES,
@@ -22,6 +23,14 @@ from unionml_tpu.models.llama import (
     Llama,
     LlamaConfig,
     init_cache,
+)
+from unionml_tpu.models.encdec import (
+    ENCDEC_PARTITION_RULES,
+    EncDecConfig,
+    EncoderDecoder,
+    init_decoder_cache,
+    make_seq2seq_generator,
+    seq2seq_step,
 )
 from unionml_tpu.models.generate import make_generator, make_lm_predictor, serving_params
 from unionml_tpu.models.mlp import Mlp, MlpConfig
@@ -51,8 +60,11 @@ from unionml_tpu.models.vit import VIT_PARTITION_RULES, ViT, ViTConfig
 __all__ = [
     "Mlp", "MlpConfig",
     "ViT", "ViTConfig", "VIT_PARTITION_RULES",
-    "BertEncoder", "BertClassifier", "BertMlm", "BertConfig", "BERT_PARTITION_RULES", "make_mlm_batch",
+    "BertEncoder", "BertClassifier", "BertMlm", "BertConfig",
+    "BERT_PARTITION_RULES", "make_mlm_batch", "mlm_step",
     "Llama", "LlamaConfig", "init_cache", "LLAMA_PARTITION_RULES",
+    "EncoderDecoder", "EncDecConfig", "ENCDEC_PARTITION_RULES",
+    "init_decoder_cache", "make_seq2seq_generator", "seq2seq_step",
     "LLAMA_QUANT_PARTITION_RULES", "LLAMA_MOE_PARTITION_RULES",
     "TrainState", "create_train_state", "classification_step", "lm_step",
     "make_evaluator", "make_predictor",

@@ -152,6 +152,45 @@ class BertMlm(nn.Module):
         return nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="mlm_head")(x)
 
 
+def mlm_step(module, *, ignore_id: int = -100, accumulate_steps: int = 1):
+    """Masked-LM training step over padded corpora.
+
+    ``batch = (inputs, labels, attention_mask)``: unlike the bare
+    ``lm_step(BertMlm(cfg))`` composition (fine for fixed-length
+    batches), this passes the padding mask through to the encoder so
+    real tokens never attend pad positions. ``accumulate_steps > 1``
+    adds gradient accumulation over a leading microbatch axis.
+    """
+    import jax
+
+    from unionml_tpu.models.train import (
+        accumulated_value_and_grad,
+        masked_cross_entropy,
+    )
+
+    def loss_fn(params, microbatch):
+        inputs, labels, attention_mask = microbatch
+        logits = module.apply(
+            {"params": params}, inputs, attention_mask=attention_mask
+        )
+        loss = masked_cross_entropy(logits, labels, ignore_id=ignore_id)
+        return loss, {"z": jnp.float32(0.0)}
+
+    def step(state, batch):
+        if accumulate_steps > 1:
+            (loss, _), grads = accumulated_value_and_grad(
+                loss_fn, state.params, batch
+            )
+        else:
+            (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, batch
+            )
+        state = state.apply_gradients(grads=grads)
+        return state, {"loss": loss, "perplexity": jnp.exp(loss)}
+
+    return step
+
+
 def make_mlm_batch(
     tokens,
     *,

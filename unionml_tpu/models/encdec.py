@@ -1,0 +1,307 @@
+"""Encoder-decoder transformer — the seq2seq family of the model zoo.
+
+No reference counterpart (the reference delegates modeling to user
+code); this rounds the zoo out beyond encoders (BERT) and decoders
+(Llama) so translation/summarization-style apps get the same
+three-line-step treatment (SURVEY.md §2.4 model-zoo addition).
+
+TPU-first choices, consistent with the rest of the zoo:
+
+- pre-LN blocks, bf16 compute with fp32 master weights and fp32
+  normalization statistics;
+- self-attention carries RoPE (no learned position tables to shard or
+  bound); cross-attention is position-free and always fully visible,
+  masked only by the source padding mask;
+- the decoder threads the same functional KV cache as Llama for its
+  SELF-attention, so generation is one jitted prefill-free scan. Cross
+  k/v are recomputed from the (loop-invariant) encoder output inside
+  the scan body — XLA hoists them out of the loop, which is why there
+  is no cross-KV cache to plumb;
+- Megatron partition rules: q/k/v/up shard output features over
+  ``tensor``, o/down shard input features — two collectives per block.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from unionml_tpu.models.layers import Attention, MlpBlock, RMSNorm
+from unionml_tpu.parallel.sharding import PartitionRule
+
+Cache = Tuple[Tuple[jnp.ndarray, jnp.ndarray], ...]
+
+
+@dataclass(frozen=True)
+class EncDecConfig:
+    vocab_size: int = 32_128
+    hidden_dim: int = 768
+    num_encoder_layers: int = 12
+    num_decoder_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 2048
+    rope_theta: float = 10_000.0
+    max_len: int = 512
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def tiny(vocab_size: int = 512, **overrides) -> "EncDecConfig":
+        kwargs = dict(
+            vocab_size=vocab_size, hidden_dim=64, num_encoder_layers=2,
+            num_decoder_layers=2, num_heads=4, mlp_dim=128, max_len=64,
+        )
+        kwargs.update(overrides)
+        return EncDecConfig(**kwargs)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_dim // self.num_heads
+
+
+class _EncoderBlock(nn.Module):
+    config: EncDecConfig
+
+    @nn.compact
+    def __call__(self, x, src_mask):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        h = RMSNorm(dtype=dtype, name="attn_norm")(x)
+        # bidirectional self-attention; padded source tokens are hidden
+        # through the cross-attention kv path (kv=h with a source mask)
+        x = x + Attention(
+            num_heads=cfg.num_heads, head_dim=cfg.head_dim, rope=False,
+            causal=False, dtype=dtype, name="attn",
+        )(h, kv=h, kv_mask=src_mask)
+        h = RMSNorm(dtype=dtype, name="mlp_norm")(x)
+        return x + MlpBlock(hidden_dim=cfg.mlp_dim, gated=True, dtype=dtype, name="mlp")(h)
+
+
+class _DecoderBlock(nn.Module):
+    config: EncDecConfig
+
+    @nn.compact
+    def __call__(self, x, enc_out, src_mask, *, positions=None, cache=None,
+                 cache_index=None):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        h = RMSNorm(dtype=dtype, name="self_norm")(x)
+        self_attn = Attention(
+            num_heads=cfg.num_heads, head_dim=cfg.head_dim, rope=True,
+            rope_theta=cfg.rope_theta, causal=True, dtype=dtype, name="self_attn",
+        )
+        if cache is not None:
+            a, new_cache = self_attn(
+                h, positions=positions, cache=cache, cache_index=cache_index
+            )
+        else:
+            a, new_cache = self_attn(h, positions=positions), None
+        x = x + a
+        h = RMSNorm(dtype=dtype, name="cross_norm")(x)
+        x = x + Attention(
+            num_heads=cfg.num_heads, head_dim=cfg.head_dim, rope=False,
+            causal=False, dtype=dtype, name="cross_attn",
+        )(h, kv=enc_out, kv_mask=src_mask)
+        h = RMSNorm(dtype=dtype, name="mlp_norm")(x)
+        x = x + MlpBlock(hidden_dim=cfg.mlp_dim, gated=True, dtype=dtype, name="mlp")(h)
+        return x, new_cache
+
+
+class EncoderDecoder(nn.Module):
+    """Seq2seq transformer with a shared source/target embedding.
+
+    Call forms:
+
+    - training: ``module.apply(vars, src_ids, tgt_ids, src_mask=...)``
+      → decoder logits [B, S_tgt, V] (teacher forcing — shift outside);
+    - encode once: ``module.apply(vars, src_ids, src_mask=...,
+      method=EncoderDecoder.encode)`` → enc_out;
+    - cached decode step: ``module.apply(vars, tgt_tok, enc_out,
+      src_mask, cache, cache_index, method=EncoderDecoder.decode)``
+      → (logits, new_cache) — the generation scan body.
+    """
+
+    config: EncDecConfig = field(default_factory=EncDecConfig)
+
+    def setup(self):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        self.embed = nn.Embed(cfg.vocab_size, cfg.hidden_dim, dtype=dtype, name="embed")
+        self.enc_blocks = [
+            _EncoderBlock(cfg, name=f"enc_{i}")
+            for i in range(cfg.num_encoder_layers)
+        ]
+        self.enc_norm = RMSNorm(dtype=dtype, name="enc_norm")
+        self.dec_blocks = [
+            _DecoderBlock(cfg, name=f"dec_{i}")
+            for i in range(cfg.num_decoder_layers)
+        ]
+        self.dec_norm = RMSNorm(dtype=dtype, name="dec_norm")
+        self.lm_head = nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="lm_head")
+
+    def encode(self, src_ids, *, src_mask=None):
+        if src_mask is None:
+            src_mask = jnp.ones(src_ids.shape, bool)
+        x = self.embed(src_ids)
+        for block in self.enc_blocks:
+            x = block(x, src_mask)
+        return self.enc_norm(x)
+
+    def decode(self, tgt_ids, enc_out, src_mask=None, cache=None, cache_index=None):
+        if src_mask is None:
+            src_mask = jnp.ones(enc_out.shape[:2], bool)
+        x = self.embed(tgt_ids)
+        new_cache = []
+        for i, block in enumerate(self.dec_blocks):
+            layer_cache = cache[i] if cache is not None else None
+            x, c = block(
+                x, enc_out, src_mask,
+                cache=layer_cache, cache_index=cache_index,
+            )
+            new_cache.append(c)
+        x = self.dec_norm(x)
+        logits = self.lm_head(x.astype(jnp.float32))
+        if cache is not None:
+            return logits, tuple(new_cache)
+        return logits
+
+    def __call__(self, src_ids, tgt_ids, *, src_mask=None):
+        enc_out = self.encode(src_ids, src_mask=src_mask)
+        return self.decode(tgt_ids, enc_out, src_mask)
+
+
+def init_decoder_cache(
+    config: EncDecConfig, batch: int, max_len: Optional[int] = None,
+    dtype=jnp.bfloat16,
+) -> Cache:
+    """Zero-filled decoder SELF-attention cache (cross needs none)."""
+    max_len = max_len or config.max_len
+    shape = (batch, max_len, config.num_heads, config.head_dim)
+    zeros = jnp.zeros(shape, dtype)
+    return tuple((zeros, zeros) for _ in range(config.num_decoder_layers))
+
+
+def make_seq2seq_generator(
+    module: EncoderDecoder,
+    *,
+    max_new_tokens: int,
+    bos_id: int = 1,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> "callable":
+    """Build ``generate(params, src_ids, key=None, src_mask=None) ->
+    tokens [B, max_new_tokens]``: encode once, then one ``lax.scan``
+    decode with the self-attention KV cache (same static-shape design
+    as the Llama generator — one executable per (batch, src_len))."""
+    from unionml_tpu.models.generate import make_sampler
+
+    cfg = module.config
+    sample = make_sampler(temperature=temperature, top_k=top_k, top_p=top_p)
+    total = max_new_tokens + 1  # bos occupies slot 0
+
+    def generate(params, src_ids, key=None, src_mask=None):
+        batch = src_ids.shape[0]
+        if key is None:
+            if temperature != 0.0:
+                raise ValueError(
+                    "temperature sampling needs an explicit PRNG key: "
+                    "generate(params, src_ids, key)"
+                )
+            key = jax.random.PRNGKey(0)
+        enc_out = module.apply(
+            {"params": params}, src_ids, src_mask=src_mask,
+            method=EncoderDecoder.encode,
+        )
+        # cache in the module's compute dtype: a bf16 cache under an fp32
+        # config would break cached-vs-uncached decode parity
+        cache = init_decoder_cache(cfg, batch, total, dtype=jnp.dtype(cfg.dtype))
+        bos = jnp.full((batch, 1), bos_id, jnp.int32)
+
+        def step(carry, key_step):
+            cache, tok, index, done = carry
+            logits, cache = module.apply(
+                {"params": params}, tok, enc_out, src_mask, cache, index,
+                method=EncoderDecoder.decode,
+            )
+            nxt = sample(logits[:, -1], key_step)
+            if eos_id is not None:
+                nxt = jnp.where(done, pad_id, nxt)
+                done = done | (nxt == eos_id)
+            return (cache, nxt[:, None], index + 1, done), nxt
+
+        keys = jax.random.split(key, max_new_tokens)
+        done0 = jnp.zeros(batch, bool)
+        (_, _, _, _), toks = jax.lax.scan(
+            step, (cache, bos, jnp.int32(0), done0), keys
+        )
+        return toks.T  # [B, max_new_tokens]
+
+    return jax.jit(generate)
+
+
+def seq2seq_step(
+    module: EncoderDecoder,
+    *,
+    ignore_id: int = -100,
+    pad_id: int = 0,
+    accumulate_steps: int = 1,
+):
+    """Teacher-forced seq2seq training step.
+
+    ``batch = (src_ids, tgt_ids)``: the decoder consumes
+    ``tgt_ids[:, :-1]`` and is supervised on ``tgt_ids[:, 1:]`` with
+    ``ignore_id`` positions (padding) masked out of the mean CE —
+    the ``(state, batch) -> (state, metrics)`` step-trainer contract.
+    Source padding: ``src_ids == pad_id`` is hidden from every attention
+    over the source (set ``pad_id`` to your tokenizer's — id 0 is only
+    the default, not an assumption).
+
+    ``accumulate_steps > 1``: gradient accumulation over a leading
+    microbatch axis, like the other zoo step factories.
+    """
+    from unionml_tpu.models.train import (
+        accumulated_value_and_grad,
+        masked_cross_entropy,
+    )
+
+    def loss_fn(params, microbatch):
+        src_ids, tgt_ids = microbatch
+        inputs, targets = tgt_ids[:, :-1], tgt_ids[:, 1:]
+        logits = module.apply(
+            {"params": params}, src_ids, inputs, src_mask=src_ids != pad_id
+        )
+        loss = masked_cross_entropy(logits, targets, ignore_id=ignore_id)
+        return loss, {"z": jnp.float32(0.0)}
+
+    def step(state, batch):
+        if accumulate_steps > 1:
+            (loss, _), grads = accumulated_value_and_grad(
+                loss_fn, state.params, batch
+            )
+        else:
+            (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, batch
+            )
+        state = state.apply_gradients(grads=grads)
+        return state, {"loss": loss, "perplexity": jnp.exp(loss)}
+
+    return step
+
+
+# Megatron-style TP over the `tensor` axis: two collectives per block
+# (one after each attention's o, one after each MLP down); the shared
+# embedding and the head shard vocab.
+ENCDEC_PARTITION_RULES = (
+    PartitionRule(r"(self_attn|cross_attn|attn)/(q|k|v)/kernel$", (None, "tensor", None)),
+    PartitionRule(r"(self_attn|cross_attn|attn)/o/kernel$", ("tensor", None, None)),
+    PartitionRule(r"mlp/(gate|up)/kernel$", (None, "tensor")),
+    PartitionRule(r"mlp/down/kernel$", ("tensor", None)),
+    PartitionRule(r"embed/embedding$", ("tensor", None)),
+    PartitionRule(r"lm_head/kernel$", (None, "tensor")),
+)

@@ -220,6 +220,7 @@ class Attention(nn.Module):
         self,
         x: jnp.ndarray,
         *,
+        kv: Optional[jnp.ndarray] = None,
         positions: Optional[jnp.ndarray] = None,
         cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
         cache_index: Optional[jnp.ndarray] = None,
@@ -227,6 +228,13 @@ class Attention(nn.Module):
     ):
         """Returns ``out`` or ``(out, new_cache)`` when a cache is given.
 
+        ``kv``: optional (batch, kv_seq, features) source for CROSS
+        attention — k/v project from it instead of ``x`` (q still from
+        ``x``). Requires ``causal=False``, ``rope=False`` and no cache:
+        the whole source is always visible, and inside a decode
+        ``lax.scan`` the loop-invariant k/v projections are hoisted by
+        XLA, so no cross-KV cache plumbing is needed. ``kv_mask`` then
+        masks padded SOURCE positions ((batch, kv_seq), False = hidden).
         ``cache``: (k, v) of shape (batch, max_len, kv_heads, head_dim);
         ``cache_index``: current fill position (decode step) — a scalar
         int shared by every row, or an int vector ``[batch]`` of per-row
@@ -243,6 +251,28 @@ class Attention(nn.Module):
             dtype=self.dtype, param_dtype=self.param_dtype, name=name,
         )
         q = dense((self.num_heads, head_dim), "q")(x)
+        if kv is not None:
+            if self.causal or self.rope or cache is not None:
+                raise ValueError(
+                    "cross attention (kv=...) is incompatible with causal "
+                    "masking, RoPE, and KV caches — the source is fully "
+                    "visible and position-free"
+                )
+            k = dense((kv_heads, head_dim), "k")(kv)
+            v = dense((kv_heads, head_dim), "v")(kv)
+            # always the XLA op: q_len != kv_len in general (the Pallas
+            # short-seq kernel assumes square score tiles), and XLA fuses
+            # the modest [S_dec, S_enc] score chain well
+            bias = (
+                jnp.where(kv_mask[:, None, None, :], 0.0, -1e30)
+                if kv_mask is not None
+                else None
+            )
+            out = xla_attention(q, k, v, bias=bias)
+            return make_dense(
+                quantized=self.quantized, features=features, axis=(-2, -1),
+                dtype=self.dtype, param_dtype=self.param_dtype, name="o",
+            )(out)
         k = dense((kv_heads, head_dim), "k")(x)
         v = dense((kv_heads, head_dim), "v")(x)
 
