@@ -154,3 +154,44 @@ def test_seq2seq_step_accumulation_and_pad_id():
     out_a = np.asarray(gen(params, src1, None, src1 != 63))
     out_b = np.asarray(gen(params, padded, None, padded != 63))
     np.testing.assert_array_equal(out_a, out_b)
+
+
+def test_seq2seq_predictor_ragged_buckets_and_warmup(tiny_encdec):
+    """Ragged sources bucket/pad transparently: per-row outputs equal
+    unpadded single-source generation; warmup counts executables; eos
+    trimming applies."""
+    from unionml_tpu.models import make_seq2seq_predictor
+
+    module, params = tiny_encdec
+
+    class S:
+        pass
+
+    s = S()
+    s.params = params
+    pred = make_seq2seq_predictor(
+        module, max_new_tokens=5, src_buckets=(8, 16)
+    )
+    sources = [[1, 2, 3], [4, 5, 6, 7, 8, 9, 10]]
+    out = pred(s, sources)
+    assert len(out) == 2 and all(len(r) == 5 for r in out)
+    for src_row, got in zip(sources, out):
+        want = _full_prefix_greedy(
+            module, params, np.asarray([src_row], np.int32), 5
+        )[0].tolist()
+        assert got == want, (got, want)
+
+    n = pred.warmup(s, max_batch=4)
+    assert n == 2 * 3  # buckets {8,16} x batches {1,2,4}
+    with pytest.raises(ValueError, match="not configured"):
+        pred.warmup(s, max_batch=1, buckets=(64,))
+    with pytest.raises(ValueError, match="empty bucket tuple"):
+        pred.warmup(s, max_batch=1, buckets=())
+
+    # eos trimming
+    first = out[0][0]
+    pred_eos = make_seq2seq_predictor(
+        module, max_new_tokens=5, src_buckets=(8,), eos_id=first
+    )
+    trimmed = pred_eos(s, [sources[0]])[0]
+    assert trimmed == [first]

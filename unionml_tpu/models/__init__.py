@@ -30,6 +30,7 @@ from unionml_tpu.models.encdec import (
     EncoderDecoder,
     init_decoder_cache,
     make_seq2seq_generator,
+    make_seq2seq_predictor,
     seq2seq_step,
 )
 from unionml_tpu.models.generate import make_generator, make_lm_predictor, serving_params
@@ -64,7 +65,7 @@ __all__ = [
     "BERT_PARTITION_RULES", "make_mlm_batch", "mlm_step",
     "Llama", "LlamaConfig", "init_cache", "LLAMA_PARTITION_RULES",
     "EncoderDecoder", "EncDecConfig", "ENCDEC_PARTITION_RULES",
-    "init_decoder_cache", "make_seq2seq_generator", "seq2seq_step",
+    "init_decoder_cache", "make_seq2seq_generator", "make_seq2seq_predictor", "seq2seq_step",
     "LLAMA_QUANT_PARTITION_RULES", "LLAMA_MOE_PARTITION_RULES",
     "TrainState", "create_train_state", "classification_step", "lm_step",
     "make_evaluator", "make_predictor",

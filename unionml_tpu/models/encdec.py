@@ -294,6 +294,97 @@ def seq2seq_step(
     return step
 
 
+def make_seq2seq_predictor(
+    module: EncoderDecoder,
+    *,
+    max_new_tokens: int = 32,
+    src_buckets: tuple = (16, 32, 64, 128),
+    bos_id: int = 1,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+    seed: int = 0,
+    **gen_kwargs,
+) -> "callable":
+    """An ``@model.predictor``-compatible fn over token-id sources.
+
+    The seq2seq counterpart of ``make_lm_predictor``: accepts a list of
+    (possibly ragged) source token-id lists, right-pads each to the
+    smallest covering source bucket and the batch to the next power of
+    two (XLA sees ``len(src_buckets) × log2(max_batch)`` executables),
+    generates through :func:`make_seq2seq_generator`, and returns one
+    token list per source — trimmed at ``eos_id`` when set. Padded
+    source positions are masked out of every attention, so a padded
+    source generates exactly what its unpadded form would (tested).
+    XLA compiles ``len(src_buckets) * (log2(max_batch) + 1)``
+    executables (batch sizes 1, 2, ..., max_batch).
+
+    ``.warmup(state, max_batch=..., buckets=...)`` pre-compiles every
+    (bucket, power-of-two batch) executable — same contract (and same
+    strict bucket validation) as ``make_lm_predictor``'s warmup.
+    """
+    import numpy as np
+
+    buckets = tuple(sorted(set(int(b) for b in src_buckets)))
+    gen = make_seq2seq_generator(
+        module, max_new_tokens=max_new_tokens, bos_id=bos_id,
+        eos_id=eos_id, pad_id=pad_id, **gen_kwargs,
+    )
+    key_state = {"key": jax.random.PRNGKey(seed)}
+    temperature = gen_kwargs.get("temperature", 0.0)
+
+    def predictor(state, sources) -> list:
+        params = state.params if hasattr(state, "params") else state
+        rows = [np.asarray(s, dtype=np.int32).ravel() for s in sources]
+        longest = max(len(r) for r in rows)
+        bucket = next((b for b in buckets if b >= longest), buckets[-1])
+        n = len(rows)
+        n_padded = 1 << (n - 1).bit_length()
+        batch = np.full((n_padded, bucket), pad_id, np.int32)
+        mask = np.zeros((n_padded, bucket), bool)
+        for i in range(n_padded):
+            r = rows[min(i, n - 1)][:bucket]      # truncate long sources
+            batch[i, : len(r)] = r
+            mask[i, : len(r)] = True
+        key_state["key"], sub = jax.random.split(key_state["key"])
+        key = sub if temperature != 0.0 else None
+        out = np.asarray(gen(params, jnp.asarray(batch), key, jnp.asarray(mask)))
+        results = []
+        for row in out[:n]:
+            toks = row.tolist()
+            if eos_id is not None and eos_id in toks:
+                toks = toks[: toks.index(eos_id) + 1]
+            results.append(toks)
+        return results
+
+    def warmup(state, *, max_batch: int = 8, buckets: Optional[tuple] = None,
+               _all=buckets) -> int:
+        if buckets is not None and not buckets:
+            # an empty tuple would silently warm nothing — same guard as
+            # the LM predictor's warmup
+            raise ValueError(
+                "warmup got an empty bucket tuple — pass buckets=None to "
+                "warm every configured bucket"
+            )
+        use = _all if buckets is None else tuple(buckets)
+        unknown = sorted(set(use) - set(_all))
+        if unknown:
+            raise ValueError(
+                f"warmup buckets {unknown} are not configured ({_all})"
+            )
+        compiled = 0
+        top = 1 << (max(1, max_batch) - 1).bit_length()
+        for b in use:
+            size = 1
+            while size <= top:
+                predictor(state, np.ones((size, b), np.int32))
+                compiled += 1
+                size *= 2
+        return compiled
+
+    predictor.warmup = warmup
+    return predictor
+
+
 # Megatron-style TP over the `tensor` axis: two collectives per block
 # (one after each attention's o, one after each MLP down); the shared
 # embedding and the head shard vocab.
