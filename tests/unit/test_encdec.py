@@ -195,3 +195,18 @@ def test_seq2seq_predictor_ragged_buckets_and_warmup(tiny_encdec):
     )
     trimmed = pred_eos(s, [sources[0]])[0]
     assert trimmed == [first]
+
+
+def test_seq2seq_predictor_rejects_oversized_source(tiny_encdec):
+    from unionml_tpu.models import make_seq2seq_predictor
+
+    module, params = tiny_encdec
+
+    class S:
+        pass
+
+    s = S()
+    s.params = params
+    pred = make_seq2seq_predictor(module, max_new_tokens=3, src_buckets=(8,))
+    with pytest.raises(ValueError, match="exceeds the largest"):
+        pred(s, [list(range(1, 12))])

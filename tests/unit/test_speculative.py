@@ -1,0 +1,127 @@
+"""Speculative decoding: the greedy acceptance rule must make the output
+token-identical to plain greedy decoding of the TARGET, for any draft —
+acceptance only changes speed, never tokens."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu.models import Llama, LlamaConfig, make_generator
+from unionml_tpu.models.speculative import make_speculative_generator
+
+
+@pytest.fixture(scope="module")
+def pair():
+    t_cfg = LlamaConfig.tiny(vocab_size=97)
+    d_cfg = LlamaConfig.tiny(vocab_size=97, hidden_dim=32, num_layers=1,
+                             num_heads=2, num_kv_heads=1, mlp_dim=64)
+    t = Llama(t_cfg)
+    d = Llama(d_cfg)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    tp = t.init(jax.random.PRNGKey(0), toks)["params"]
+    dp = d.init(jax.random.PRNGKey(1), toks)["params"]
+    return t, d, tp, dp
+
+
+def _target_greedy(target, tp, prompts, n_new):
+    gen = make_generator(target, max_new_tokens=n_new, max_len=128)
+    return np.asarray(gen(tp, jnp.asarray(prompts, jnp.int32)))
+
+
+def test_arbitrary_draft_is_token_identical(pair):
+    """An unrelated random draft (low acceptance) must not change a
+    single output token."""
+    target, draft, tp, dp = pair
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, 97, size=(3, 10))
+    spec = make_speculative_generator(
+        target, draft, max_new_tokens=12, speculate_k=3, max_len=64
+    )
+    got = np.asarray(spec(tp, dp, jnp.asarray(prompts, jnp.int32)))
+    want = _target_greedy(target, tp, prompts, 12)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_self_speculation_full_acceptance(pair):
+    """draft == target: every proposal accepted; output still identical."""
+    target, _, tp, _ = pair
+    rng = np.random.default_rng(1)
+    prompts = rng.integers(1, 97, size=(2, 6))
+    spec = make_speculative_generator(
+        target, target, max_new_tokens=10, speculate_k=4, max_len=64
+    )
+    got = np.asarray(spec(tp, tp, jnp.asarray(prompts, jnp.int32)))
+    want = _target_greedy(target, tp, prompts, 10)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("k", [1, 2, 5])
+def test_speculate_k_never_changes_tokens(pair, k):
+    target, draft, tp, dp = pair
+    rng = np.random.default_rng(2)
+    prompts = rng.integers(1, 97, size=(2, 7))
+    spec = make_speculative_generator(
+        target, draft, max_new_tokens=8, speculate_k=k, max_len=64
+    )
+    got = np.asarray(spec(tp, dp, jnp.asarray(prompts, jnp.int32)))
+    want = _target_greedy(target, tp, prompts, 8)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_eos_stops_like_plain_decoding(pair):
+    target, draft, tp, dp = pair
+    prompt = np.arange(1, 9)[None]
+    plain = _target_greedy(target, tp, prompt, 8)[0]
+    eos = int(plain[2])  # force an eos hit on the third generated token
+    gen = make_generator(target, max_new_tokens=8, max_len=128, eos_id=eos, pad_id=0)
+    want = np.asarray(gen(tp, jnp.asarray(prompt, jnp.int32)))[0]
+    spec = make_speculative_generator(
+        target, draft, max_new_tokens=8, speculate_k=3, max_len=64,
+        eos_id=eos, pad_id=0,
+    )
+    got = np.asarray(spec(tp, dp, jnp.asarray(prompt, jnp.int32)))[0]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_config_validation(pair):
+    target, draft, *_ = pair
+    other = Llama(LlamaConfig.tiny(vocab_size=64))
+    with pytest.raises(ValueError, match="vocabularies differ"):
+        make_speculative_generator(target, other, max_new_tokens=4)
+    with pytest.raises(ValueError, match="speculate_k"):
+        make_speculative_generator(target, draft, max_new_tokens=4, speculate_k=0)
+
+
+def test_full_acceptance_round_count_no_draft_cache_hole(pair):
+    """Self-speculation must keep accepting across rounds: a draft-cache
+    hole after a fully-accepted round would collapse acceptance from
+    round 2 (the regression this pins). 10 tokens at k=4 means 1 prefill
+    token + 2 rounds of 5, with 4 drafts accepted per live round."""
+    target, _, tp, _ = pair
+    rng = np.random.default_rng(3)
+    prompts = rng.integers(1, 97, size=(2, 6))
+    spec = make_speculative_generator(
+        target, target, max_new_tokens=10, speculate_k=4, max_len=64,
+        with_stats=True,
+    )
+    toks, stats = spec(tp, tp, jnp.asarray(prompts, jnp.int32))
+    rounds = np.asarray(stats["rounds"])
+    accepted = np.asarray(stats["accepted"])
+    np.testing.assert_array_equal(rounds, [2, 2])
+    np.testing.assert_array_equal(accepted, [8, 8])  # 4 per round
+    want = _target_greedy(target, tp, prompts, 10)
+    np.testing.assert_array_equal(np.asarray(toks), want)
+
+
+def test_chance_draft_low_acceptance_stats(pair):
+    target, draft, tp, dp = pair
+    rng = np.random.default_rng(4)
+    prompts = rng.integers(1, 97, size=(1, 8))
+    spec = make_speculative_generator(
+        target, draft, max_new_tokens=8, speculate_k=3, max_len=64,
+        with_stats=True,
+    )
+    toks, stats = spec(tp, dp, jnp.asarray(prompts, jnp.int32))
+    assert int(np.asarray(stats["rounds"])[0]) >= 3  # mostly rejected

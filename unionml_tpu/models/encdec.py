@@ -310,9 +310,11 @@ def make_seq2seq_predictor(
     The seq2seq counterpart of ``make_lm_predictor``: accepts a list of
     (possibly ragged) source token-id lists, right-pads each to the
     smallest covering source bucket and the batch to the next power of
-    two (XLA sees ``len(src_buckets) × log2(max_batch)`` executables),
-    generates through :func:`make_seq2seq_generator`, and returns one
-    token list per source — trimmed at ``eos_id`` when set. Padded
+    two, generates through :func:`make_seq2seq_generator`, and returns
+    one token list per source — trimmed at ``eos_id`` when set. A
+    source longer than the largest bucket raises (head-truncating a
+    seq2seq source would silently drop the tail the decoder needs —
+    configure ``src_buckets`` for your traffic instead). Padded
     source positions are masked out of every attention, so a padded
     source generates exactly what its unpadded form would (tested).
     XLA compiles ``len(src_buckets) * (log2(max_batch) + 1)``
@@ -341,8 +343,13 @@ def make_seq2seq_predictor(
         n_padded = 1 << (n - 1).bit_length()
         batch = np.full((n_padded, bucket), pad_id, np.int32)
         mask = np.zeros((n_padded, bucket), bool)
+        if longest > buckets[-1]:
+            raise ValueError(
+                f"source length {longest} exceeds the largest configured "
+                f"bucket {buckets[-1]}; add a larger bucket to src_buckets"
+            )
         for i in range(n_padded):
-            r = rows[min(i, n - 1)][:bucket]      # truncate long sources
+            r = rows[min(i, n - 1)]
             batch[i, : len(r)] = r
             mask[i, : len(r)] = True
         key_state["key"], sub = jax.random.split(key_state["key"])
