@@ -125,3 +125,37 @@ def test_chance_draft_low_acceptance_stats(pair):
     )
     toks, stats = spec(tp, dp, jnp.asarray(prompts, jnp.int32))
     assert int(np.asarray(stats["rounds"])[0]) >= 3  # mostly rejected
+
+
+def test_speculative_predictor_buckets_pads_and_trims(pair):
+    """The serving wrapper: ragged prompts right-pad into ONE bucketed
+    call (bounded executables), per-row outputs equal plain target
+    decoding, FrozenDict state accepted, warmup counts executables."""
+    from flax.core import freeze
+
+    from unionml_tpu.models.speculative import make_speculative_predictor
+
+    target, draft, tp, dp = pair
+    pred = make_speculative_predictor(
+        target, draft, max_new_tokens=6, bucket_lens=(8, 16), speculate_k=2
+    )
+    state = {"target": tp, "draft": dp}
+    prompts = [[1, 2, 3, 4], [9, 8, 7], [5, 6, 7, 8]]
+    out = pred(state, prompts)
+    for p, got in zip(prompts, out):
+        want = _target_greedy(target, tp, np.asarray([p], np.int32), 6)[0].tolist()
+        assert got == want, (p, got, want)
+
+    # frozen mappings are valid state (checkpoint-restored trees)
+    out2 = pred(freeze(state), prompts[:1])
+    assert out2[0] == out[0]
+
+    n = pred.warmup(state, max_batch=4)
+    assert n == 2 * 3  # buckets {8,16} x batches {1,2,4}
+    with pytest.raises(ValueError, match="empty bucket tuple"):
+        pred.warmup(state, buckets=())
+
+    with pytest.raises(ValueError, match="mapping"):
+        pred(tp, prompts)
+    with pytest.raises(ValueError, match="largest bucket"):
+        pred(state, [list(range(40))])

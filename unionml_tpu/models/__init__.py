@@ -34,7 +34,10 @@ from unionml_tpu.models.encdec import (
     seq2seq_step,
 )
 from unionml_tpu.models.generate import make_generator, make_lm_predictor, serving_params
-from unionml_tpu.models.speculative import make_speculative_generator
+from unionml_tpu.models.speculative import (
+    make_speculative_generator,
+    make_speculative_predictor,
+)
 from unionml_tpu.models.mlp import Mlp, MlpConfig
 from unionml_tpu.models.sequence_parallel import (
     sequence_parallel_config,
@@ -70,7 +73,7 @@ __all__ = [
     "LLAMA_QUANT_PARTITION_RULES", "LLAMA_MOE_PARTITION_RULES",
     "TrainState", "create_train_state", "classification_step", "lm_step",
     "make_evaluator", "make_predictor",
-    "make_speculative_generator",
+    "make_speculative_generator", "make_speculative_predictor",
     "make_generator", "make_lm_predictor", "serving_params", "adamw",
     "create_pipelined_lm_state", "pipelined_lm_step", "pipelined_lm_apply",
     "to_pipeline_params", "PIPELINE_PARTITION_RULES",

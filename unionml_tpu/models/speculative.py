@@ -45,6 +45,8 @@ import jax.numpy as jnp
 
 from unionml_tpu.models.llama import Llama, init_cache
 
+__all__ = ["make_speculative_generator", "make_speculative_predictor"]
+
 
 def make_speculative_generator(
     target: Llama,
@@ -73,6 +75,14 @@ def make_speculative_generator(
     "accepted": [..]})`` per batch row — rounds taken and total draft
     tokens accepted (the acceptance-rate observability the equality
     tests can't see).
+
+    ``generate`` also takes an optional ``true_lens`` int vector [B] for
+    RIGHT-padded prompt batches (the serving-bucket form): each row's
+    caches fill only to its true length, the first token reads that
+    row's last REAL position, and the pad-garbage cache rows sit above
+    the fill where visibility (``kv_pos <= q_pos``) cannot reach them
+    before a later round overwrites them (fill advances ≤ k+1 per round
+    while rounds write ``fill..fill+k`` — no row can be skipped).
     """
     t_cfg, d_cfg = target.config, draft.config
     if t_cfg.vocab_size != d_cfg.vocab_size:
@@ -84,7 +94,9 @@ def make_speculative_generator(
     if k < 1:
         raise ValueError(f"speculate_k must be >= 1, got {k}")
 
-    def generate(target_params, draft_params, tokens: jnp.ndarray) -> jnp.ndarray:
+    def generate(
+        target_params, draft_params, tokens: jnp.ndarray, true_lens=None
+    ) -> jnp.ndarray:
         batch, prompt_len = tokens.shape
         # + k + 1 slack: a round writes up to k+1 rows past a row's fill
         # before acceptance truncates it
@@ -104,11 +116,20 @@ def make_speculative_generator(
             {"params": draft_params}, tokens, cache=d_cache,
             cache_index=jnp.int32(0),
         )
-        first = jnp.argmax(t_logits[:, -1], -1).astype(jnp.int32)  # [B]
+        if true_lens is None:
+            true_lens = jnp.full((batch,), prompt_len, jnp.int32)
+        else:
+            true_lens = jnp.asarray(true_lens, jnp.int32)
+        # each row's first token reads its last REAL position (causal
+        # prefill: positions < true_len never attend the right-padding)
+        last_logits = jnp.take_along_axis(
+            t_logits, (true_lens - 1)[:, None, None], axis=1
+        )[:, 0]
+        first = jnp.argmax(last_logits, -1).astype(jnp.int32)  # [B]
 
         out = jnp.full((batch, max_new_tokens + k + 1), pad_id, jnp.int32)
         out = out.at[:, 0].set(first)
-        fill0 = jnp.full((batch,), prompt_len, jnp.int32)
+        fill0 = true_lens
         done0 = jnp.full((batch,), max_new_tokens <= 1)
         if eos_id is not None:
             done0 = done0 | (first == eos_id)
@@ -199,3 +220,112 @@ def make_speculative_generator(
         return toks
 
     return jax.jit(generate)
+
+
+def make_speculative_predictor(
+    target: Llama,
+    draft: Llama,
+    *,
+    max_new_tokens: int = 32,
+    bucket_lens: tuple = (16, 32, 64, 128),
+    speculate_k: int = 4,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+) -> Callable:
+    """An ``@model.predictor``-compatible fn with speculative decoding.
+
+    The serving-side wrapper, matching ``make_lm_predictor``'s shape
+    discipline: ragged token-id prompts are RIGHT-padded to the smallest
+    covering prompt bucket and the batch to the next power of two, so
+    XLA compiles a bounded executable set — one generator call per
+    request, per-row ``true_lens`` keeping padded rows exact (the
+    generator's vector-fill prefill). ``state`` must carry the paired
+    trees as a mapping ``{"target": ..., "draft": ...}`` (plain dict or
+    ``flax.core.FrozenDict``; or an object with ``.params`` holding it)
+    — the artifact a speculative serving app saves. Output trims at
+    ``eos_id`` when set.
+
+    ``.warmup(state, max_batch=...)`` pre-compiles every (bucket,
+    power-of-two batch) executable, like the LM predictor's.
+    """
+    from collections.abc import Mapping
+
+    import numpy as np
+
+    buckets = tuple(sorted(set(int(b) for b in bucket_lens)))
+    gens = {
+        b: make_speculative_generator(
+            target, draft, max_new_tokens=max_new_tokens, speculate_k=speculate_k,
+            max_len=b + max_new_tokens, eos_id=eos_id, pad_id=pad_id,
+        )
+        for b in buckets
+    }
+
+    def predictor(state, prompts) -> list:
+        params = state.params if hasattr(state, "params") else state
+        if (
+            not isinstance(params, Mapping)
+            or "target" not in params
+            or "draft" not in params
+        ):
+            raise ValueError(
+                'speculative predictor state must be a mapping '
+                '{"target": params, "draft": params}'
+            )
+        rows = [np.asarray(p, dtype=np.int32).ravel() for p in prompts]
+        if any(len(r) == 0 for r in rows):
+            raise ValueError("empty prompt")
+        longest = max(len(r) for r in rows)
+        bucket = next((b for b in buckets if b >= longest), None)
+        if bucket is None:
+            raise ValueError(
+                f"prompt length {longest} exceeds the largest bucket "
+                f"{buckets[-1]}; add a larger bucket to bucket_lens"
+            )
+        n = len(rows)
+        n_padded = 1 << (n - 1).bit_length()
+        batch = np.full((n_padded, bucket), pad_id, np.int32)
+        true_lens = np.ones((n_padded,), np.int32)
+        for i in range(n_padded):
+            r = rows[min(i, n - 1)]               # pad rows replicate last
+            batch[i, : len(r)] = r
+            true_lens[i] = len(r)
+        out = np.asarray(
+            gens[bucket](
+                params["target"], params["draft"], jnp.asarray(batch),
+                jnp.asarray(true_lens),
+            )
+        )
+        results = []
+        for row in out[:n]:
+            toks = row.tolist()
+            if eos_id is not None and eos_id in toks:
+                toks = toks[: toks.index(eos_id) + 1]
+            results.append(toks)
+        return results
+
+    def warmup(state, *, max_batch: int = 8, buckets: Optional[tuple] = None,
+               _all=buckets) -> int:
+        if buckets is not None and not buckets:
+            raise ValueError(
+                "warmup got an empty bucket tuple — pass buckets=None to "
+                "warm every configured bucket"
+            )
+        use = _all if buckets is None else tuple(buckets)
+        unknown = sorted(set(use) - set(_all))
+        if unknown:
+            raise ValueError(
+                f"warmup buckets {unknown} are not configured ({_all})"
+            )
+        compiled = 0
+        top = 1 << (max(1, max_batch) - 1).bit_length()
+        for b in use:
+            size = 1
+            while size <= top:
+                predictor(state, np.ones((size, b), np.int32))
+                compiled += 1
+                size *= 2
+        return compiled
+
+    predictor.warmup = warmup
+    return predictor
