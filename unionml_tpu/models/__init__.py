@@ -17,6 +17,7 @@ from unionml_tpu.models.bert import (
     mlm_step,
 )
 from unionml_tpu.models.llama import (
+    LLAMA_LORA_PARTITION_RULES,
     LLAMA_MOE_PARTITION_RULES,
     LLAMA_PARTITION_RULES,
     LLAMA_QUANT_PARTITION_RULES,
@@ -34,6 +35,15 @@ from unionml_tpu.models.encdec import (
     seq2seq_step,
 )
 from unionml_tpu.models.generate import make_generator, make_lm_predictor, serving_params
+from unionml_tpu.models.lora import (
+    LORA_PARTITION_RULES,
+    LoRADenseGeneral,
+    LoRATrainState,
+    create_lora_train_state,
+    merge_lora,
+    merge_param_trees,
+    split_lora_params,
+)
 from unionml_tpu.models.speculative import (
     make_speculative_generator,
     make_speculative_predictor,
@@ -71,6 +81,9 @@ __all__ = [
     "EncoderDecoder", "EncDecConfig", "ENCDEC_PARTITION_RULES",
     "init_decoder_cache", "make_seq2seq_generator", "make_seq2seq_predictor", "seq2seq_step",
     "LLAMA_QUANT_PARTITION_RULES", "LLAMA_MOE_PARTITION_RULES",
+    "LLAMA_LORA_PARTITION_RULES", "LORA_PARTITION_RULES",
+    "LoRADenseGeneral", "LoRATrainState", "create_lora_train_state",
+    "merge_lora", "merge_param_trees", "split_lora_params",
     "TrainState", "create_train_state", "classification_step", "lm_step",
     "make_evaluator", "make_predictor",
     "make_speculative_generator", "make_speculative_predictor",

@@ -164,6 +164,7 @@ def mlm_step(module, *, ignore_id: int = -100, accumulate_steps: int = 1):
     import jax
 
     from unionml_tpu.models.train import (
+        _bind_frozen,
         accumulated_value_and_grad,
         masked_cross_entropy,
     )
@@ -177,12 +178,13 @@ def mlm_step(module, *, ignore_id: int = -100, accumulate_steps: int = 1):
         return loss, {"z": jnp.float32(0.0)}
 
     def step(state, batch):
+        bound = _bind_frozen(loss_fn, state)
         if accumulate_steps > 1:
             (loss, _), grads = accumulated_value_and_grad(
-                loss_fn, state.params, batch
+                bound, state.params, batch
             )
         else:
-            (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            (loss, _), grads = jax.value_and_grad(bound, has_aux=True)(
                 state.params, batch
             )
         state = state.apply_gradients(grads=grads)

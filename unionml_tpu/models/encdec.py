@@ -266,6 +266,7 @@ def seq2seq_step(
     microbatch axis, like the other zoo step factories.
     """
     from unionml_tpu.models.train import (
+        _bind_frozen,
         accumulated_value_and_grad,
         masked_cross_entropy,
     )
@@ -280,12 +281,13 @@ def seq2seq_step(
         return loss, {"z": jnp.float32(0.0)}
 
     def step(state, batch):
+        bound = _bind_frozen(loss_fn, state)
         if accumulate_steps > 1:
             (loss, _), grads = accumulated_value_and_grad(
-                loss_fn, state.params, batch
+                bound, state.params, batch
             )
         else:
-            (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            (loss, _), grads = jax.value_and_grad(bound, has_aux=True)(
                 state.params, batch
             )
         state = state.apply_gradients(grads=grads)
@@ -331,11 +333,13 @@ def make_seq2seq_predictor(
         module, max_new_tokens=max_new_tokens, bos_id=bos_id,
         eos_id=eos_id, pad_id=pad_id, **gen_kwargs,
     )
+    from unionml_tpu.models.train import resolve_params
+
     key_state = {"key": jax.random.PRNGKey(seed)}
     temperature = gen_kwargs.get("temperature", 0.0)
 
     def predictor(state, sources) -> list:
-        params = state.params if hasattr(state, "params") else state
+        params = resolve_params(state)
         rows = [np.asarray(s, dtype=np.int32).ravel() for s in sources]
         longest = max(len(r) for r in rows)
         bucket = next((b for b in buckets if b >= longest), buckets[-1])

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from unionml_tpu.models.llama import Llama, LlamaConfig, init_cache
+from unionml_tpu.models.train import resolve_params
 
 
 def make_sampler(
@@ -225,7 +226,7 @@ def make_lm_predictor(
     key_state = {"key": jax.random.PRNGKey(seed)}
 
     def predictor(state, prompts) -> list:
-        params = state.params if hasattr(state, "params") else state
+        params = resolve_params(state)
         if isinstance(prompts, (list, tuple)):
             rows = [np.asarray(p, dtype=np.int32).ravel() for p in prompts]
         else:

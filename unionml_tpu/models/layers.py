@@ -43,10 +43,25 @@ def make_dense(
     axis=-1,
     param_dtype: Dtype = jnp.float32,
     use_bias: bool = False,
+    lora_rank: int = 0,
+    lora_alpha: float = 16.0,
 ):
     """Dense-projection factory shared by every matmul site that supports
     the int8 weight-only serving path (Attention qkv/o, gated MLP,
-    lm_head): one place to extend quantized-layer construction."""
+    lm_head): one place to extend quantized-layer construction.
+
+    ``lora_rank > 0`` swaps in :class:`~unionml_tpu.models.lora.
+    LoRADenseGeneral` — same base parameter paths (fp ``kernel`` or int8
+    ``kernel_q``+``scale``) plus trainable ``lora_a``/``lora_b`` adapters
+    (QLoRA when combined with ``quantized=True``)."""
+    if lora_rank > 0:
+        from unionml_tpu.models.lora import LoRADenseGeneral
+
+        return LoRADenseGeneral(
+            features=features, axis=axis, lora_rank=lora_rank,
+            lora_alpha=lora_alpha, quantized=quantized, use_bias=use_bias,
+            dtype=dtype, param_dtype=param_dtype, name=name,
+        )
     if quantized:
         from unionml_tpu.models.quantization import QuantizedDenseGeneral
 
@@ -212,6 +227,8 @@ class Attention(nn.Module):
     attn_impl: str = "xla"
     sequence_axis: Optional[str] = None
     quantized: bool = False  # int8 weight-only projections (serving)
+    lora_rank: int = 0  # >0: trainable low-rank adapters on q/k/v/o
+    lora_alpha: float = 16.0
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
 
@@ -249,6 +266,7 @@ class Attention(nn.Module):
         dense = lambda feats, name: make_dense(  # noqa: E731
             quantized=self.quantized, features=feats, axis=-1,
             dtype=self.dtype, param_dtype=self.param_dtype, name=name,
+            lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
         )
         q = dense((self.num_heads, head_dim), "q")(x)
         if kv is not None:
@@ -272,6 +290,7 @@ class Attention(nn.Module):
             return make_dense(
                 quantized=self.quantized, features=features, axis=(-2, -1),
                 dtype=self.dtype, param_dtype=self.param_dtype, name="o",
+                lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
             )(out)
         k = dense((kv_heads, head_dim), "k")(x)
         v = dense((kv_heads, head_dim), "v")(x)
@@ -334,6 +353,7 @@ class Attention(nn.Module):
         out = make_dense(
             quantized=self.quantized, features=features, axis=(-2, -1),
             dtype=self.dtype, param_dtype=self.param_dtype, name="o",
+            lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
         )(out)
         if cache is not None:
             return out, new_cache
@@ -346,6 +366,8 @@ class MlpBlock(nn.Module):
     hidden_dim: int
     gated: bool = False  # True → SwiGLU
     quantized: bool = False  # int8 weight-only (bias-free gated form only)
+    lora_rank: int = 0  # >0: trainable low-rank adapters on gate/up/down
+    lora_alpha: float = 16.0
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
 
@@ -357,6 +379,7 @@ class MlpBlock(nn.Module):
         dense = lambda feats, name: make_dense(  # noqa: E731
             quantized=self.quantized, features=feats, dtype=self.dtype,
             param_dtype=self.param_dtype, use_bias=not self.gated, name=name,
+            lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
         )
         if self.gated:
             gate = nn.silu(dense(self.hidden_dim, "gate")(x))

@@ -52,6 +52,13 @@ class LlamaConfig:
     # dispatch collectives (see ops/moe.py for the explicit all_to_all op).
     num_experts: int = 0
     num_selected: int = 2
+    # LoRA fine-tuning: rank-r adapters on attention q/k/v/o and dense-MLP
+    # gate/up/down (models/lora.py). With quantized=True this is the QLoRA
+    # configuration: int8 frozen base + bf16-computed fp32 adapters — the
+    # single-chip 8B fine-tune path. MoE expert weights are NOT adapted
+    # (MoEMlp has no lora path; attention adapters still apply).
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
     dtype: str = "bfloat16"
 
     def __post_init__(self):
@@ -106,6 +113,8 @@ class LlamaBlock(nn.Module):
             attn_impl=cfg.attn_impl,
             sequence_axis=cfg.sequence_axis,
             quantized=cfg.quantized,
+            lora_rank=cfg.lora_rank,
+            lora_alpha=cfg.lora_alpha,
             dtype=dtype,
             name="attn",
         )
@@ -140,6 +149,7 @@ class LlamaBlock(nn.Module):
         else:
             x = x + MlpBlock(
                 hidden_dim=cfg.mlp_dim, gated=True, quantized=cfg.quantized,
+                lora_rank=cfg.lora_rank, lora_alpha=cfg.lora_alpha,
                 dtype=dtype, name="mlp",
             )(h)
         return x, new_cache
@@ -231,6 +241,13 @@ LLAMA_QUANT_PARTITION_RULES = LLAMA_PARTITION_RULES + (
     PartitionRule(r"lm_head/kernel_q$", (None, "tensor")),
     PartitionRule(r"lm_head/scale$", ("tensor",)),
 )
+
+# LoRA fine-tune configs (lora_rank > 0): adapter factors follow their
+# base kernel's Megatron layout (rules in models/lora.py); the union
+# covers fp and QLoRA (int8 base) alike.
+from unionml_tpu.models.lora import LORA_PARTITION_RULES  # noqa: E402
+
+LLAMA_LORA_PARTITION_RULES = LORA_PARTITION_RULES + LLAMA_QUANT_PARTITION_RULES
 
 # MoE configs (num_experts > 0): expert weights [E, d, h] shard E over the
 # `expert` mesh axis (GSPMD turns the one-hot dispatch einsums into

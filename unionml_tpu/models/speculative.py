@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from unionml_tpu.models.llama import Llama, init_cache
+from unionml_tpu.models.train import resolve_params
 
 __all__ = ["make_speculative_generator", "make_speculative_predictor"]
 
@@ -262,7 +263,7 @@ def make_speculative_predictor(
     }
 
     def predictor(state, prompts) -> list:
-        params = state.params if hasattr(state, "params") else state
+        params = resolve_params(state)
         if (
             not isinstance(params, Mapping)
             or "target" not in params

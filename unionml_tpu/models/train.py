@@ -70,6 +70,33 @@ def _accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
 
 
+def resolve_params(state: Any) -> Any:
+    """The full apply-tree behind a state-or-params argument.
+
+    Serving/eval surfaces accept either a bare param tree or any
+    TrainState; a :class:`~unionml_tpu.models.lora.LoRATrainState` holds
+    only the adapters in ``.params``, so its ``full_params()`` (frozen
+    base + adapters) is what ``module.apply`` needs.
+    """
+    if hasattr(state, "full_params"):
+        return state.full_params()
+    return state.params if hasattr(state, "params") else state
+
+
+def _bind_frozen(loss_fn: Callable, state: Any) -> Callable:
+    """Adapt a loss over FULL params to a state that differentiates a
+    subset: for :class:`~unionml_tpu.models.lora.LoRATrainState` the
+    trainable tree (``state.params``, lora adapters) is merged over the
+    frozen base inside the loss, so ``value_and_grad`` touches only the
+    adapters and the optimizer state stays adapter-sized."""
+    frozen = getattr(state, "frozen_params", None)
+    if frozen is None:
+        return loss_fn
+    from unionml_tpu.models.lora import merge_param_trees
+
+    return lambda params, batch: loss_fn(merge_param_trees(frozen, params), batch)
+
+
 def accumulated_value_and_grad(
     loss_fn: Callable, params: Any, batch: Any
 ) -> Tuple[Tuple[jnp.ndarray, Any], Any]:
@@ -149,12 +176,13 @@ def classification_step(module: nn.Module, *, accumulate_steps: int = 1) -> Call
         return loss, {"accuracy": _accuracy(logits, labels)}
 
     def step(state: TrainState, batch: Tuple[Any, Any]):
+        bound = _bind_frozen(loss_fn, state)
         if accumulate_steps > 1:
             (loss, aux), grads = accumulated_value_and_grad(
-                loss_fn, state.params, batch
+                bound, state.params, batch
             )
         else:
-            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            (loss, aux), grads = jax.value_and_grad(bound, has_aux=True)(
                 state.params, batch
             )
         state = state.apply_gradients(grads=grads)
@@ -206,12 +234,13 @@ def lm_step(
         return ce_loss + aux_loss_weight * aux, {"ce": ce_loss, "aux": aux}
 
     def step(state: TrainState, batch):
+        bound = _bind_frozen(loss_fn, state)
         if accumulate_steps > 1:
             (_, aux), grads = accumulated_value_and_grad(
-                loss_fn, state.params, batch
+                bound, state.params, batch
             )
         else:
-            (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            (_, aux), grads = jax.value_and_grad(bound, has_aux=True)(
                 state.params, batch
             )
         state = state.apply_gradients(grads=grads)
@@ -230,8 +259,7 @@ def make_evaluator(module: nn.Module) -> Callable:
         return _accuracy(logits, labels)
 
     def evaluator(state: Any, features: Any, labels: Any) -> float:
-        params = state.params if hasattr(state, "params") else state
-        return float(_acc(params, jnp.asarray(features), jnp.asarray(labels)))
+        return float(_acc(resolve_params(state), jnp.asarray(features), jnp.asarray(labels)))
 
     return evaluator
 
@@ -244,7 +272,6 @@ def make_predictor(module: nn.Module) -> Callable:
         return jnp.argmax(module.apply({"params": params}, features), axis=-1)
 
     def predictor(state: Any, features: Any) -> Any:
-        params = state.params if hasattr(state, "params") else state
-        return _predict(params, jnp.asarray(features))
+        return _predict(resolve_params(state), jnp.asarray(features))
 
     return predictor
