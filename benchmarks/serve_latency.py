@@ -207,5 +207,109 @@ def main() -> None:
             }))
 
 
+def kv_cache_legs() -> None:
+    """Long-context decode: bf16 vs int8 KV cache
+    (``UNIONML_TPU_BENCH_KV=1``, composes with the preset env var).
+
+    Decode streams weights AND the filled cache every step; at serving's
+    short prompts the cache is noise next to the weights, but at long
+    prompts it rivals them (1.5B int8 weights ~1.5 GB vs ~0.75 GB bf16
+    cache at batch 8 x 1152 ctx). ``kv_quant`` halves the cache bytes —
+    both the per-step HBM traffic share and the resident footprint that
+    caps engine slot counts.
+    """
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu.models import LlamaConfig, Llama, make_generator
+
+    backend = jax.default_backend()
+    preset = os.environ.get(
+        "UNIONML_TPU_BENCH_PRESET", "tiny" if backend == "cpu" else "serve_1p5b"
+    )
+    cfg = serving_config(preset)
+    trials = 3 if preset == "tiny" else 20
+    if preset == "tiny":
+        prompt_len, new_tokens, batch = 16, 4, 2
+    elif preset == "serve_8b":
+        # the capability-unlock config: 8B x 8k context x batch 8. The
+        # bf16 cache alone is 32L x 2 x 8 x 8192 x 8 x 128 x 2B = 8.6 GB
+        # — plus the 8.6 GB int8 weights it EXCEEDS one v5e's HBM (the
+        # bf16 leg is expected to OOM and is reported as such); the int8
+        # cache (4.4 GB) fits with ~3 GB to spare.
+        prompt_len, new_tokens, batch, trials = 8064, 128, 8, 5
+    else:
+        prompt_len, new_tokens, batch = 1024, 128, 8
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(1, cfg.vocab_size, size=(batch, prompt_len)), jnp.int32
+    )
+    base = LlamaConfig(**{**cfg.__dict__, "quantized": True})
+    # params are identical for both legs (kv_quant changes only the cache)
+    # — build ONE tree; a per-leg copy would transiently double-hold the
+    # weights (17 GB at the 8B preset on a 16 GB chip)
+    qparams = random_quantized_params(Llama(base))
+    for kv_quant in (False, True):
+        qcfg = LlamaConfig(**{**base.__dict__, "kv_quant": kv_quant})
+        qmodule = Llama(qcfg)
+        generate = make_generator(
+            qmodule, max_new_tokens=new_tokens,
+            max_len=prompt_len + new_tokens,
+            # 8k prefill needs both long-context knobs: chunked prefill
+            # bounds the [B, H, chunk, total] score buffer (~1 GB at 128)
+            # and the last-position-only head avoids [B, S, vocab] logits
+            prefill_chunk=128 if prompt_len >= 4096 else None,
+        )
+        cache_mb = (
+            cfg.num_layers * 2 * batch * (prompt_len + new_tokens)
+            * cfg.num_kv_heads * cfg.head_dim
+            * ((1 + 4 / cfg.head_dim) if kv_quant else 2) / 1e6
+        )
+        metric = f"{preset}_longctx_kv_{'int8' if kv_quant else 'bf16'}_p50_ms"
+        try:
+            _ = np.asarray(generate(qparams, prompt))  # compile
+        except jax.errors.JaxRuntimeError as e:
+            # only genuine memory exhaustion is the expected "bf16 cache
+            # doesn't fit" datapoint; anything else is a regression and
+            # must fail the run, not masquerade as the OOM result
+            if not any(
+                marker in str(e)
+                for marker in ("Ran out of memory", "RESOURCE_EXHAUSTED",
+                               "Exceeded hbm capacity")
+            ):
+                raise
+            print(json.dumps({
+                "metric": metric,
+                "batch": batch, "prompt_len": prompt_len,
+                "new_tokens": new_tokens, "cache_mb": round(cache_mb, 1),
+                "value": None, "oom": True,
+                "error": f"{type(e).__name__}: {str(e)[:160]}",
+                "unit": "ms",
+            }))
+            continue
+        lat = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            _ = np.asarray(generate(qparams, prompt))
+            lat.append((time.perf_counter() - t0) * 1e3)
+        lat.sort()
+        p50 = lat[len(lat) // 2]
+        print(json.dumps({
+            "metric": metric,
+            "batch": batch, "prompt_len": prompt_len, "new_tokens": new_tokens,
+            "cache_mb": round(cache_mb, 1),
+            "value": round(p50, 1),
+            "tokens_per_sec": round(batch * new_tokens / (p50 / 1e3), 1),
+            "unit": "ms",
+        }))
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("UNIONML_TPU_BENCH_KV"):
+        kv_cache_legs()
+    else:
+        main()

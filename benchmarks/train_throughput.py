@@ -100,6 +100,49 @@ def main() -> None:
         "unit": "tokens/sec/chip",
     }))
 
+    # -- QLoRA fine-tune (UNIONML_TPU_BENCH_PRESET=qlora_8b) ------------ #
+    # The serving flagship run in reverse: fine-tune Llama-3-8B on ONE
+    # chip. Full fine-tuning cannot fit (bf16 params + fp32 master + adam
+    # m/v ~ 96 GB); QLoRA does: the int8 base (~8.6 GB, the same tree the
+    # serving path streams) is frozen, and only rank-16 adapters (~42M
+    # params, ~0.5 GB with adam state) train. Per-block remat keeps
+    # activations at one block.
+    if os.environ.get("UNIONML_TPU_BENCH_PRESET") == "qlora_8b" or tiny:
+        from benchmarks.serve_latency import random_quantized_params
+
+        from unionml_tpu.models import create_lora_train_state
+
+        if tiny:
+            qcfg = LlamaConfig.tiny(vocab_size=256, quantized=True)
+            batch, seq, rank = 2, 32, 4
+        else:
+            qcfg = LlamaConfig(
+                quantized=True, remat=True, attn_impl="flash", max_len=2048
+            )
+            batch, seq, rank = 1, 1024, 16
+        base = random_quantized_params(Llama(qcfg))
+        import dataclasses
+
+        lcfg = dataclasses.replace(qcfg, lora_rank=rank)
+        lora_llama = Llama(lcfg)
+        state = create_lora_train_state(
+            lora_llama, jnp.zeros((1, 8), jnp.int32), base_params=base,
+            learning_rate=1e-4,
+        )
+        del base  # the state holds the only reference now
+        tokens = jnp.asarray(
+            rng.integers(0, qcfg.vocab_size, size=(batch, seq)), jnp.int32
+        )
+        step = jax.jit(lm_step(lora_llama), donate_argnums=0)
+        n_steps = steps if tiny else 30  # ~0.5 s/step at 8B: 30 suffice
+        dt = _time_steps(step, state, tokens, n_steps, warmup if tiny else 5)
+        print(json.dumps({
+            "metric": "qlora_8b_train_tokens_per_sec_per_chip",
+            "batch": batch, "seq": seq, "lora_rank": rank,
+            "value": round(batch * (seq - 1) * n_steps / dt, 1),
+            "unit": "tokens/sec/chip",
+        }))
+
     # -- long-context scaling (UNIONML_TPU_BENCH_LC_SCALE=1) ------------ #
     # tokens/sec vs sequence length at a constant 8192-token batch:
     # flash attention keeps memory linear in seq; per-block remat trades

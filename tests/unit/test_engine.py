@@ -271,3 +271,24 @@ def test_engine_under_tensor_parallel_sharding(tiny_llama):
             assert out == _solo(module, params, prompt, 6)
     finally:
         engine.close()
+
+
+def test_engine_with_kv_quant_cache(tiny_llama):
+    """The engine on the int8 KV cache (kv_quant=True): joins splice int8
+    rows + scale planes, and every request still matches ITS solo run on
+    the same quantized-cache path."""
+    import dataclasses
+
+    module, params = tiny_llama
+    qmodule = Llama(dataclasses.replace(module.config, kv_quant=True))
+    engine = DecodeEngine(
+        qmodule, slots=4, max_new_tokens=8, prompt_buckets=(8, 16), chunk_steps=4
+    )
+    try:
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(1, 97, size=n).tolist() for n in (5, 8, 11, 16)]
+        outs = engine.generate(params, prompts)
+        for prompt, out in zip(prompts, outs):
+            assert out == _solo(qmodule, params, prompt, 8)
+    finally:
+        engine.close()

@@ -159,3 +159,24 @@ def test_speculative_predictor_buckets_pads_and_trims(pair):
         pred(tp, prompts)
     with pytest.raises(ValueError, match="largest bucket"):
         pred(state, [list(range(40))])
+
+
+def test_speculative_with_kv_quant_cache(pair):
+    """Speculation on int8 KV caches (kv_quant=True target AND draft):
+    still token-identical to plain greedy decoding of the quantized-cache
+    target — per-position quantization is write-order independent, so the
+    multi-token verify forward writes the same int8 rows a one-token
+    decode would."""
+    import dataclasses
+
+    target, draft, tp, dp = pair
+    q_target = Llama(dataclasses.replace(target.config, kv_quant=True))
+    q_draft = Llama(dataclasses.replace(draft.config, kv_quant=True))
+    rng = np.random.default_rng(5)
+    prompts = rng.integers(1, 97, size=(2, 10))
+    spec = make_speculative_generator(
+        q_target, q_draft, max_new_tokens=10, speculate_k=3, max_len=64
+    )
+    got = np.asarray(spec(tp, dp, jnp.asarray(prompts, jnp.int32)))
+    want = _target_greedy(q_target, tp, prompts, 10)
+    np.testing.assert_array_equal(got, want)

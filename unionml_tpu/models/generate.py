@@ -94,6 +94,7 @@ def make_generator(
     top_p: Optional[float] = None,
     eos_id: Optional[int] = None,
     pad_id: int = 0,
+    prefill_chunk: Optional[int] = None,
 ) -> Callable:
     """Build ``generate(params, tokens, key) -> tokens[B, max_new_tokens]``.
 
@@ -145,10 +146,46 @@ def make_generator(
         )
 
         cache = init_cache(cfg, batch, total_len)
-        # prefill: one pass over the whole (padded) prompt
+        # prefill. The head runs on the LAST position only (prompts are
+        # left-padded, so the last slot is the last real token): a
+        # full-sequence head materializes [B, S, vocab] fp32 — 33 GB at
+        # 8B x batch 8 x 8k. ``prefill_chunk`` additionally bounds the
+        # cached-attention score buffer ([B, H, chunk, total] fp32
+        # instead of [B, H, S, total]) — the knob that makes 8k-context
+        # prefill fit at all (BASELINE.md round 3). The chunk loop is a
+        # lax.scan (ONE compiled chunk body), not a Python unroll — 63
+        # unrolled 8B chunk applies took the remote compiler >20 min.
+        step_size = prefill_chunk or prompt_len
+        n_chunks = max(0, (prompt_len - 1) // step_size)  # before the tail
+        tail_start = n_chunks * step_size
+        if n_chunks > 0:
+            lead = tokens[:, :tail_start].reshape(batch, n_chunks, step_size)
+            lead_pos = positions[:, :tail_start].reshape(
+                batch, n_chunks, step_size
+            )
+            starts = jnp.arange(n_chunks, dtype=jnp.int32) * step_size
+
+            def chunk_body(carry, xs):
+                toks_c, pos_c, start = xs
+                # logit_index=0: the head output is unused and DCE'd; the
+                # chunk exists only to fill its cache rows
+                _, carry = module.apply(
+                    {"params": params}, toks_c, positions=pos_c,
+                    cache=carry, cache_index=start, kv_mask=kv_mask,
+                    logit_index=jnp.zeros((batch,), jnp.int32),
+                )
+                return carry, None
+
+            cache, _ = jax.lax.scan(
+                chunk_body, cache,
+                (lead.transpose(1, 0, 2), lead_pos.transpose(1, 0, 2), starts),
+            )
+        tail_len = prompt_len - tail_start
         logits, cache = module.apply(
-            {"params": params}, tokens, positions=positions,
-            cache=cache, cache_index=jnp.int32(0), kv_mask=kv_mask,
+            {"params": params}, tokens[:, tail_start:],
+            positions=positions[:, tail_start:],
+            cache=cache, cache_index=jnp.int32(tail_start), kv_mask=kv_mask,
+            logit_index=jnp.full((batch,), tail_len - 1, jnp.int32),
         )
         key, sub = jax.random.split(key)
         first = sample(logits[:, -1], sub)

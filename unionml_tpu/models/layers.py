@@ -306,21 +306,49 @@ class Attention(nn.Module):
 
         new_cache = None
         if cache is not None:
-            ck, cv = cache
             index = jnp.asarray(cache_index)
-            if index.ndim == 1:
-                # per-row fill positions: a vmapped dynamic_update_slice
-                # lowers to one scatter — the continuous-batching decode
-                # step where each slot writes at its own depth
-                upd = lambda c, new, i: jax.lax.dynamic_update_slice(  # noqa: E731
-                    c, new, (i,) + (0,) * (c.ndim - 1)
+
+            def upd(buf, new, idx=index):
+                # scalar index: one dynamic_update_slice at [_, idx, ...];
+                # vector [batch] index: a vmapped slice-update (one scatter)
+                # — the continuous-batching decode step where each slot
+                # writes at its own depth
+                new = new.astype(buf.dtype)
+                if idx.ndim == 1:
+                    one = lambda c, n, i: jax.lax.dynamic_update_slice(  # noqa: E731
+                        c, n, (i,) + (0,) * (c.ndim - 1)
+                    )
+                    return jax.vmap(one)(buf, new, idx)
+                return jax.lax.dynamic_update_slice(
+                    buf, new, (0, idx) + (0,) * (buf.ndim - 2)
                 )
-                ck = jax.vmap(upd)(ck, k.astype(ck.dtype), index)
-                cv = jax.vmap(upd)(cv, v.astype(cv.dtype), index)
+
+            if len(cache) == 4:
+                # int8-quantized KV cache: (k_q, v_q, k_scale, v_scale),
+                # scales per (batch, position, kv_head). Halves cache HBM
+                # (the long-context serving bound) at the cost of one
+                # int8 grid rounding per written position; the dequant
+                # multiply fuses into the attention matmul reads.
+                ck, cv, ks, vs = cache
+
+                def quantize(x):
+                    x32 = x.astype(jnp.float32)
+                    s = jnp.max(jnp.abs(x32), axis=-1) / 127.0  # [B,S,H]
+                    s = jnp.maximum(s, 1e-8)
+                    q = jnp.clip(
+                        jnp.round(x32 / s[..., None]), -127, 127
+                    ).astype(jnp.int8)
+                    return q, s
+
+                k_q, k_s = quantize(k)
+                v_q, v_s = quantize(v)
+                ck, cv = upd(ck, k_q), upd(cv, v_q)
+                ks, vs = upd(ks, k_s), upd(vs, v_s)
+                new_cache = (ck, cv, ks, vs)
             else:
-                ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, index, 0, 0))
-                cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, index, 0, 0))
-            new_cache = (ck, cv)
+                ck, cv = cache
+                ck, cv = upd(ck, k), upd(cv, v)
+                new_cache = (ck, cv)
             # attend over the filled prefix only: kv slot j is visible to
             # query i iff j <= cache_index + i (covers decode seq=1 and
             # cached prefill seq>1; unwritten slots are masked out)
@@ -340,9 +368,19 @@ class Attention(nn.Module):
                     bias = jnp.where(visible, 0.0, -1e30)[:, None]
                 else:
                     bias = jnp.where(visible, 0.0, -1e30)[None, None]
-            out = xla_attention(
-                q, ck.astype(self.dtype), cv.astype(self.dtype), bias=bias
-            )
+            if len(cache) == 4:
+                from unionml_tpu.ops.attention import quantized_cache_attention
+
+                out = quantized_cache_attention(q, ck, cv, ks, vs, bias=bias)
+            else:
+                # grouped GQA path: reads the cache at kv-head width (no
+                # repeat — measured 2x decode at 1.5B) and block-scans
+                # past the VMEM limit at long context
+                from unionml_tpu.ops.attention import cached_attention
+
+                out = cached_attention(
+                    q, ck.astype(self.dtype), cv.astype(self.dtype), bias=bias
+                )
         else:
             out = _run_attention(
                 q, k, v,

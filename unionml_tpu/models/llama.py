@@ -59,6 +59,12 @@ class LlamaConfig:
     # (MoEMlp has no lora path; attention adapters still apply).
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    # int8 KV cache (generation paths): halves cache HBM — the binding
+    # constraint for long contexts and engine slot counts (an 8B 8k-ctx
+    # batch-8 bf16 cache is ~8.6 GB, rivaling the int8 weights) — with
+    # per-(position, kv_head) scales. init_cache builds the quantized
+    # layout; Attention infers it from the cache structure.
+    kv_quant: bool = False
     dtype: str = "bfloat16"
 
     def __post_init__(self):
@@ -167,11 +173,18 @@ class Llama(nn.Module):
         cache: Optional[Cache] = None,
         cache_index: Optional[jnp.ndarray] = None,
         kv_mask: Optional[jnp.ndarray] = None,
+        logit_index: Optional[jnp.ndarray] = None,
     ):
         """logits [B,S,V]; with ``cache`` returns (logits, new_cache).
 
         ``kv_mask``: bool (batch, max_len) — False cache slots are never
         attended to (left-padded prompts in generation).
+        ``logit_index``: optional int [B] — compute the LM head for only
+        that position per row (returned logits are [B, 1, V]). Generation
+        needs one next-token distribution, but the full-sequence head on
+        a long prefill materializes [B, S, vocab] fp32 — 33 GB at 8B,
+        batch 8, 8k context — so serving paths pass the last real
+        position instead.
         """
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
@@ -197,6 +210,9 @@ class Llama(nn.Module):
                 kv_mask=kv_mask,
             )
             new_cache.append(c)
+        if logit_index is not None:
+            idx = jnp.asarray(logit_index)
+            x = x[jnp.arange(x.shape[0]), idx][:, None, :]  # [B, 1, D]
         x = RMSNorm(dtype=dtype, impl=cfg.norm_impl, name="final_norm")(x)
         logits = make_dense(
             quantized=cfg.quantized, features=cfg.vocab_size,
@@ -210,9 +226,25 @@ class Llama(nn.Module):
 def init_cache(
     config: LlamaConfig, batch: int, max_len: Optional[int] = None, dtype: Any = jnp.bfloat16
 ) -> Cache:
-    """Zero-filled KV cache: per-layer (k, v) of [B, max_len, kv_heads, head_dim]."""
+    """Zero-filled KV cache: per-layer (k, v) of [B, max_len, kv_heads, head_dim].
+
+    With ``config.kv_quant`` each layer is instead
+    ``(k_q int8, v_q int8, k_scale fp32 [B, max_len, kv_heads], v_scale)``
+    — half the HBM of the bf16 form (int8 bytes + 1/32 scale overhead).
+    """
     max_len = max_len or config.max_len
     shape = (batch, max_len, config.num_kv_heads, config.head_dim)
+    if config.kv_quant:
+        if dtype != jnp.bfloat16:
+            # the dtype arg governs the bf16 cache form only; silently
+            # dropping an explicit request would be a trap
+            raise ValueError(
+                f"kv_quant caches are int8 + fp32 scales; dtype={dtype} "
+                "cannot apply (drop the dtype argument or kv_quant)"
+            )
+        q = jnp.zeros(shape, jnp.int8)
+        s = jnp.ones(shape[:-1], jnp.float32)
+        return tuple((q, q, s, s) for _ in range(config.num_layers))
     zeros = jnp.zeros(shape, dtype)
     return tuple((zeros, zeros) for _ in range(config.num_layers))
 

@@ -79,6 +79,167 @@ def mha_reference(
     return out.astype(q.dtype)
 
 
+def _grouped_cache_attention(
+    q,
+    k,
+    v,
+    *,
+    k_scale=None,
+    v_scale=None,
+    bias=None,
+    scale=None,
+    block_threshold: int = 2048,
+):
+    """Shared engine for cached-decode attention (bf16 or int8 KV).
+
+    ``k``/``v``: [B, S, Hk, D] (bf16, or int8 with ``k_scale``/``v_scale``
+    fp32 [B, S, Hk] per-(position, head) dequant scales). Three design
+    rules, each from a measured failure (BASELINE.md round 3):
+
+    - **No GQA repeat.** The group dim folds into the einsums (q reshaped
+      to [B, Sq, Hk, G, D]) so the cache is read at its own byte size; a
+      materialized repeat costs G x the cache traffic per decode step
+      (4x at the 8B geometry).
+    - **No dequantized copy.** int8 scales ride the small tensors —
+      ``k_scale`` multiplies the scores, ``v_scale`` multiplies the
+      softmax weights — so cache HBM reads stay int8.
+    - **Bounded VMEM, no cache copies.** Above ``block_threshold`` keys
+      the full-row softmax (f32[B, H, S] > 16 MB scoped VMEM at 8k) is
+      replaced by an online-softmax ``lax.scan`` over block INDICES with
+      ``dynamic_slice`` into the cache — passing cache blocks as scan
+      operands would materialize a transposed copy of the whole cache
+      every step (measured: 4 GB of HLO-temp copies at 8B/8k, an HBM
+      OOM). A non-dividing tail slab is merged after the scan, so the
+      cache is never padded (padding is a full copy too).
+
+    ``bias`` must broadcast over heads (head dim 1) — every cache caller
+    satisfies this. Output [B, Sq, Hq, D] in ``q.dtype``, equal to the
+    materialized form up to float reduction order.
+    """
+    batch, q_len, num_q_heads, head_dim = q.shape
+    num_kv_heads = k.shape[2]
+    if num_q_heads % num_kv_heads:
+        raise ValueError(
+            f"q heads {num_q_heads} must be a multiple of kv heads {num_kv_heads}"
+        )
+    group = num_q_heads // num_kv_heads
+    if bias is not None and bias.shape[1] != 1:
+        raise ValueError(
+            f"bias head dim must be 1 (broadcast over heads), got {bias.shape}"
+        )
+    scale = scale if scale is not None else head_dim**-0.5
+    kv_len = k.shape[1]
+    # [B, Sq, Hk, G, D]: contiguous head groups share a kv head (the
+    # jnp.repeat layout _repeat_kv would produce)
+    qg = q.reshape(batch, q_len, num_kv_heads, group, head_dim)
+
+    def scores_for(k_c, ks_c, bias_c):
+        """k-scale-folded scores for one key slab: [B, Hk, G, Q, K]."""
+        s = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", qg, k_c.astype(q.dtype),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if ks_c is not None:
+            s = s * jnp.transpose(ks_c, (0, 2, 1))[:, :, None, None, :]
+        if bias_c is not None:
+            s = s + bias_c[:, :, None]  # [B,1,Q,K] -> [B,1,1,Q,K]
+        return s
+
+    def weighted_values(w, v_c, vs_c):
+        if vs_c is not None:
+            w = w * jnp.transpose(vs_c, (0, 2, 1))[:, :, None, None, :]
+        return jnp.einsum(
+            "bhgqk,bkhd->bqhgd", w.astype(q.dtype), v_c.astype(q.dtype),
+            preferred_element_type=jnp.float32,
+        )
+
+    if kv_len <= block_threshold:
+        weights = jax.nn.softmax(scores_for(k, k_scale, bias), axis=-1)
+        out = weighted_values(weights, v, v_scale)
+        return out.reshape(batch, q_len, num_q_heads, head_dim).astype(q.dtype)
+
+    block = block_threshold
+    n_full, tail = divmod(kv_len, block)
+
+    def slab(x, start, size, axis=1):
+        return (
+            None
+            if x is None
+            else jax.lax.dynamic_slice_in_dim(x, start, size, axis=axis)
+        )
+
+    def merge(carry, start, size):
+        """Online-softmax update with the [start, start+size) key slab."""
+        m, l, acc = carry
+        s = scores_for(
+            slab(k, start, size), slab(k_scale, start, size),
+            slab(bias, start, size, axis=3),
+        )
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l = l * corr + p.sum(axis=-1)
+        acc = acc * jnp.moveaxis(corr, 3, 1)[..., None] + weighted_values(
+            p, slab(v, start, size), slab(v_scale, start, size)
+        )
+        return m_new, l, acc
+
+    stat = (batch, num_kv_heads, group, q_len)
+    carry = (
+        jnp.full(stat, NEG_INF, jnp.float32),
+        jnp.zeros(stat, jnp.float32),
+        jnp.zeros((batch, q_len, num_kv_heads, group, head_dim), jnp.float32),
+    )
+    if n_full:
+        carry, _ = jax.lax.scan(
+            lambda c, start: (merge(c, start, block), None),
+            carry,
+            jnp.arange(n_full, dtype=jnp.int32) * block,
+        )
+    if tail:
+        carry = merge(carry, n_full * block, tail)
+    m, l, acc = carry
+    out = acc / jnp.moveaxis(l, 3, 1)[..., None]
+    return out.reshape(batch, q_len, num_q_heads, head_dim).astype(q.dtype)
+
+
+def cached_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    bias: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    block_threshold: int = 2048,
+) -> jnp.ndarray:
+    """bf16 KV-cache decode attention: grouped GQA (no cache repeat),
+    VMEM-bounded block scan at long context. See
+    :func:`_grouped_cache_attention`."""
+    return _grouped_cache_attention(
+        q, k, v, bias=bias, scale=scale, block_threshold=block_threshold
+    )
+
+
+def quantized_cache_attention(
+    q: jnp.ndarray,
+    k_q: jnp.ndarray,
+    v_q: jnp.ndarray,
+    k_s: jnp.ndarray,
+    v_s: jnp.ndarray,
+    *,
+    bias: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    block_threshold: int = 2048,
+) -> jnp.ndarray:
+    """int8 KV-cache decode attention, dequant scales folded into the
+    attention math (never a dequantized cache copy). See
+    :func:`_grouped_cache_attention`."""
+    return _grouped_cache_attention(
+        q, k_q, v_q, k_scale=k_s, v_scale=v_s, bias=bias, scale=scale,
+        block_threshold=block_threshold,
+    )
+
+
 def blockwise_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,

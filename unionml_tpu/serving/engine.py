@@ -253,15 +253,18 @@ class DecodeEngine:
                 {"params": params}, tokens[None],
                 positions=jnp.arange(bucket)[None, :],
                 cache=fresh, cache_index=jnp.int32(0), kv_mask=kv_mask,
+                # head on the last REAL position only — the full-bucket
+                # head would materialize [1, bucket, vocab] fp32
+                logit_index=jnp.reshape(true_len - 1, (1,)),
             )
-            last = jax.lax.dynamic_slice(
-                logits, (0, true_len - 1, 0), (1, 1, logits.shape[-1])
-            )[:, 0]
-            first = sample(last, key)[0]
+            first = sample(logits[:, 0], key)[0]
             cache = tuple(
                 tuple(
                     jax.lax.dynamic_update_slice(
-                        glob, rows.astype(glob.dtype), (slot, 0, 0, 0)
+                        glob, rows.astype(glob.dtype),
+                        # rank-generic: covers the bf16 [B,L,H,D] buffers
+                        # and the int8-cache [B,L,H] scale planes alike
+                        (slot,) + (0,) * (glob.ndim - 1),
                     )
                     for glob, rows in zip(glayer, flayer)
                 )
