@@ -308,8 +308,77 @@ def kv_cache_legs() -> None:
         }))
 
 
+def prefix_cache_legs() -> None:
+    """Shared-prefix (system prompt) serving: cached vs naive
+    (``UNIONML_TPU_BENCH_PREFIX=1``, composes with the preset env var).
+
+    Per-request prefill work is proportional to prompt length; a system
+    prompt shared by every request multiplies it for no information
+    gain. ``make_lm_predictor(system_prefix=...)`` prefills the prefix
+    once per weights and broadcasts its KV rows, so requests pay only
+    their own suffix.
+    """
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from unionml_tpu.models import Llama, LlamaConfig, make_lm_predictor
+
+    backend = jax.default_backend()
+    preset = os.environ.get(
+        "UNIONML_TPU_BENCH_PRESET", "tiny" if backend == "cpu" else "serve_1p5b"
+    )
+    cfg = serving_config(preset)
+    trials = 3 if preset == "tiny" else 20
+    prefix_len, prompt_len, new_tokens, batch = (
+        (8, 4, 4, 2) if preset == "tiny" else (512, 64, 32, 8)
+    )
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(1, cfg.vocab_size, prefix_len).tolist()
+    prompts = [
+        rng.integers(1, cfg.vocab_size, prompt_len).tolist() for _ in range(batch)
+    ]
+    base = LlamaConfig(**{**cfg.__dict__, "quantized": True})
+    qmodule = Llama(base)
+    qparams = random_quantized_params(qmodule)
+
+    for cached in (False, True):
+        if cached:
+            pred = make_lm_predictor(
+                qmodule, max_new_tokens=new_tokens,
+                bucket_lens=(prompt_len,), max_len=cfg.max_len,
+                system_prefix=prefix,
+            )
+            reqs = prompts
+        else:
+            pred = make_lm_predictor(
+                qmodule, max_new_tokens=new_tokens,
+                bucket_lens=(prefix_len + prompt_len,), max_len=cfg.max_len,
+            )
+            reqs = [prefix + p for p in prompts]
+        pred(qparams, reqs)  # compile (+ prefix prefill when cached)
+        lat = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            pred(qparams, reqs)
+            lat.append((time.perf_counter() - t0) * 1e3)
+        lat.sort()
+        p50 = lat[len(lat) // 2]
+        print(json.dumps({
+            "metric": f"{preset}_prefix_{'cached' if cached else 'naive'}_p50_ms",
+            "batch": batch, "prefix_len": prefix_len, "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "value": round(p50, 1),
+            "unit": "ms",
+        }))
+
+
 if __name__ == "__main__":
     if os.environ.get("UNIONML_TPU_BENCH_KV"):
         kv_cache_legs()
+    elif os.environ.get("UNIONML_TPU_BENCH_PREFIX"):
+        prefix_cache_legs()
     else:
         main()

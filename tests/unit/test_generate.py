@@ -445,3 +445,139 @@ def test_chunked_prefill_matches_unchunked(tiny_llama):
         )
     )
     np.testing.assert_array_equal(got, unchunked)
+
+
+# -- shared-prefix (system prompt) serving -------------------------------- #
+
+
+def test_prefix_cache_matches_concatenated_generation(tiny_llama):
+    """Prefix-cached generation == prepending the prefix to every prompt,
+    exactly (greedy), including left-padded rows and a chunked prefix
+    build."""
+    from unionml_tpu.models.generate import make_prefix_cache
+
+    module, params = tiny_llama
+    rng = np.random.default_rng(6)
+    prefix = rng.integers(1, 97, 10).tolist()
+    prompts = rng.integers(1, 97, (2, 6)).astype(np.int32)
+
+    ref_gen = make_generator(module, max_new_tokens=5, max_len=64)
+    cat = np.concatenate([np.tile(prefix, (2, 1)), prompts], axis=1)
+    ref = np.asarray(ref_gen(params, jnp.asarray(cat, jnp.int32)))
+
+    pc = make_prefix_cache(module, params, prefix, max_len=64)
+    gen = make_generator(module, max_new_tokens=5, max_len=64, prefix_len=10)
+    got = np.asarray(gen(params, jnp.asarray(prompts), prefix_cache=pc))
+    np.testing.assert_array_equal(got, ref)
+
+    # left-padded prompt rows: the reference is the LEFT-padded
+    # concatenation (the plain generator's contract — pads first)
+    mask = np.ones((2, 6), bool)
+    mask[0, :2] = False
+    padded = prompts.copy()
+    padded[0, :2] = 0
+    cat_p = np.zeros((2, 16), np.int32)
+    cat_m = np.zeros((2, 16), bool)
+    cat_p[0, 2:12], cat_p[0, 12:] = prefix, prompts[0, 2:]
+    cat_m[0, 2:] = True
+    cat_p[1, :10], cat_p[1, 10:] = prefix, prompts[1]
+    cat_m[1, :] = True
+    ref_p = np.asarray(
+        ref_gen(params, jnp.asarray(cat_p), None, jnp.asarray(cat_m))
+    )
+    got_p = np.asarray(
+        gen(params, jnp.asarray(padded), None, jnp.asarray(mask), prefix_cache=pc)
+    )
+    np.testing.assert_array_equal(got_p, ref_p)
+
+    # chunked prefix build (non-dividing chunk) fills the same rows
+    pc_chunked = make_prefix_cache(module, params, prefix, max_len=64, prefill_chunk=4)
+    got_c = np.asarray(gen(params, jnp.asarray(prompts), prefix_cache=pc_chunked))
+    np.testing.assert_array_equal(got_c, ref)
+
+
+def test_prefix_cache_validations(tiny_llama):
+    from unionml_tpu.models.generate import make_prefix_cache
+
+    module, params = tiny_llama
+    gen = make_generator(module, max_new_tokens=2, max_len=32, prefix_len=4)
+    with pytest.raises(ValueError, match="prefix_cache must be passed"):
+        gen(params, jnp.zeros((1, 4), jnp.int32))
+    plain = make_generator(module, max_new_tokens=2, max_len=32)
+    pc = make_prefix_cache(module, params, [1, 2, 3, 4], max_len=32)
+    with pytest.raises(ValueError, match="prefix_cache must be passed"):
+        plain(params, jnp.zeros((1, 4), jnp.int32), None, None, pc)
+    with pytest.raises(ValueError, match="no cache room"):
+        make_prefix_cache(module, params, list(range(1, 33)), max_len=32)
+
+
+def test_lm_predictor_system_prefix(tiny_llama):
+    """system_prefix through the bucketed predictor: per-row outputs equal
+    prepending the prefix; the prefix cache is built once per params and
+    reused across calls/buckets."""
+    from unionml_tpu.models import generate as gen_mod
+
+    module, params = tiny_llama
+    rng = np.random.default_rng(7)
+    prefix = rng.integers(1, 97, 8).tolist()
+
+    calls = []
+    real = gen_mod.make_prefix_cache
+
+    def spy(*args, **kwargs):
+        calls.append(kwargs.get("max_len"))
+        return real(*args, **kwargs)
+
+    gen_mod.make_prefix_cache = spy
+    try:
+        pred = gen_mod.make_lm_predictor(
+            module, max_new_tokens=3, bucket_lens=(8, 16), max_len=64,
+            system_prefix=prefix,
+        )
+        out = pred(params, [[5, 6, 7], [9, 10, 11, 12]])
+        out2 = pred(params, [[5, 6, 7]])
+    finally:
+        gen_mod.make_prefix_cache = real
+    assert len(calls) == 1  # memoized per (state, bucket)
+
+    full = make_generator(module, max_new_tokens=3, max_len=64)
+    for row, prompt in zip(out, [[5, 6, 7], [9, 10, 11, 12]]):
+        ref = np.asarray(
+            full(params, jnp.asarray([prefix + prompt], jnp.int32))
+        )
+        np.testing.assert_array_equal(np.asarray(row), ref[0])
+    assert out2[0] == out[0]
+
+
+def test_lm_predictor_system_prefix_memoizes_for_lora_state(tiny_llama):
+    """The prefix memo keys on the STATE object: a LoRATrainState resolves
+    to a freshly-merged param tree every call, so an id(params) key would
+    re-prefill per request (the bug this test pins)."""
+    from unionml_tpu.models import create_lora_train_state
+    from unionml_tpu.models import generate as gen_mod
+
+    module, params = tiny_llama
+    lora_module = Llama(dataclasses.replace(module.config, lora_rank=2))
+    state = create_lora_train_state(
+        lora_module, jnp.zeros((1, 8), jnp.int32), base_params=params
+    )
+
+    calls = []
+    real = gen_mod.make_prefix_cache
+
+    def spy(*args, **kwargs):
+        calls.append(1)
+        return real(*args, **kwargs)
+
+    gen_mod.make_prefix_cache = spy
+    try:
+        pred = gen_mod.make_lm_predictor(
+            lora_module, max_new_tokens=2, bucket_lens=(8,), max_len=32,
+            system_prefix=[1, 2, 3],
+        )
+        first = pred(state, [[5, 6]])
+        second = pred(state, [[5, 6]])
+    finally:
+        gen_mod.make_prefix_cache = real
+    assert len(calls) == 1, "prefix re-prefilled per request for a LoRA state"
+    assert first == second
