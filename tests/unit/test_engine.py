@@ -292,3 +292,27 @@ def test_engine_with_kv_quant_cache(tiny_llama):
             assert out == _solo(qmodule, params, prompt, 8)
     finally:
         engine.close()
+
+
+def test_engine_system_prefix_matches_prefixed_solo(tiny_llama):
+    """Engine with system_prefix: every request's tokens equal the solo
+    generation of (prefix + prompt) — the prefix KV is seeded once and
+    shared by all slots."""
+    module, params = tiny_llama
+    rng = np.random.default_rng(9)
+    prefix = rng.integers(1, 97, 7).tolist()
+    engine = DecodeEngine(
+        module, slots=3, max_new_tokens=6, prompt_buckets=(8, 16),
+        chunk_steps=3, system_prefix=prefix,
+    )
+    try:
+        prompts = [rng.integers(1, 97, size=n).tolist() for n in (5, 8, 12)]
+        outs = engine.generate(params, prompts)
+        for prompt, out in zip(prompts, outs):
+            assert out == _solo(module, params, prefix + prompt, 6)
+        # second round reuses the seeded prefix rows (slot reuse path)
+        outs2 = engine.generate(params, prompts[:2])
+        for prompt, out in zip(prompts[:2], outs2):
+            assert out == _solo(module, params, prefix + prompt, 6)
+    finally:
+        engine.close()

@@ -34,7 +34,13 @@ from unionml_tpu.models.encdec import (
     make_seq2seq_predictor,
     seq2seq_step,
 )
-from unionml_tpu.models.generate import make_generator, make_lm_predictor, serving_params
+from unionml_tpu.models.generate import (
+    PrefixCache,
+    make_generator,
+    make_lm_predictor,
+    make_prefix_cache,
+    serving_params,
+)
 from unionml_tpu.models.lora import (
     LORA_PARTITION_RULES,
     LoRADenseGeneral,
@@ -88,6 +94,7 @@ __all__ = [
     "make_evaluator", "make_predictor",
     "make_speculative_generator", "make_speculative_predictor",
     "make_generator", "make_lm_predictor", "serving_params", "adamw",
+    "make_prefix_cache", "PrefixCache",
     "create_pipelined_lm_state", "pipelined_lm_step", "pipelined_lm_apply",
     "to_pipeline_params", "PIPELINE_PARTITION_RULES",
     "sequence_parallel_config", "sequence_parallel_lm_step",

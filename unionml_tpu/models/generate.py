@@ -31,7 +31,8 @@ length — see :mod:`unionml_tpu.serving.batcher`): the per-batch scalar
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -247,7 +248,59 @@ def make_generator(
         )
         return jnp.concatenate([first[:, None], rest.T], axis=1)
 
-    return jax.jit(generate)
+    jitted = jax.jit(generate)
+    if prefix_len == 0:
+        def plain(params, tokens, key=None, prompt_mask=None, prefix_cache=None):
+            if prefix_cache is not None:
+                # raise here, not inside jit: an unregistered PrefixCache
+                # dataclass would die in pytree flattening with an opaque
+                # "not a valid JAX type" error
+                raise ValueError(
+                    "prefix_cache must be passed exactly when the "
+                    "generator was built with prefix_len > 0 "
+                    "(prefix_len=0)"
+                )
+            return jitted(params, tokens, key, prompt_mask)
+
+        return plain
+
+    def prefixed(params, tokens, key=None, prompt_mask=None, prefix_cache=None):
+        # validate the wrapper OUTSIDE the jit boundary (an unregistered
+        # dataclass would die in pytree flattening with an opaque error):
+        # a cache built for a different prefix or max_len would be
+        # silently overwritten/misread otherwise
+        if prefix_cache is None:
+            raise ValueError(
+                "prefix_cache must be passed exactly when the generator "
+                f"was built with prefix_len > 0 (prefix_len={prefix_len})"
+            )
+        if not isinstance(prefix_cache, PrefixCache):
+            raise TypeError(
+                "prefix_cache must come from make_prefix_cache "
+                f"(got {type(prefix_cache).__name__})"
+            )
+        if prefix_cache.length != prefix_len or prefix_cache.total_len != total_len:
+            raise ValueError(
+                f"prefix_cache was built for prefix_len={prefix_cache.length}, "
+                f"max_len={prefix_cache.total_len}; this generator needs "
+                f"prefix_len={prefix_len}, max_len={total_len}"
+            )
+        return jitted(params, tokens, key, prompt_mask, prefix_cache.cache)
+
+    return prefixed
+
+
+@dataclass(frozen=True)
+class PrefixCache:
+    """A prefilled shared-prefix KV cache plus the geometry it was built
+    for — :func:`make_generator`'s prefixed form validates ``length`` /
+    ``total_len`` against its own configuration, so a cache built for a
+    different prefix or cache size is rejected instead of silently
+    conditioning generation on the wrong rows."""
+
+    cache: Any
+    length: int
+    total_len: int
 
 
 def make_prefix_cache(
@@ -257,20 +310,22 @@ def make_prefix_cache(
     *,
     max_len: Optional[int] = None,
     prefill_chunk: Optional[int] = None,
-):
+) -> PrefixCache:
     """Prefill a shared prefix (system prompt) ONCE into a [1, max_len]
     KV cache for :func:`make_generator`'s ``prefix_len`` mode.
 
-    Returns the cache pytree (bf16 or int8 per ``config.kv_quant``) with
-    rows ``[0, len(prefix_tokens))`` filled; ``generate`` broadcasts it
-    across each request batch and prefills only the per-request suffix.
-    Rebuild whenever ``params`` change (the predictor's ``system_prefix``
-    mode memoizes per params identity).
+    Returns a :class:`PrefixCache` whose pytree (bf16 or int8 per
+    ``config.kv_quant``) has rows ``[0, len(prefix_tokens))`` filled;
+    ``generate`` broadcasts it across each request batch and prefills
+    only the per-request suffix. Rebuild whenever ``params`` change (the
+    predictor's ``system_prefix`` mode memoizes per state identity).
     """
     cfg: LlamaConfig = module.config
     total_len = max_len or cfg.max_len
     toks = jnp.asarray(prefix_tokens, jnp.int32)[None]
     prefix_len = toks.shape[1]
+    if prefix_len == 0:
+        raise ValueError("prefix_tokens must be non-empty")
     if prefix_len >= total_len:
         raise ValueError(
             f"prefix of {prefix_len} tokens leaves no cache room within "
@@ -309,7 +364,11 @@ def make_prefix_cache(
         )
         return cache
 
-    return jax.jit(build)(params, toks)
+    return PrefixCache(
+        cache=jax.jit(build)(params, toks),
+        length=prefix_len,
+        total_len=total_len,
+    )
 
 
 def make_lm_predictor(
@@ -350,6 +409,10 @@ def make_lm_predictor(
         if system_prefix is None
         else np.asarray(system_prefix, np.int32).ravel()
     )
+    if prefix is not None and prefix.size == 0:
+        # an empty array would thread prefix_len=0 into make_prefix_cache
+        # and die in a ZeroDivisionError at the first request
+        raise ValueError("system_prefix must be non-empty when given")
     prefix_len = 0 if prefix is None else len(prefix)
     total_len = max_len or module.config.max_len
     # only buckets that leave room for generation (and the prefix) in the

@@ -71,6 +71,27 @@ def _start_host_copy(arr) -> None:
         pass
 
 
+def _splice_rows(dst_tree, src_tree, b_start, r_start):
+    """Write ``src_tree``'s rows into ``dst_tree`` at (batch, row) offset
+    ``(b_start, r_start)`` — per layer, per buffer, rank-generic (covers
+    the bf16 [B, L, H, D] KV buffers and the int8-cache [B, L, H] scale
+    planes alike). The single home for the engine's three cache splices
+    (prefix seed broadcast, per-request fresh-cache seed, suffix
+    placement)."""
+    import jax
+
+    return tuple(
+        tuple(
+            jax.lax.dynamic_update_slice(
+                dst, src.astype(dst.dtype),
+                (b_start, r_start) + (0,) * (dst.ndim - 2),
+            )
+            for dst, src in zip(dst_layer, src_layer)
+        )
+        for dst_layer, src_layer in zip(dst_tree, src_tree)
+    )
+
+
 @dataclass
 class _Request:
     prompt: np.ndarray                  # int32 [P], truncated to max bucket
@@ -138,6 +159,7 @@ class DecodeEngine:
         pad_id: int = 0,
         seed: int = 0,
         submit_timeout: float = 300.0,
+        system_prefix: Optional[Sequence[int]] = None,
     ):
         import jax
 
@@ -158,6 +180,21 @@ class DecodeEngine:
         self.eos_id = eos_id
         self.pad_id = pad_id
         self.submit_timeout = submit_timeout
+        # shared system prefix: its KV rows occupy [0, prefix_len) of
+        # EVERY slot, seeded once per bound weights (one [1, P] prefill)
+        # and splice-broadcast into the resident cache; per-request
+        # prefills then cover only the user prompt at rows >= prefix_len
+        self._prefix_tokens = (
+            None
+            if system_prefix is None
+            else np.asarray(system_prefix, np.int32).ravel()
+        )
+        if self._prefix_tokens is not None and self._prefix_tokens.size == 0:
+            raise ValueError("system_prefix must be non-empty when given")
+        self.prefix_len = (
+            0 if self._prefix_tokens is None else len(self._prefix_tokens)
+        )
+        self._prefix_rows = None  # [1, prefix_len] KV tree, set at seed
         # spare rows: a slot may overshoot its token budget by up to the
         # full in-flight window (pipeline_depth chunks dispatched before
         # the host harvests the completion, plus the chunk being
@@ -165,18 +202,19 @@ class DecodeEngine:
         # the fill invariant (fill always points at a masked-False row)
         # without per-slot write redirection
         self.cache_len = (
-            self.buckets[-1]
+            self.prefix_len
+            + self.buckets[-1]
             + max_new_tokens
             + (self.pipeline_depth + 1) * chunk_steps
         )
         if self.cache_len > self.cfg.max_len:
             raise ValueError(
-                f"cache length {self.cache_len} (= max bucket "
-                f"{self.buckets[-1]} + max_new_tokens {max_new_tokens} + "
-                f"(pipeline_depth {self.pipeline_depth} + 1) * chunk_steps "
-                f"{chunk_steps} spare rows) exceeds model max_len "
-                f"{self.cfg.max_len}; lower pipeline_depth/chunk_steps or "
-                "raise max_len"
+                f"cache length {self.cache_len} (= prefix {self.prefix_len} "
+                f"+ max bucket {self.buckets[-1]} + max_new_tokens "
+                f"{max_new_tokens} + (pipeline_depth {self.pipeline_depth} "
+                f"+ 1) * chunk_steps {chunk_steps} spare rows) exceeds "
+                f"model max_len {self.cfg.max_len}; lower pipeline_depth/"
+                "chunk_steps or raise max_len"
             )
         self._sample = make_sampler(
             temperature=temperature, top_k=top_k, top_p=top_p
@@ -229,6 +267,7 @@ class DecodeEngine:
         from unionml_tpu.models.llama import init_cache
 
         cfg, L, B = self.cfg, self.cache_len, self.slots
+        P = self.prefix_len
         module, sample = self.module, self._sample
         eos_id, pad_id = self.eos_id, self.pad_id
 
@@ -236,45 +275,85 @@ class DecodeEngine:
             return {
                 "cache": init_cache(cfg, B, L),
                 "kv_mask": jnp.zeros((B, L), bool),
-                "fill": jnp.zeros((B,), jnp.int32),
+                # empty slots idle at row P, NOT 0: dead slots still run
+                # the decode apply and write garbage k/v at their fill
+                # row. Row P is masked False and overwritten by the next
+                # prefill's suffix splice; row 0 would be a PREFIX row —
+                # shared, seeded once, never rewritten — and idle writes
+                # there corrupted every later occupant (caught by
+                # test_engine_system_prefix_matches_prefixed_solo).
+                "fill": jnp.full((B,), P, jnp.int32),
                 "last_tok": jnp.zeros((B,), jnp.int32),
                 "done": jnp.ones((B,), bool),
             }
 
         self._init_state = jax.jit(init_state)
 
-        def prefill(params, state, slot, tokens, true_len, key):
+        if P:
+            prefix_toks = jnp.asarray(self._prefix_tokens, jnp.int32)[None]
+
+            def seed_prefix(params, state):
+                """Prefill the shared prefix ONCE ([1, P] program) and
+                broadcast its KV rows into rows [0, P) of every slot."""
+                pcache = init_cache(cfg, 1, P)
+                _, pcache = module.apply(
+                    {"params": params}, prefix_toks,
+                    positions=jnp.arange(P)[None, :],
+                    cache=pcache, cache_index=jnp.int32(0),
+                    logit_index=jnp.zeros((1,), jnp.int32),
+                )
+                broadcast = tuple(
+                    tuple(
+                        jnp.broadcast_to(rows, (B,) + rows.shape[1:])
+                        for rows in player
+                    )
+                    for player in pcache
+                )
+                cache = _splice_rows(state["cache"], broadcast, 0, 0)
+                return {**state, "cache": cache}, pcache
+
+            self._seed_prefix = jax.jit(seed_prefix, donate_argnums=(1,))
+
+        def prefill(params, state, slot, tokens, true_len, key, prefix_rows):
             """Run one prompt (padded to its bucket) through a fresh
-            [1, bucket] cache, splice the KV rows into ``slot``."""
+            [1, prefix + bucket] cache seeded with the shared prefix
+            rows, splice the SUFFIX KV rows into ``slot`` (the slot's
+            prefix rows were broadcast at seed time and never rewritten)."""
             bucket = tokens.shape[0]
-            fresh = init_cache(cfg, 1, bucket)
-            kv_mask = (jnp.arange(bucket) < true_len)[None, :]
+            fresh = init_cache(cfg, 1, P + bucket)
+            if P:
+                fresh = _splice_rows(fresh, prefix_rows, 0, 0)
+            kv_mask = jnp.concatenate(
+                [
+                    jnp.ones((1, P), bool),
+                    (jnp.arange(bucket) < true_len)[None, :],
+                ],
+                axis=1,
+            )
             logits, filled = module.apply(
                 {"params": params}, tokens[None],
-                positions=jnp.arange(bucket)[None, :],
-                cache=fresh, cache_index=jnp.int32(0), kv_mask=kv_mask,
+                positions=P + jnp.arange(bucket)[None, :],
+                cache=fresh, cache_index=jnp.int32(P), kv_mask=kv_mask,
                 # head on the last REAL position only — the full-bucket
                 # head would materialize [1, bucket, vocab] fp32
                 logit_index=jnp.reshape(true_len - 1, (1,)),
             )
             first = sample(logits[:, 0], key)[0]
-            cache = tuple(
+            # suffix rows only ([P, P + bucket)): the slot's prefix rows
+            # were broadcast at seed time and are never rewritten
+            suffix = tuple(
                 tuple(
-                    jax.lax.dynamic_update_slice(
-                        glob, rows.astype(glob.dtype),
-                        # rank-generic: covers the bf16 [B,L,H,D] buffers
-                        # and the int8-cache [B,L,H] scale planes alike
-                        (slot,) + (0,) * (glob.ndim - 1),
-                    )
-                    for glob, rows in zip(glayer, flayer)
+                    jax.lax.dynamic_slice_in_dim(rows, P, bucket, axis=1)
+                    for rows in flayer
                 )
-                for glayer, flayer in zip(state["cache"], filled)
+                for flayer in filled
             )
-            row_mask = jnp.arange(L) < true_len
+            cache = _splice_rows(state["cache"], suffix, slot, P)
+            row_mask = jnp.arange(L) < P + true_len
             return {
                 "cache": cache,
                 "kv_mask": state["kv_mask"].at[slot].set(row_mask),
-                "fill": state["fill"].at[slot].set(true_len),
+                "fill": state["fill"].at[slot].set(P + true_len),
                 "last_tok": state["last_tok"].at[slot].set(first),
                 "done": state["done"].at[slot].set(False),
             }, first
@@ -389,6 +468,11 @@ class DecodeEngine:
                     "cannot swap engine params while requests are in "
                     "flight — drain the engine (or create a new one) first"
                 )
+            if self._params is not None and self.prefix_len:
+                # resident prefix KV rows belong to the OLD weights;
+                # drop the state so admission re-seeds under the new tree
+                self._state = None
+                self._prefix_rows = None
             self._params = params
 
     def warmup(self, params) -> int:
@@ -483,7 +567,7 @@ class DecodeEngine:
         (key,) = self._next_key()
         self._state, first = self._prefill(
             self._params, self._state, jnp.int32(slot), jnp.asarray(padded),
-            jnp.int32(len(req.prompt)), key,
+            jnp.int32(len(req.prompt)), key, self._prefix_rows,
         )
         _start_host_copy(first)
         with self._lock:
@@ -605,6 +689,11 @@ class DecodeEngine:
                 return
             if self._state is None:
                 self._state = self._init_state()
+                if self.prefix_len:
+                    # seed the shared prefix rows for the bound weights
+                    self._state, self._prefix_rows = self._seed_prefix(
+                        self._params, self._state
+                    )
             try:
                 self._admit(req)
             except BaseException as exc:
@@ -666,3 +755,4 @@ class DecodeEngine:
                     req.event.set()
                     self._occupant[slot] = None
         self._state = None
+        self._prefix_rows = None
