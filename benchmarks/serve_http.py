@@ -50,6 +50,12 @@ def main() -> None:
         "shallow pipelines or joins queue behind the chunk backlog)",
     )
     parser.add_argument(
+        "--checkpoint", default=None,
+        help="HF safetensors checkpoint directory — serve REAL weights, "
+        "streamed to int8 on load (models/convert.py); geometry comes "
+        "from its config.json and overrides the preset's",
+    )
+    parser.add_argument(
         "--open-rate", type=float, default=0.0,
         help="also run an open-loop scenario: Poisson arrivals at this "
         "rate (req/s) — the workload where step-boundary joins beat the "
@@ -82,21 +88,34 @@ def main() -> None:
     if preset == "tiny":
         args.requests = min(args.requests, 3)
     cfg = serving_config(preset)
-    qcfg = LlamaConfig(**{**cfg.__dict__, "quantized": True})
-    qmodule = Llama(qcfg)
+    if args.checkpoint:
+        # REAL weights: geometry from the checkpoint's config.json,
+        # serving knobs (cache size, kv_quant, attention impl) from the
+        # preset; kernels stream to int8 on load without an fp tree ever
+        # materializing (models/convert.py)
+        from unionml_tpu.models import load_llama_checkpoint
 
-    if preset == "serve_8b":
-        # synthetic int8 weights: an 8B master tree can't be materialized
-        # on-chip to quantize from (see serve_latency.random_quantized_params)
-        from benchmarks.serve_latency import random_quantized_params
-
-        qparams = random_quantized_params(qmodule)
+        qparams, qcfg = load_llama_checkpoint(
+            args.checkpoint, quantize=True, quantized=True,
+            max_len=cfg.max_len, kv_quant=cfg.kv_quant,
+            attn_impl=cfg.attn_impl,
+        )
+        qmodule = Llama(qcfg)
     else:
-        # int8 artifact, exactly the serve_latency production path
-        fp_params = jax.jit(Llama(cfg).init)(
-            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
-        )["params"]
-        qparams = quantize_params(fp_params, LLAMA_QUANT_PATTERNS)
+        qcfg = LlamaConfig(**{**cfg.__dict__, "quantized": True})
+        qmodule = Llama(qcfg)
+        if preset == "serve_8b":
+            # synthetic int8 weights: an 8B master tree can't be materialized
+            # on-chip to quantize from (see serve_latency.random_quantized_params)
+            from benchmarks.serve_latency import random_quantized_params
+
+            qparams = random_quantized_params(qmodule)
+        else:
+            # int8 artifact, exactly the serve_latency production path
+            fp_params = jax.jit(Llama(cfg).init)(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )["params"]
+            qparams = quantize_params(fp_params, LLAMA_QUANT_PATTERNS)
 
     dataset = Dataset(name="http_bench_data", targets=[])
 
@@ -116,8 +135,10 @@ def main() -> None:
         depth = args.pipeline_depth
         if depth is None:
             # cover one ~120 ms RTT of backlog, no more: deeper pipelines
-            # make joining prefills queue behind the whole chunk backlog
-            per_step_ms = {"serve_8b": 11.0}.get(preset, 3.3)
+            # make joining prefills queue behind the whole chunk backlog.
+            # Keyed on actual geometry, not the preset name: --checkpoint
+            # can swap in an 8B-class model under any preset
+            per_step_ms = 11.0 if qcfg.hidden_dim >= 4096 else 3.3
             depth = max(2, int(round(120.0 / (args.chunk_steps * per_step_ms))))
         engine = DecodeEngine(
             qmodule, slots=args.clients, max_new_tokens=args.new_tokens,
@@ -162,7 +183,7 @@ def main() -> None:
     host, port = serving.serve(port=0, blocking=False)
 
     rng = np.random.default_rng(0)
-    prompt = rng.integers(1, cfg.vocab_size, size=(args.prompt_len,)).tolist()
+    prompt = rng.integers(1, qcfg.vocab_size, size=(args.prompt_len,)).tolist()
     body = json.dumps({"features": [prompt]}).encode()
 
     def request() -> float:

@@ -34,6 +34,15 @@ from unionml_tpu.models.encdec import (
     make_seq2seq_predictor,
     seq2seq_step,
 )
+from unionml_tpu.models.convert import (
+    bert_config_from_hf,
+    export_bert_safetensors,
+    export_llama_safetensors,
+    llama_config_from_hf,
+    load_bert_checkpoint,
+    load_llama_checkpoint,
+    merge_pretrained,
+)
 from unionml_tpu.models.generate import (
     PrefixCache,
     make_generator,

@@ -31,6 +31,10 @@ class BertConfig:
     mlp_dim: int = 3072
     num_classes: int = 2  # classification head width
     attn_impl: str = "xla"  # "fused" only when attention_mask is None
+    # HF BERT checkpoints use erf GELU; the default tanh approximation is
+    # one transcendental cheaper. Checkpoint loaders set True
+    # (models/convert.py) for faithful pretrained inference.
+    gelu_exact: bool = False
     dtype: str = "bfloat16"
 
     @staticmethod
@@ -84,7 +88,10 @@ class BertBlock(nn.Module):
             features=cfg.hidden_dim, axis=(-2, -1), dtype=dtype, name="attn_o"
         )(attn)
         x = nn.LayerNorm(dtype=dtype, name="ln1")(x + attn)
-        h = MlpBlock(hidden_dim=cfg.mlp_dim, dtype=dtype, name="mlp")(x)
+        h = MlpBlock(
+            hidden_dim=cfg.mlp_dim, gelu_approximate=not cfg.gelu_exact,
+            dtype=dtype, name="mlp",
+        )(x)
         return nn.LayerNorm(dtype=dtype, name="ln2")(x + h)
 
 

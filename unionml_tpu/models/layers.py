@@ -26,6 +26,7 @@ from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import linen as nn
 
 from unionml_tpu.ops.attention import attention as xla_attention
@@ -123,17 +124,54 @@ class LayerNorm(nn.Module):
         return fused_layer_norm(x, scale, bias, self.eps).astype(self.dtype)
 
 
+def llama3_rope_frequencies(
+    freqs: jnp.ndarray,
+    *,
+    factor: float,
+    low_freq_factor: float,
+    high_freq_factor: float,
+    original_max_len: int,
+) -> jnp.ndarray:
+    """Llama-3.1/3.2 long-context RoPE frequency rescaling.
+
+    Wavelengths shorter than ``original_max_len / high_freq_factor`` keep
+    their frequency, longer than ``original_max_len / low_freq_factor``
+    divide by ``factor``, and the band between interpolates smoothly —
+    the "llama3" ``rope_scaling`` scheme HF checkpoints carry in
+    config.json. Verified against transformers' torch implementation in
+    ``tests/unit/test_convert_hf_parity.py``.
+    """
+    wavelen = 2.0 * np.pi / freqs
+    ratio = original_max_len / wavelen
+    smooth = (ratio - low_freq_factor) / (high_freq_factor - low_freq_factor)
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    return ((1.0 - smooth) / factor + smooth) * freqs
+
+
 def rotary_embedding(
-    x: jnp.ndarray, positions: jnp.ndarray, *, theta: float = 10_000.0
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    *,
+    theta: float = 10_000.0,
+    scaling: Optional[Tuple[float, float, float, int]] = None,
 ) -> jnp.ndarray:
     """Apply rotary position embedding to ``x`` of shape (..., seq, heads, head_dim).
 
     ``positions``: integer array broadcastable to (..., seq). Llama-3 uses
     ``theta=500_000`` for long-context; classic RoPE uses 10_000.
+    ``scaling``: optional llama3-type frequency rescale as a
+    ``(factor, low_freq_factor, high_freq_factor, original_max_len)``
+    tuple (hashable — it rides inside frozen model configs).
     """
     head_dim = x.shape[-1]
     half = head_dim // 2
     freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    if scaling is not None:
+        factor, low, high, orig = scaling
+        freqs = llama3_rope_frequencies(
+            freqs, factor=factor, low_freq_factor=low,
+            high_freq_factor=high, original_max_len=orig,
+        )
     angles = positions[..., None].astype(jnp.float32) * freqs  # (..., seq, half)
     cos = jnp.cos(angles)[..., None, :]  # (..., seq, 1, half)
     sin = jnp.sin(angles)[..., None, :]
@@ -223,6 +261,9 @@ class Attention(nn.Module):
     head_dim: Optional[int] = None
     rope: bool = False
     rope_theta: float = 10_000.0
+    # llama3-type long-context frequency rescale:
+    # (factor, low_freq_factor, high_freq_factor, original_max_len)
+    rope_scaling: Optional[Tuple[float, float, float, int]] = None
     causal: bool = False
     attn_impl: str = "xla"
     sequence_axis: Optional[str] = None
@@ -301,8 +342,12 @@ class Attention(nn.Module):
                 base = base[:, None]  # per-row fill positions (slot decode)
             positions = base + jnp.arange(seq)[None, :]
         if self.rope:
-            q = rotary_embedding(q, positions, theta=self.rope_theta)
-            k = rotary_embedding(k, positions, theta=self.rope_theta)
+            q = rotary_embedding(
+                q, positions, theta=self.rope_theta, scaling=self.rope_scaling
+            )
+            k = rotary_embedding(
+                k, positions, theta=self.rope_theta, scaling=self.rope_scaling
+            )
 
         new_cache = None
         if cache is not None:
@@ -406,6 +451,10 @@ class MlpBlock(nn.Module):
     quantized: bool = False  # int8 weight-only (bias-free gated form only)
     lora_rank: int = 0  # >0: trainable low-rank adapters on gate/up/down
     lora_alpha: float = 16.0
+    # tanh-approximate GELU by default (one transcendental cheaper on the
+    # VPU); HF BERT checkpoints were trained with erf GELU — loaders set
+    # False for checkpoint-faithful inference (models/convert.py)
+    gelu_approximate: bool = True
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
 
@@ -423,5 +472,7 @@ class MlpBlock(nn.Module):
             gate = nn.silu(dense(self.hidden_dim, "gate")(x))
             up = dense(self.hidden_dim, "up")(x)
             return dense(features, "down")(gate * up)
-        h = nn.gelu(dense(self.hidden_dim, "up")(x), approximate=True)
+        h = nn.gelu(
+            dense(self.hidden_dim, "up")(x), approximate=self.gelu_approximate
+        )
         return dense(features, "down")(h)

@@ -40,6 +40,12 @@ class LlamaConfig:
     num_kv_heads: int = 8
     mlp_dim: int = 14_336
     rope_theta: float = 500_000.0
+    # llama3-type long-context RoPE rescale, as the hashable tuple
+    # (factor, low_freq_factor, high_freq_factor, original_max_len) —
+    # what HF Llama-3.1/3.2 config.json carries as `rope_scaling`
+    # (models/convert.py maps it; ops in models/layers.py)
+    rope_scaling: Optional[Tuple[float, float, float, int]] = None
+    norm_eps: float = 1e-5  # HF `rms_norm_eps` (1e-6 for Llama-2-era)
     max_len: int = 8192
     attn_impl: str = "xla"
     # "fused" = Pallas RMSNorm kernel pair (ops/fused_norm.py)
@@ -115,6 +121,7 @@ class LlamaBlock(nn.Module):
             head_dim=cfg.head_dim,
             rope=True,
             rope_theta=cfg.rope_theta,
+            rope_scaling=cfg.rope_scaling,
             causal=True,
             attn_impl=cfg.attn_impl,
             sequence_axis=cfg.sequence_axis,
@@ -124,7 +131,7 @@ class LlamaBlock(nn.Module):
             dtype=dtype,
             name="attn",
         )
-        h = RMSNorm(dtype=dtype, impl=cfg.norm_impl, name="attn_norm")(x)
+        h = RMSNorm(eps=cfg.norm_eps, dtype=dtype, impl=cfg.norm_impl, name="attn_norm")(x)
         if cache is not None:
             a, new_cache = attn(
                 h, positions=positions, cache=cache, cache_index=cache_index,
@@ -141,7 +148,7 @@ class LlamaBlock(nn.Module):
                 )
             a, new_cache = attn(h, positions=positions), None
         x = x + a
-        h = RMSNorm(dtype=dtype, impl=cfg.norm_impl, name="mlp_norm")(x)
+        h = RMSNorm(eps=cfg.norm_eps, dtype=dtype, impl=cfg.norm_impl, name="mlp_norm")(x)
         if cfg.num_experts:
             mlp_out, aux = MoEMlp(
                 num_experts=cfg.num_experts, num_selected=cfg.num_selected,
@@ -213,7 +220,7 @@ class Llama(nn.Module):
         if logit_index is not None:
             idx = jnp.asarray(logit_index)
             x = x[jnp.arange(x.shape[0]), idx][:, None, :]  # [B, 1, D]
-        x = RMSNorm(dtype=dtype, impl=cfg.norm_impl, name="final_norm")(x)
+        x = RMSNorm(eps=cfg.norm_eps, dtype=dtype, impl=cfg.norm_impl, name="final_norm")(x)
         logits = make_dense(
             quantized=cfg.quantized, features=cfg.vocab_size,
             dtype=jnp.float32, name="lm_head",
