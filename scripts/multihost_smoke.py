@@ -1,0 +1,199 @@
+"""Multi-host bring-up smoke: a real ``jax.distributed`` training run.
+
+Launched by ``tests/integration/test_multihost.py`` and the
+``multihost_dp_fsdp`` leg of ``__graft_entry__.dryrun_multichip`` as a
+pair of OS processes (CPU backend, ``--xla_force_host_platform_device_
+count`` local devices each, Gloo cross-process collectives) — the same
+control plane ``jax.distributed`` uses on TPU pods, minus the hardware.
+
+Each process feeds ONLY its own batch rows (``local_batches`` →
+``DeviceFeed`` assembling global arrays from process-local shards), runs
+a dp×fsdp ``compile_step`` training loop, and prints the final loss plus
+a replicated parameter checksum. The single-process invocation
+(``--num-processes 1``) is the equality reference: same seeds, same
+global batch, same step count — the distributed run must land on the
+same numbers.
+
+Reference anchor: the reference proves its control plane by running
+through a real (sandboxed) Flyte deployment
+(tests/integration/test_flyte_remote.py:33-57); this is the TPU-native
+equivalent with a real distributed runtime.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+from typing import Dict, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def _worker_env() -> Dict[str, str]:
+    # the worker sets its own device count; a parent's XLA_FLAGS (e.g.
+    # the test conftest's 8-device flag) must not leak in ahead of it
+    return {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+
+
+def launch_single(
+    *, local_devices: int, steps: int = 6, timeout: int = 300
+) -> dict:
+    """Run the single-process reference and return its result JSON."""
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--local-devices", str(local_devices), "--steps", str(steps)],
+        capture_output=True, text=True, timeout=timeout, env=_worker_env(),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"single-process worker failed: {out.stderr[-2000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def launch_pair(
+    *,
+    local_devices: int,
+    steps: int = 6,
+    timeout: int = 300,
+    port: Optional[int] = None,
+) -> dict:
+    """Run the 2-process ``jax.distributed`` pair; return process 0's
+    result JSON. On timeout both workers are killed and their stderr
+    tails surface in the raised error (a hung Gloo bring-up otherwise
+    leaks two live processes and all diagnostics)."""
+    import socket
+    import subprocess
+
+    if port is None:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--process-id", str(pid), "--num-processes", "2",
+             "--coordinator", f"127.0.0.1:{port}",
+             "--local-devices", str(local_devices), "--steps", str(steps)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=_worker_env(),
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=timeout))
+    except subprocess.TimeoutExpired:
+        tails = []
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            stdout, stderr = p.communicate()
+            tails.append(stderr[-1000:] if stderr else "")
+        raise RuntimeError(
+            f"multihost pair timed out after {timeout}s; worker stderr "
+            f"tails: {tails}"
+        )
+    for p, (stdout, stderr) in zip(procs, outs):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"multihost worker rc={p.returncode}: {stderr[-2000:]}"
+            )
+    return json.loads(outs[0][0].strip().splitlines()[-1])
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--process-id", type=int, default=0)
+    ap.add_argument("--num-processes", type=int, default=1)
+    ap.add_argument("--coordinator", default="127.0.0.1:12321")
+    ap.add_argument("--local-devices", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--global-batch", type=int, default=64)
+    args = ap.parse_args()
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={args.local_devices}"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    if args.num_processes > 1:
+        from unionml_tpu.parallel import multihost_initialize
+
+        assert multihost_initialize(
+            args.coordinator, args.num_processes, args.process_id
+        ), "jax.distributed bring-up failed"
+        assert jax.process_count() == args.num_processes
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu.data import local_batches, prefetch_to_device
+    from unionml_tpu.parallel import ShardingConfig, compile_step
+
+    total = args.num_processes * args.local_devices
+    cfg = ShardingConfig(data=2, fsdp=total // 2)
+
+    dim = 16
+    true_w = np.linspace(-1.0, 1.0, dim).astype(np.float32)
+
+    def global_batch(step: int):
+        # every host derives the same global batch from the step seed;
+        # local_batches then keeps only this process's rows
+        rng = np.random.default_rng(1000 + step)
+        x = rng.normal(size=(args.global_batch, dim)).astype(np.float32)
+        y = x @ true_w + 0.25
+        return x, y
+
+    def step_fn(state, batch):
+        x, y = batch
+
+        def loss_fn(w, b):
+            pred = x @ w + b
+            return jnp.mean((pred - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            state["w"], state["b"]
+        )
+        return (
+            {"w": state["w"] - 0.1 * grads[0], "b": state["b"] - 0.1 * grads[1]},
+            {"loss": loss},
+        )
+
+    state = {"w": jnp.zeros((dim,)), "b": jnp.zeros(())}
+    compiled, state = compile_step(step_fn, state, sharding=cfg, donate_state=False)
+
+    batches = (global_batch(s) for s in range(args.steps))
+    if jax.process_count() > 1:
+        batches = local_batches(batches, cfg, args.global_batch)
+    metrics = {"loss": jnp.zeros(())}
+    for batch in prefetch_to_device(batches, sharding=cfg):
+        state, metrics = compiled(state, batch)
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    checksum = jax.jit(
+        lambda s: jnp.sum(s["w"] ** 2) + s["b"] ** 2,
+        out_shardings=NamedSharding(cfg.mesh(), PartitionSpec()),
+    )(state)
+    if jax.process_index() == 0:
+        print(json.dumps({
+            "processes": jax.process_count(),
+            "devices": len(jax.devices()),
+            "steps": args.steps,
+            "loss": float(metrics["loss"]),
+            "checksum": float(checksum),
+        }))
+
+
+if __name__ == "__main__":
+    main()

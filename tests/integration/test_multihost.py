@@ -1,0 +1,34 @@
+"""True multi-process validation (SURVEY.md §5.8): a 2-process × 4-device
+``jax.distributed`` CPU run executes a dp×fsdp train step end-to-end with
+process-local data feeding, and lands on the same loss as the
+single-process 8-device run.
+
+This is the TPU-native analogue of the reference's prove-it-with-a-real-
+control-plane integration test (reference:
+tests/integration/test_flyte_remote.py:33-57): ``multihost_initialize``,
+Gloo cross-process collectives, ``make_array_from_process_local_data``
+batch assembly, and the per-process row slicing all run for real — no
+fakes anywhere in the leg.
+"""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO / "scripts"))
+
+from multihost_smoke import launch_pair, launch_single  # noqa: E402
+
+
+def test_two_process_run_matches_single_process():
+    single = launch_single(local_devices=8)
+    pair = launch_pair(local_devices=4)
+    assert single["processes"] == 1 and single["devices"] == 8
+    assert pair["processes"] == 2 and pair["devices"] == 8
+    # same seeds, same global batches, same step count — cross-process
+    # Gloo reductions may reassociate floating-point sums, hence the
+    # tight-but-not-bitwise tolerance
+    assert abs(pair["loss"] - single["loss"]) <= 1e-5 * max(1.0, abs(single["loss"]))
+    assert abs(pair["checksum"] - single["checksum"]) <= 1e-5 * abs(single["checksum"])
+    # and training actually trained
+    assert pair["loss"] < 1.0

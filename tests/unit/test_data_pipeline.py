@@ -1,0 +1,106 @@
+"""Host→HBM data path (data/pipeline.py): sharded placement, prefetch
+ordering, and the multi-host row-slicing contract. The true 2-process
+assembly runs in tests/integration/test_multihost.py; here the
+single-process semantics (process 0 owns every row) are pinned."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from unionml_tpu.data import (
+    DeviceFeed,
+    local_batches,
+    prefetch_to_device,
+    process_batch_slice,
+)
+from unionml_tpu.parallel import ShardingConfig
+
+
+def test_prefetch_preserves_order_and_sharding():
+    cfg = ShardingConfig(data=2, fsdp=4)
+    batches = [
+        (np.full((8, 4), i, np.float32), np.full((8,), i, np.float32))
+        for i in range(5)
+    ]
+    out = list(prefetch_to_device(iter(batches), sharding=cfg))
+    assert len(out) == 5
+    for i, (x, y) in enumerate(out):
+        assert float(x[0, 0]) == i and float(y[0]) == i
+        assert x.sharding.is_equivalent_to(cfg.batch_sharding(), x.ndim)
+
+
+def test_device_feed_default_placement():
+    feed = DeviceFeed()
+    arr = feed.put(np.ones((4, 2), np.float32))
+    assert isinstance(arr, jax.Array)
+
+
+def test_process_batch_slice_single_process_owns_all():
+    cfg = ShardingConfig(data=2, fsdp=4)
+    assert process_batch_slice(cfg.batch_sharding(), 16) == slice(0, 16)
+
+
+def test_process_batch_slice_rejects_row_starved_process():
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    cfg = ShardingConfig(data=2, fsdp=4)
+    # a replicated batch spec gives this process rows — fine; but a batch
+    # smaller than the shard count starves nobody single-process. The
+    # ownerless case needs multi-process, so assert the replicated case
+    # degrades to the full range instead.
+    sharding = NamedSharding(cfg.mesh(), PartitionSpec())
+    assert process_batch_slice(sharding, 8) == slice(0, 8)
+
+
+def test_local_batches_slices_global_batches():
+    cfg = ShardingConfig(data=2, fsdp=4)
+    batches = [
+        (np.arange(16, dtype=np.float32), np.arange(16, dtype=np.float32) * 2)
+        for _ in range(3)
+    ]
+    got = list(local_batches(iter(batches), cfg, 16))
+    assert len(got) == 3
+    # single process: the local slice IS the global batch
+    np.testing.assert_array_equal(got[0][0], batches[0][0])
+    np.testing.assert_array_equal(got[0][1], batches[0][1])
+
+
+def test_local_batches_feed_roundtrip_matches_direct_put():
+    """local_batches → DeviceFeed.put lands the same global values as a
+    straight sharded device_put of the global batch."""
+    cfg = ShardingConfig(data=2, fsdp=4)
+    x = np.arange(32, dtype=np.float32).reshape(16, 2)
+    direct = jax.device_put(x, cfg.batch_sharding())
+    feed = DeviceFeed(sharding=cfg)
+    via_local = feed.put(next(local_batches(iter([x]), cfg, 16)))
+    np.testing.assert_array_equal(np.asarray(direct), np.asarray(via_local))
+
+
+def test_prefetch_keeps_buffer_in_flight():
+    pulled = []
+
+    def source():
+        for i in range(4):
+            pulled.append(i)
+            yield np.full((4,), i, np.float32)
+
+    it = prefetch_to_device(source(), buffer_size=2)
+    first = next(it)
+    # buffer_size batches were eagerly pulled before the first yield —
+    # batch 1's device transfer was already in flight while the consumer
+    # processes batch 0 (the refill lands at the next pull)
+    assert pulled == [0, 1]
+    assert float(first[0]) == 0
+    second = next(it)
+    assert pulled == [0, 1, 2]
+    assert [int(b[0]) for b in [second] + list(it)] == [1, 2, 3]
+
+
+def test_batch_pytree_placement():
+    cfg = ShardingConfig(data=-1)
+    feed = DeviceFeed(sharding=cfg)
+    batch = {"x": np.ones((8, 3), np.float32), "y": np.zeros((8,), np.int32)}
+    placed = feed.put(batch)
+    assert set(placed) == {"x", "y"}
+    assert placed["x"].sharding.is_equivalent_to(cfg.batch_sharding(), 2)
+    assert jnp.issubdtype(placed["y"].dtype, jnp.integer)

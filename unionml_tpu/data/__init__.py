@@ -3,6 +3,18 @@ buffering. Batch assembly (shuffle + gather) is C++ (``_native/``) with a
 determinism-equivalent numpy fallback."""
 
 from unionml_tpu.data.native import BatchLoader, epoch_permutation
-from unionml_tpu.data.pipeline import DeviceFeed, prefetch_to_device
+from unionml_tpu.data.pipeline import (
+    DeviceFeed,
+    local_batches,
+    prefetch_to_device,
+    process_batch_slice,
+)
 
-__all__ = ["BatchLoader", "DeviceFeed", "epoch_permutation", "prefetch_to_device"]
+__all__ = [
+    "BatchLoader",
+    "DeviceFeed",
+    "epoch_permutation",
+    "local_batches",
+    "prefetch_to_device",
+    "process_batch_slice",
+]

@@ -9,8 +9,16 @@ host→device DMA of batch N+1 overlaps the compute of batch N — JAX
 dispatch is async, so a buffer of 2 suffices to hide transfer latency.
 
 When a :class:`~unionml_tpu.parallel.ShardingConfig` is given, each batch
-is placed with its data-axis NamedSharding: every host feeds only its
-addressable shards and XLA never re-lays the batch out.
+is placed with its data-axis NamedSharding. Multi-host execution
+(``jax.process_count() > 1`` after
+:func:`~unionml_tpu.parallel.multihost_initialize`) is first-class: each
+process feeds ONLY the batch rows its addressable devices own —
+:meth:`DeviceFeed.put` assembles the global array from process-local
+shards via ``jax.make_array_from_process_local_data``, and
+:func:`process_batch_slice` tells a data source which row range this
+process must read. Validated by a real 2-process × 4-device
+``jax.distributed`` run in ``tests/integration/test_multihost.py`` and
+the ``multihost_dp_fsdp`` leg of ``__graft_entry__.dryrun_multichip``.
 """
 
 from __future__ import annotations
@@ -20,8 +28,55 @@ import itertools
 from typing import Any, Iterable, Iterator
 
 
+def process_batch_slice(sharding: Any, global_batch: int) -> slice:
+    """The half-open row range of a global batch that THIS process feeds.
+
+    Computed from the sharding's device→index map restricted to this
+    process's addressable devices, so it is correct for any mesh layout
+    whose batch-dimension placement gives each process one contiguous
+    block (the standard dp/fsdp-outermost layouts). Raises when rows are
+    non-contiguous per process — feeding such a layout a contiguous
+    slice would silently scramble example↔device placement.
+    """
+    index_map = sharding.devices_indices_map((global_batch,))
+    rows = set()
+    for device, index in index_map.items():
+        if device.process_index != _process_index():
+            continue
+        sl = index[0]
+        start = sl.start if sl.start is not None else 0
+        stop = sl.stop if sl.stop is not None else global_batch
+        rows.update(range(start, stop))
+    if not rows:
+        raise ValueError(
+            "this process owns no rows of the batch sharding — was the "
+            "mesh built over all processes' devices?"
+        )
+    lo, hi = min(rows), max(rows) + 1
+    if rows != set(range(lo, hi)):
+        raise ValueError(
+            "this process's batch rows are non-contiguous under the given "
+            "sharding; feed per-device shards explicitly instead of a "
+            "contiguous process slice"
+        )
+    return slice(lo, hi)
+
+
+def _process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
 class DeviceFeed:
-    """Shard-aware device placement for host batches."""
+    """Shard-aware device placement for host batches.
+
+    Single-process: batches land via ``jax.device_put`` against the batch
+    sharding (or a given device). Multi-process: ``put`` receives this
+    process's LOCAL rows (see :func:`process_batch_slice`) and assembles
+    the global jax.Array from every process's shards — no host ever
+    materializes or transfers the full global batch.
+    """
 
     def __init__(self, sharding: Any = None, device: Any = None):
         self._sharding = None
@@ -36,10 +91,46 @@ class DeviceFeed:
         import jax
 
         if self._sharding is not None:
+            if jax.process_count() > 1:
+                import numpy as np
+
+                sharding = self._sharding
+                return jax.tree_util.tree_map(
+                    lambda x: jax.make_array_from_process_local_data(
+                        sharding, np.asarray(x)
+                    ),
+                    batch,
+                )
             return jax.device_put(batch, self._sharding)
         if self._device is not None:
             return jax.device_put(batch, self._device)
         return jax.device_put(batch)
+
+
+def local_batches(
+    iterator: Iterable[Any], sharding: Any, global_batch: int
+) -> Iterator[Any]:
+    """Slice an iterator of GLOBAL batches down to this process's rows.
+
+    For data sources that deterministically produce the same global batch
+    on every host (seeded synthetic data, a shared filesystem read): each
+    host keeps only its :func:`process_batch_slice` rows, which is what
+    :meth:`DeviceFeed.put` expects under ``jax.process_count() > 1``.
+    Sources that can seek (sharded files, SQL OFFSET) should read only
+    their slice instead and skip this wrapper.
+    """
+    sharding = (
+        sharding.batch_sharding() if hasattr(sharding, "batch_sharding") else sharding
+    )
+    sl = process_batch_slice(sharding, global_batch)
+
+    def cut(x: Any) -> Any:
+        return x[sl]
+
+    import jax
+
+    for batch in iterator:
+        yield jax.tree_util.tree_map(cut, batch)
 
 
 def prefetch_to_device(
@@ -49,7 +140,12 @@ def prefetch_to_device(
     sharding: Any = None,
     device: Any = None,
 ) -> Iterator[Any]:
-    """Yield device-resident batches, keeping ``buffer_size`` in flight."""
+    """Yield device-resident batches, keeping ``buffer_size`` in flight.
+
+    Multi-process contract: ``iterator`` yields PROCESS-LOCAL rows (wrap
+    a global-batch source with :func:`local_batches`); placement then
+    assembles global arrays per :class:`DeviceFeed`.
+    """
     feed = DeviceFeed(sharding=sharding, device=device)
     queue: collections.deque = collections.deque()
     it = iter(iterator)
