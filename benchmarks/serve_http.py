@@ -151,7 +151,12 @@ def main() -> None:
             return engine.generate(params, prompts)
 
         serving_kwargs = dict(
-            warmup=lambda params: engine.warmup(params), stats=engine.stats
+            warmup=lambda params: engine.warmup(params), stats=engine.stats,
+            # SSE token streaming (POST /predict/stream): TTFT ~ queue +
+            # prefill instead of the whole generation
+            stream=lambda params, prompts: engine.generate_stream(
+                params, prompts[0]
+            ),
         )
     else:
         predict = make_lm_predictor(
@@ -217,7 +222,7 @@ def main() -> None:
         return {
             k: stats[k]
             for k in ("queue_wait_ms", "prefill_ms", "decode_ms",
-                      "device_ms", "slot_occupancy")
+                      "ttft_ms", "device_ms", "slot_occupancy")
             if k in stats
         }
 
@@ -229,6 +234,55 @@ def main() -> None:
         "value": s["p50"], "p95_ms": s["p95"], "unit": "ms",
     }))
     reset_stats()
+
+    if args.mode == "engine":
+        # streaming: time-to-first-token at the HTTP boundary (the UX
+        # metric SSE exists for) vs the same request's full duration
+        import http.client
+
+        def stream_request():
+            conn = http.client.HTTPConnection(host, port, timeout=300)
+            t0 = time.perf_counter()
+            conn.request(
+                "POST", "/predict/stream", body=json.dumps({"features": prompt}),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200, resp.read()
+            ttft = None
+            n_tokens = 0
+            buf = b""
+            while True:
+                chunk = resp.read1(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n\n" in buf:
+                    event, buf = buf.split(b"\n\n", 1)
+                    if not event.startswith(b"data: "):
+                        continue
+                    data = json.loads(event[len(b"data: "):])
+                    if "tokens" in data:
+                        if ttft is None:
+                            ttft = (time.perf_counter() - t0) * 1e3
+                        n_tokens += len(data["tokens"])
+                    elif data.get("done"):
+                        assert data["n_tokens"] == n_tokens == args.new_tokens
+            conn.close()
+            return ttft, (time.perf_counter() - t0) * 1e3
+
+        stream_request()  # warm the path
+        reset_stats()
+        pairs = [stream_request() for _ in range(args.requests)]
+        ttft_s = percentile_summary([p[0] for p in pairs])
+        full_s = percentile_summary([p[1] for p in pairs])
+        print(json.dumps({
+            "metric": f"{preset}_http_ttft_ms", "mode": "engine-stream",
+            "clients": 1, "value": ttft_s["p50"], "p95_ms": ttft_s["p95"],
+            "full_response_p50_ms": full_s["p50"], "unit": "ms",
+            "stats": fetch_stats(),
+        }))
+        reset_stats()
 
     # concurrent clients: the micro-batcher coalesces in-flight requests
     all_lat: list = []

@@ -316,3 +316,117 @@ def test_engine_system_prefix_matches_prefixed_solo(tiny_llama):
             assert out == _solo(module, params, prefix + prompt, 6)
     finally:
         engine.close()
+
+
+def test_generate_stream_token_identity_and_chunking(tiny_llama):
+    """Streamed chunks concatenate to exactly the blocking generate()
+    output; the first chunk is the single prefill token (the TTFT event)
+    and later chunks respect the chunk_steps granularity."""
+    module, params = tiny_llama
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=12, prompt_buckets=(8,), chunk_steps=4
+    )
+    try:
+        prompt = list(range(1, 8))
+        want = engine.generate(params, [prompt])[0]
+        chunks = list(engine.generate_stream(params, prompt))
+        assert [t for c in chunks for t in c] == want
+        assert len(chunks[0]) == 1  # prefill token arrives alone
+        assert all(len(c) <= engine.chunk_steps for c in chunks[1:])
+        assert len(chunks) >= 3  # actually incremental, not one blob
+    finally:
+        engine.close()
+
+
+def test_generate_stream_concurrent_with_blocking_calls(tiny_llama):
+    """A stream interleaved with blocking generate() calls on other
+    threads keeps token identity for everyone (chunk-boundary joins)."""
+    module, params = tiny_llama
+    engine = DecodeEngine(
+        module, slots=4, max_new_tokens=8, prompt_buckets=(8,), chunk_steps=2
+    )
+    try:
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(1, 97, size=6).tolist() for _ in range(3)]
+        results = {}
+
+        def blocking(i):
+            results[i] = engine.generate(params, [prompts[i]])[0]
+
+        threads = [
+            threading.Thread(target=blocking, args=(i,)) for i in (1, 2)
+        ]
+        for t in threads:
+            t.start()
+        streamed = [t for c in engine.generate_stream(params, prompts[0]) for t in c]
+        for t in threads:
+            t.join()
+        assert streamed == _solo(module, params, prompts[0], 8)
+        for i in (1, 2):
+            assert results[i] == _solo(module, params, prompts[i], 8)
+    finally:
+        engine.close()
+
+
+def test_generate_stream_validation_and_eos(tiny_llama):
+    module, params = tiny_llama
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=8, prompt_buckets=(8,), chunk_steps=4,
+        eos_id=3,
+    )
+    try:
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            list(engine.generate_stream(params, [1, 2], max_new_tokens=99))
+        with pytest.raises(ValueError, match="empty"):
+            list(engine.generate_stream(params, []))
+        prompt = list(range(1, 8))
+        want = engine.generate(params, [prompt])[0]
+        got = [t for c in engine.generate_stream(params, prompt) for t in c]
+        assert got == want  # eos truncation identical across surfaces
+    finally:
+        engine.close()
+
+
+def test_stats_include_ttft(tiny_llama):
+    module, params = tiny_llama
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=4, prompt_buckets=(8,), chunk_steps=2
+    )
+    try:
+        engine.generate(params, [[1, 2, 3]])
+        stats = engine.stats()
+        assert "ttft_ms" in stats
+        # TTFT covers queue+prefill only — it must not exceed the full
+        # request latency (prefill + decode)
+        assert stats["ttft_ms"]["p50"] <= (
+            stats["queue_wait_ms"]["p50"] + stats["prefill_ms"]["p50"]
+            + stats["decode_ms"]["p50"] + 1e-6
+        )
+    finally:
+        engine.close()
+
+
+def test_stream_consumer_disconnect_frees_slot(tiny_llama):
+    """Closing a stream early (the SSE client-disconnect lifecycle) must
+    abandon the request so its slot stops decoding dead work."""
+    module, params = tiny_llama
+    engine = DecodeEngine(
+        module, slots=1, max_new_tokens=64, prompt_buckets=(8,), chunk_steps=2
+    )
+    try:
+        stream = engine.generate_stream(params, [1, 2, 3])
+        next(stream)       # first (prefill) chunk arrives
+        stream.close()     # GeneratorExit → abandoned
+        deadline = time.perf_counter() + 10.0
+        while time.perf_counter() < deadline:
+            with engine._lock:
+                if engine._occupant[0] is None:
+                    break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("abandoned stream's slot was never freed")
+        # the lone slot is reusable for a live request
+        out = engine.generate(params, [[4, 5, 6]], max_new_tokens=4)
+        assert len(out[0]) == 4
+    finally:
+        engine.close()

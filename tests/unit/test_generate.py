@@ -581,3 +581,35 @@ def test_lm_predictor_system_prefix_memoizes_for_lora_state(tiny_llama):
         gen_mod.make_prefix_cache = real
     assert len(calls) == 1, "prefix re-prefilled per request for a LoRA state"
     assert first == second
+
+
+def test_system_prefix_memo_warns_on_rewrapped_state():
+    """Re-wrapping the same weight buffers in a fresh state object
+    violates the memo's identity contract — the predictor must say so
+    instead of silently re-prefilling the prefix per request. (The
+    framework logger is propagate=False with a stream handler bound at
+    import time, so attach a recording handler instead of capturing
+    streams.)"""
+    import logging
+
+    from unionml_tpu._logging import logger as framework_logger
+
+    cfg = LlamaConfig.tiny(vocab_size=53)
+    module = Llama(cfg)
+    params = module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    predict = make_lm_predictor(
+        module, max_new_tokens=4, bucket_lens=(8,), system_prefix=[5, 6, 7]
+    )
+    messages = []
+    handler = logging.Handler()
+    handler.emit = lambda record: messages.append(record.getMessage())
+    framework_logger.addHandler(handler)
+    try:
+        predict(params, [[1, 2, 3]])
+        assert not any("rebuilt" in m for m in messages)
+        predict(dict(params), [[1, 2, 3]])  # same buffers, new wrapper
+        assert any("rebuilt" in m for m in messages)
+    finally:
+        framework_logger.removeHandler(handler)

@@ -224,3 +224,25 @@ def test_split_merge_roundtrip():
         lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
         full, rebuilt,
     )
+
+
+def test_tree_walkers_accept_frozendict():
+    """Checkpoint-loaded trees often arrive as flax FrozenDicts — the
+    walkers must traverse any Mapping, not just dict (a FrozenDict leaf
+    would yield zero adapters / silently drop base keys)."""
+    from flax.core import freeze
+
+    from unionml_tpu.models.lora import merge_param_trees, split_lora_params
+
+    tree = freeze({
+        "block": {
+            "q": {"kernel": np.zeros((4, 4)), "lora_a": np.ones((4, 2)),
+                  "lora_b": np.zeros((2, 4))},
+            "norm": {"scale": np.ones(4)},
+        }
+    })
+    adapters, base = split_lora_params(tree)
+    assert set(adapters["block"]["q"]) == {"lora_a", "lora_b"}
+    assert set(base["block"]) == {"q", "norm"}
+    merged = merge_param_trees(freeze(base), adapters)
+    assert set(merged["block"]["q"]) == {"kernel", "lora_a", "lora_b"}

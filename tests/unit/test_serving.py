@@ -230,3 +230,129 @@ def test_fastapi_stats_route_parity(trained_model):
     with TestClient(app) as client:
         s = client.get("/stats")
         assert s.status_code == 200 and s.json()["engine"] == "direct"
+
+
+# ---------------------------------------------------------------------------
+# SSE token streaming (POST /predict/stream)
+
+
+def _lm_serving_app(stream=True):
+    import jax
+    import jax.numpy as jnp
+
+    from unionml_tpu import Dataset, Model
+    from unionml_tpu.model import ModelArtifact
+    from unionml_tpu.models import Llama, LlamaConfig
+    from unionml_tpu.serving.engine import DecodeEngine
+
+    cfg = LlamaConfig.tiny(vocab_size=61)
+    module = Llama(cfg)
+    params = module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=10, prompt_buckets=(8,), chunk_steps=4
+    )
+    dataset = Dataset(name="sse_data", targets=[])
+
+    @dataset.reader
+    def reader() -> list:
+        return []
+
+    lm = Model(name="sse_lm", init=lambda: params, dataset=dataset)
+
+    @lm.trainer
+    def trainer(p: dict, features: list) -> dict:
+        return p
+
+    @lm.predictor
+    def predictor(p: dict, prompts: list) -> list:
+        return engine.generate(p, prompts)
+
+    lm.artifact = ModelArtifact(params, {}, {})
+    kwargs = dict(stats=engine.stats)
+    if stream:
+        kwargs["stream"] = lambda p, prompts: engine.generate_stream(p, prompts[0])
+    return ServingApp(lm, **kwargs), engine
+
+
+def _read_sse(resp):
+    events = []
+    for line in resp.iter_lines():
+        if line.startswith("data: "):
+            import json
+
+            events.append(json.loads(line[len("data: "):]))
+    return events
+
+
+def test_predict_stream_sse_token_identity():
+    app, engine = _lm_serving_app()
+    host, port = app.serve(port=0, blocking=False)
+    base = f"http://{host}:{port}"
+    prompt = list(range(1, 8))
+    try:
+        full = httpx.post(
+            f"{base}/predict", json={"features": [prompt]}, timeout=120
+        ).json()
+        with httpx.stream(
+            "POST", f"{base}/predict/stream", json={"features": prompt},
+            timeout=120,
+        ) as resp:
+            assert resp.status_code == 200
+            assert resp.headers["content-type"].startswith("text/event-stream")
+            events = _read_sse(resp)
+        assert events[-1]["done"] is True
+        streamed = [t for e in events[:-1] for t in e["tokens"]]
+        assert streamed == full[0]
+        assert events[-1]["n_tokens"] == len(streamed)
+        assert len(events) >= 3  # incremental: prefill + >=1 decode chunk
+        # the engine's stats now carry the TTFT percentile
+        stats = httpx.get(f"{base}/stats", timeout=30).json()
+        assert "ttft_ms" in stats
+    finally:
+        app.shutdown()
+        engine.close()
+
+
+def test_predict_stream_validation():
+    app, engine = _lm_serving_app()
+    host, port = app.serve(port=0, blocking=False)
+    base = f"http://{host}:{port}"
+    try:
+        # two prompts in one stream request
+        r = httpx.post(
+            f"{base}/predict/stream",
+            json={"features": [[1, 2], [3, 4]]}, timeout=60,
+        )
+        assert r.status_code == 422 and "one prompt" in r.json()["error"]
+        # inputs form is not streamable
+        r = httpx.post(
+            f"{base}/predict/stream", json={"inputs": {}}, timeout=60
+        )
+        assert r.status_code == 422
+        # empty prompt: the generator defers validation to first next();
+        # the transport must still turn it into a 422, not a dropped
+        # connection
+        r = httpx.post(
+            f"{base}/predict/stream", json={"features": []}, timeout=60
+        )
+        assert r.status_code == 422
+    finally:
+        app.shutdown()
+        engine.close()
+
+
+def test_predict_stream_disabled_is_422():
+    app, engine = _lm_serving_app(stream=False)
+    host, port = app.serve(port=0, blocking=False)
+    try:
+        r = httpx.post(
+            f"http://{host}:{port}/predict/stream",
+            json={"features": [1, 2, 3]}, timeout=60,
+        )
+        assert r.status_code == 422
+        assert "not enabled" in r.json()["error"]
+    finally:
+        app.shutdown()
+        engine.close()

@@ -171,7 +171,10 @@ def fetch_model(app_str: str, output: str, app_version, model_version: str):
 def serve(app_str: str, model_path, host: str, port: int, batch: bool, row_lists: bool):
     """Serve an app over HTTP (reference: cli.py:172-212).
 
-    APP is ``module:variable`` naming a Model or a ServingApp.
+    APP is ``module:variable`` naming a Model or a ServingApp. A
+    ServingApp constructed with ``stream=`` (e.g. wrapping
+    ``DecodeEngine.generate_stream``) additionally serves SSE token
+    streaming at ``POST /predict/stream``.
     """
     if model_path is not None:
         if not Path(model_path).exists():

@@ -401,6 +401,14 @@ def make_lm_predictor(
     identity) and broadcast into each request batch, so per-request
     prefill covers only the user prompt — outputs are exactly those of
     prepending the prefix to every prompt.
+
+    **Identity contract**: the prefix memo keys on the STATE OBJECT —
+    serving must hold one state object for the lifetime of the weights.
+    A caller that re-wraps the same buffers per call (``device_put`` per
+    request, a fresh dict from a checkpoint-reload loop) silently
+    re-prefills the shared prefix every request, degrading the ~-42%
+    p50 win back to naive; the predictor logs a warning when it detects
+    a rebuild over leaves it has already seen.
     """
     import numpy as np
 
@@ -453,7 +461,26 @@ def make_lm_predictor(
         if prefix is None:
             return None
         if prefix_state["ref"] is not state:
-            prefix_state.update(ref=state, caches={})
+            # same underlying buffers under a new wrapper object → the
+            # caller is violating the identity contract (see docstring):
+            # every request now pays a full prefix prefill. Warn rather
+            # than guess — keying on buffer ids would wrongly SHARE the
+            # memo across genuinely different states that alias a leaf.
+            leaves = jax.tree_util.tree_leaves(params)
+            leaf_id = id(leaves[0]) if leaves else None
+            if (
+                prefix_state["ref"] is not None
+                and leaf_id is not None
+                and leaf_id == prefix_state.get("leaf_id")
+            ):
+                from unionml_tpu._logging import logger
+
+                logger.info(
+                    "system_prefix cache rebuilt for a state wrapping the "
+                    "SAME weight buffers — hold one state object per "
+                    "weight set or every request re-prefills the prefix"
+                )
+            prefix_state.update(ref=state, caches={}, leaf_id=leaf_id)
         caches = prefix_state["caches"]
         if bucket not in caches:
             caches[bucket] = make_prefix_cache(

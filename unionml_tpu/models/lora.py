@@ -166,14 +166,19 @@ def split_lora_params(params: Any) -> Tuple[Any, Any]:
     optimizer sees.
     """
 
+    from collections.abc import Mapping
+
     def walk(tree):
-        if not isinstance(tree, dict):
+        # Mapping, not dict: flax FrozenDict checkpoint trees must walk
+        # like dicts — treating them as leaves would return zero adapters
+        # here and silently drop base keys in merge_param_trees
+        if not isinstance(tree, Mapping):
             return None, tree
         lora, base = {}, {}
         for key, value in tree.items():
             if key in LORA_PARAM_NAMES:
                 lora[key] = value
-            elif isinstance(value, dict):
+            elif isinstance(value, Mapping):
                 sub_lora, sub_base = walk(value)
                 if sub_lora:
                     lora[key] = sub_lora
@@ -194,7 +199,9 @@ def merge_param_trees(base: Any, overlay: Any) -> Any:
     ``merge_param_trees(frozen_base, adapter_params)`` inside the loss, so
     gradients flow only to the overlay's leaves.
     """
-    if not isinstance(base, dict) or not isinstance(overlay, dict):
+    from collections.abc import Mapping
+
+    if not isinstance(base, Mapping) or not isinstance(overlay, Mapping):
         return overlay
     out = dict(base)
     for key, value in overlay.items():

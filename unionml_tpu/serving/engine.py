@@ -18,14 +18,18 @@ This engine holds a **fixed-slot decode batch** resident on device:
   ``dynamic_update_slice`` of the produced KV rows into the slot);
 - decode runs in **chunks of ``chunk_steps`` inside one
   ``lax.scan``**, and up to ``pipeline_depth`` chunks are **dispatched
-  asynchronously** — the host never blocks on a chunk's tokens before
-  enqueueing the next; readbacks are harvested with a lag via
-  ``jax.Array.is_ready()`` polling. Device-side state donation chains
-  the chunks in dispatch order, so correctness never depends on host
-  timing. This matters enormously when the host↔device round trip is
-  slow (measured here: ~119 ms through the tunneled backend vs ~2 ms of
-  actual decode compute per step — a blocking per-chunk loop would be
-  ~5x slower than one monolithic generate);
+  asynchronously** — the dispatcher thread never blocks on a chunk's
+  tokens before enqueueing the next; a separate HARVESTER thread blocks
+  on the oldest in-flight readback and accounts its tokens.
+  (``is_ready()`` polling was measured and rejected: it serializes the
+  tunneled command stream — 226 ms/chunk vs 26.7 ms pure compute,
+  BASELINE.md round 3 — so the engine blocks in a dedicated thread
+  instead.) Device-side state donation chains the chunks in dispatch
+  order, so correctness never depends on host timing. This matters
+  enormously when the host↔device round trip is slow (measured here:
+  ~119 ms through the tunneled backend vs ~2 ms of actual decode
+  compute per step — a blocking per-chunk loop would be ~5x slower than
+  one monolithic generate);
 - finished slots (eos / token budget) are retired when their tokens are
   harvested and immediately reusable; a per-slot **generation counter**
   keeps tokens from an in-flight chunk dispatched for the *previous*
@@ -100,16 +104,31 @@ class _Request:
     tokens: List[int] = field(default_factory=list)
     event: threading.Event = field(default_factory=threading.Event)
     error: Optional[BaseException] = None
+    # streaming consumers: harvested token chunks are mirrored here as
+    # they land (lists of ints; None terminates; the terminal push
+    # follows error/event so a drained stream is a finished request)
+    stream: Optional["queue.Queue"] = None
     # observability (ms). prefill_ms and decode_ms are measured at token
     # HARVEST, so each includes one in-flight readback lag — honest at
-    # the request boundary, not a pure device timing.
+    # the request boundary, not a pure device timing. ttft_ms is
+    # submit→first-harvested-token: the latency a streaming client sees
+    # to its first event.
     queue_wait_ms: float = 0.0
     prefill_ms: float = 0.0
     decode_ms: float = 0.0
+    ttft_ms: float = 0.0
     abandoned: bool = False             # waiter gave up (timeout): retire asap
     _prefill_end: float = 0.0
     _dispatch_t: float = 0.0
     _expected: int = 0                  # tokens covered by dispatched work
+
+    def emit(self, chunk: List[int]) -> None:
+        if self.stream is not None and chunk:
+            self.stream.put(chunk)
+
+    def finish_stream(self) -> None:
+        if self.stream is not None:
+            self.stream.put(None)
 
 
 class DecodeEngine:
@@ -447,6 +466,57 @@ class DecodeEngine:
             out.append(list(req.tokens))
         return out
 
+    def generate_stream(
+        self,
+        params,
+        prompt: Sequence[int],
+        *,
+        max_new_tokens: Optional[int] = None,
+    ):
+        """Yield token chunks for ONE prompt as the engine harvests them.
+
+        The streaming surface behind ``POST /predict/stream``: the first
+        chunk arrives after prefill (one token — the TTFT event), then
+        one chunk per harvested decode chunk (``chunk_steps`` tokens at
+        the engine's natural emission granularity). Concatenating the
+        chunks yields exactly ``generate(params, [prompt])[0]`` (tested
+        in tests/unit/test_engine.py). Raises the engine's error, or
+        ``TimeoutError`` when no chunk lands within ``submit_timeout``.
+        """
+        self.bind(params)
+        n = max_new_tokens if max_new_tokens is not None else self.max_new_tokens
+        if not 1 <= n <= self.max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens {n} outside [1, {self.max_new_tokens}] "
+                "(raise the engine's max_new_tokens)"
+            )
+        row = np.asarray(prompt, dtype=np.int32).ravel()
+        if row.size == 0:
+            raise ValueError("empty prompt")
+        row = row[-self.buckets[-1]:]
+        req = _Request(prompt=row, max_new_tokens=n, stream=queue.Queue())
+        self._queue.put(req)
+        try:
+            while True:
+                try:
+                    chunk = req.stream.get(timeout=self.submit_timeout)
+                except queue.Empty:
+                    raise TimeoutError(
+                        "decode engine produced no chunk in time"
+                    ) from None
+                if chunk is None:
+                    if req.error is not None:
+                        raise req.error
+                    return
+                yield chunk
+        finally:
+            # consumer stopped early (client disconnect → GeneratorExit,
+            # timeout, error): mark abandoned so the slot is retired at
+            # the next harvest instead of decoding to max_new_tokens for
+            # a dead request
+            if not req.event.is_set():
+                req.abandoned = True
+
     def bind(self, params):
         """Set (or swap) the served weights; state allocates lazily.
 
@@ -505,7 +575,8 @@ class DecodeEngine:
             "slot_occupancy": round(occupied / max(1, steps * self.slots), 3),
         }
         if done:
-            for i, name in enumerate(("queue_wait_ms", "prefill_ms", "decode_ms")):
+            names = ("queue_wait_ms", "prefill_ms", "decode_ms", "ttft_ms")
+            for i, name in enumerate(names):
                 out[name] = percentile_summary([rec[i] for rec in done])
         return out
 
@@ -530,10 +601,12 @@ class DecodeEngine:
                 break
             req.error = RuntimeError("decode engine closed")
             req.event.set()
+            req.finish_stream()
         for req in self._occupant:
             if req is not None:
                 req.error = RuntimeError("decode engine closed")
                 req.event.set()
+                req.finish_stream()
         self._occupant = [None] * self.slots
 
     # ------------------------------------------------------------------ #
@@ -576,27 +649,35 @@ class DecodeEngine:
             req._expected = 1
         self._inflight.put(("prefill", slot, req, first))
 
+    def _req_done(self, req: _Request, tok: int) -> bool:
+        """The single stop predicate (shared by retirement and the
+        harvest loop's chunk-splitting — one home so a future stop
+        criterion cannot desync them)."""
+        return (
+            req.abandoned
+            or (self.eos_id is not None and tok == self.eos_id)
+            or len(req.tokens) >= req.max_new_tokens
+        )
+
     def _finish_if_done(self, slot: int, tok: int) -> bool:
         """Harvester thread, called with the lock held."""
         req = self._occupant[slot]
         if req is None:
             return True
-        done = (
-            req.abandoned
-            or (self.eos_id is not None and tok == self.eos_id)
-            or len(req.tokens) >= req.max_new_tokens
-        )
+        done = self._req_done(req, tok)
         if done:
             req.decode_ms = (time.perf_counter() - req._prefill_end) * 1e3
             if not req.abandoned:
                 self._completed.append(
-                    (req.queue_wait_ms, req.prefill_ms, req.decode_ms)
+                    (req.queue_wait_ms, req.prefill_ms, req.decode_ms,
+                     req.ttft_ms)
                 )
                 self._completed_total += 1
                 if len(self._completed) > 10_000:
                     del self._completed[:5_000]
             self._occupant[slot] = None
             req.event.set()
+            req.finish_stream()
         return done
 
     def _process_entry(self, entry) -> None:
@@ -610,22 +691,32 @@ class DecodeEngine:
             now = time.perf_counter()  # after the readback: prefill_ms
             with self._lock:           # includes its in-flight lag
                 req.prefill_ms = (now - req._dispatch_t) * 1e3
+                req.ttft_ms = (now - req.submitted) * 1e3
                 req._prefill_end = now
                 req.tokens.append(tok)
+                req.emit([tok])
                 self._finish_if_done(slot, tok)
             return
         _, mask, gens, toks = entry
         toks = np.asarray(toks)
         with self._lock:
-            for step_toks in toks:
-                for slot in np.flatnonzero(mask):
-                    req = self._occupant[slot]
-                    if req is None or gens[slot] != self._slot_gen[slot]:
-                        continue  # stale: dispatched for a previous occupant
+            # slot-major (steps for different slots are independent): each
+            # request's harvested tokens form ONE streamed chunk, emitted
+            # before retirement so the stream's terminal sentinel follows
+            # its final tokens
+            for slot in np.flatnonzero(mask):
+                req = self._occupant[slot]
+                if req is None or gens[slot] != self._slot_gen[slot]:
+                    continue  # stale: dispatched for a previous occupant
+                chunk: List[int] = []
+                for step_toks in toks:
                     tok = int(step_toks[slot])
                     req.tokens.append(tok)
-                    if self._finish_if_done(slot, tok):
-                        mask[slot] = False
+                    chunk.append(tok)
+                    if self._req_done(req, tok):
+                        break
+                req.emit(chunk)
+                self._finish_if_done(slot, chunk[-1])
 
     def _dispatch_chunk(self) -> bool:
         """Dispatch one decode chunk if the pipeline has a credit and any
@@ -686,6 +777,7 @@ class DecodeEngine:
             if req.abandoned:
                 req.error = TimeoutError("request abandoned before admission")
                 req.event.set()
+                req.finish_stream()
                 return
             if self._state is None:
                 self._state = self._init_state()
@@ -699,6 +791,7 @@ class DecodeEngine:
             except BaseException as exc:
                 req.error = exc
                 req.event.set()
+                req.finish_stream()
         finally:
             with self._lock:
                 self._admitting -= 1
@@ -753,6 +846,7 @@ class DecodeEngine:
                 if req is not None:
                     req.error = exc
                     req.event.set()
+                    req.finish_stream()
                     self._occupant[slot] = None
         self._state = None
         self._prefix_rows = None

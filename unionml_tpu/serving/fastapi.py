@@ -68,6 +68,22 @@ def serving_app(
         except (ValueError, KeyError, TypeError) as exc:
             raise HTTPException(status_code=422, detail=str(exc))
 
+    # sync `def`, not `async def`: FastAPI then runs it (and the body's
+    # blocking first-chunk pull — queue + prefill, ~120 ms at 8B, up to
+    # submit_timeout on a wedged engine) in the threadpool instead of
+    # freezing the event loop for every other request. The wire framing
+    # comes from the shared core.predict_stream_events, so the two
+    # transports cannot drift.
+    @app.post("/predict/stream")
+    def predict_stream(payload: dict):  # SSE token streaming
+        from fastapi.responses import StreamingResponse
+
+        try:
+            frames = core.predict_stream_events(payload)
+        except (ValueError, KeyError, TypeError) as exc:
+            raise HTTPException(status_code=422, detail=str(exc))
+        return StreamingResponse(frames, media_type="text/event-stream")
+
     @app.get("/health")
     async def health():  # reference: fastapi.py:66-70
         return core.health()

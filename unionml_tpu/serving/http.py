@@ -7,11 +7,19 @@ Same endpoint surface as the reference's FastAPI app
 - ``POST /predict`` — body ``{"inputs": {reader kwargs}}`` or
   ``{"features": ...}``; features flow through
   ``dataset.get_features`` then the (optionally micro-batched) predictor,
+- ``POST /predict/stream`` — Server-Sent Events: one ``data:`` event per
+  harvested token chunk (``{"tokens": [...]}``), terminated by
+  ``{"done": true, "n_tokens": N}``. Requires a streaming predictor
+  (``ServingApp(stream=...)`` — e.g. ``DecodeEngine.generate_stream``);
+  concatenated chunks are identical to the ``/predict`` response. Time
+  to first token ≈ queue + prefill, not the full generation — the
+  latency win streaming exists for.
 - ``GET /health`` — ``{"status": "ok", "model_loaded": bool}``,
 - ``GET /stats`` — serving observability: per-request queue-wait /
-  prefill / decode (or device) time splits from the active batcher or
-  decode engine (no reference counterpart — needed to attribute tail
-  latency between transport queueing and device time).
+  prefill / decode (or device) time splits — plus a ``ttft_ms``
+  percentile from the engine — from the active batcher or decode engine
+  (no reference counterpart — needed to attribute tail latency between
+  transport queueing and device time).
 
 Startup model loading mirrors fastapi.py:22-34: ``UNIONML_MODEL_PATH``
 env first, then the remote registry when ``remote=True``.
@@ -19,6 +27,7 @@ env first, then the remote registry when ``remote=True``.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
@@ -66,6 +75,7 @@ class ServingApp:
         model_path_env: str = "UNIONML_MODEL_PATH",
         warmup: Optional[Any] = None,
         stats: Optional[Any] = None,
+        stream: Optional[Any] = None,
         **batcher_kwargs,
     ):
         """``warmup``: optional callable invoked with the loaded model
@@ -77,7 +87,13 @@ class ServingApp:
         ``stats``: optional zero-arg callable whose dict is served at
         ``GET /stats`` (e.g. ``DecodeEngine.stats`` when the predictor
         wraps a continuous-batching engine); defaults to the
-        micro-batcher's stats when ``batch=True``."""
+        micro-batcher's stats when ``batch=True``.
+
+        ``stream``: optional ``(model_object, features) -> iterator of
+        token chunks`` enabling ``POST /predict/stream`` (SSE). Wrap
+        ``DecodeEngine.generate_stream`` — the batcher path computes all
+        tokens in one device call, so it has nothing incremental to
+        stream."""
         self.model = model
         self.remote = remote
         self.app_version = app_version
@@ -86,6 +102,7 @@ class ServingApp:
         self.batch = batch
         self.warmup = warmup
         self._stats_fn = stats
+        self._stream_fn = stream
         self._batcher = None
         self._batcher_kwargs = batcher_kwargs
         self._server: Optional[ThreadingHTTPServer] = None
@@ -163,6 +180,60 @@ class ServingApp:
             )
         )
 
+    def predict_stream(self, payload: dict):
+        """Yield token chunks for ONE prompt (the SSE event source).
+
+        ``{"features": [prompt]}`` (a single row, or a one-row list) —
+        the reader-kwargs ``inputs`` form is not streamable because it
+        runs the full predict workflow in one call.
+        """
+        if self._stream_fn is None:
+            raise ValueError(
+                "streaming is not enabled on this app — construct "
+                "ServingApp(stream=...) with an engine-backed generator"
+            )
+        if self.model.artifact is None:
+            self.setup_model()
+        features = payload.get("features")
+        if not features:
+            raise ValueError(
+                "streaming requires non-empty 'features' (a single "
+                "token-id prompt or a one-element list of prompts)"
+            )
+        rows = features if isinstance(features[0], (list, tuple)) else [features]
+        if len(rows) != 1:
+            raise ValueError(
+                f"streaming serves one prompt per request, got {len(rows)}"
+            )
+        loaded = self.model.dataset.get_features(rows)
+        return self._stream_fn(self.model.artifact.model_object, loaded)
+
+    def predict_stream_events(self, payload: dict):
+        """The SSE wire protocol, shared by every transport: an iterator
+        of pre-framed ``data: ...\\n\\n`` strings — one ``{"tokens"}``
+        event per harvested chunk, then ``{"done", "n_tokens"}``.
+
+        Validation raises BEFORE the first string exists (the first
+        chunk is pulled eagerly here — generator-backed streams defer
+        their checks to the first ``next()``, and those errors still
+        deserve a 422 response, not a committed-then-dropped 200).
+        """
+        it = iter(self.predict_stream(payload))
+        try:
+            first = [next(it)]
+        except StopIteration:
+            first = []
+
+        def frames():
+            n = 0
+            for chunk in itertools.chain(first, it):
+                toks = _to_jsonable(chunk)
+                n += len(toks)
+                yield f"data: {json.dumps({'tokens': toks})}\n\n"
+            yield f"data: {json.dumps({'done': True, 'n_tokens': n})}\n\n"
+
+        return frames()
+
     # -- stdlib HTTP transport --------------------------------------------
 
     def _make_handler(self):
@@ -192,8 +263,32 @@ class ServingApp:
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 
+            def _send_sse(self, frames):
+                """Stream pre-framed SSE strings; the connection closes
+                at end-of-stream (no Content-Length — ``Connection:
+                close`` delimits the body for HTTP/1.x clients). Once
+                the 200 is committed, a mid-stream failure can only
+                surface as a dropped connection — the SSE contract —
+                never as a second response spliced into the body."""
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                try:
+                    for frame in frames:
+                        self.wfile.write(frame.encode())
+                        self.wfile.flush()
+                except BrokenPipeError:
+                    pass  # client went away: the engine's generator
+                    # cleanup (GeneratorExit → abandoned) stops the work
+                except Exception as exc:
+                    logger.info(f"stream aborted mid-flight: {exc!r}")
+                finally:
+                    self.close_connection = True
+
             def do_POST(self):
-                if self.path != "/predict":
+                if self.path not in ("/predict", "/predict/stream"):
                     self._send(404, {"error": f"no route {self.path}"})
                     return
                 try:
@@ -203,7 +298,13 @@ class ServingApp:
                     except json.JSONDecodeError as exc:
                         self._send(422, {"error": f"request body must be JSON: {exc}"})
                         return
-                    self._send(200, app.predict(payload))
+                    if self.path == "/predict/stream":
+                        # predict_stream_events validates (and pulls the
+                        # first chunk) BEFORE this point commits a 200 —
+                        # errors here still get a whole 422/500 response
+                        self._send_sse(app.predict_stream_events(payload))
+                    else:
+                        self._send(200, app.predict(payload))
                 except (ValueError, KeyError, TypeError) as exc:
                     self._send(422, {"error": str(exc)})
                 except Exception as exc:  # unexpected: surface as 500
