@@ -41,7 +41,17 @@ def main() -> None:
     parser.add_argument("--clients", type=int, default=8)
     parser.add_argument("--prompt-len", type=int, default=64)
     parser.add_argument("--new-tokens", type=int, default=32)
-    parser.add_argument("--mode", choices=("engine", "batcher"), default="engine")
+    parser.add_argument(
+        "--mode", choices=("engine", "batcher", "auto"), default="auto",
+        help="auto (default) measures host<->device RTT and one decode "
+        "chunk at startup and picks the measured winner "
+        "(unionml_tpu.serving.auto); the decision and its evidence land "
+        "in /stats",
+    )
+    parser.add_argument(
+        "--spec-k", type=int, default=4,
+        help="speculate_k for the serve_spec preset",
+    )
     parser.add_argument("--chunk-steps", type=int, default=8)
     parser.add_argument(
         "--pipeline-depth", type=int, default=None,
@@ -87,8 +97,56 @@ def main() -> None:
     )
     if preset == "tiny":
         args.requests = min(args.requests, 3)
-    cfg = serving_config(preset)
-    if args.checkpoint:
+    spec_predict = None
+    if preset in ("serve_spec", "tiny_spec"):
+        # speculative decoding at the HTTP boundary: 8B target + 1.5B
+        # draft behind make_speculative_predictor, served through the
+        # row-list micro-batcher (the engine has no speculative path)
+        from unionml_tpu.models import make_speculative_predictor
+
+        if preset == "tiny_spec":
+            t_cfg = LlamaConfig.tiny(vocab_size=512)
+            d_cfg = LlamaConfig.tiny(
+                vocab_size=512, hidden_dim=32, num_layers=1, num_heads=2,
+                num_kv_heads=1, mlp_dim=64,
+            )
+            t_module, d_module = Llama(t_cfg), Llama(d_cfg)
+            toks = jnp.zeros((1, 8), jnp.int32)
+            qparams = {
+                "target": t_module.init(jax.random.PRNGKey(0), toks)["params"],
+                "draft": d_module.init(jax.random.PRNGKey(1), toks)["params"],
+            }
+            args.requests = min(args.requests, 3)
+        else:
+            from benchmarks.serve_latency import random_quantized_params
+
+            t_cfg = LlamaConfig(
+                **{**serving_config("serve_8b").__dict__, "quantized": True}
+            )
+            d_cfg = LlamaConfig(
+                **{**serving_config("serve_1p5b").__dict__, "quantized": True}
+            )
+            t_module, d_module = Llama(t_cfg), Llama(d_cfg)
+            qparams = {
+                "target": random_quantized_params(t_module),
+                "draft": random_quantized_params(d_module),
+            }
+        qcfg = t_cfg
+        spec_predict = make_speculative_predictor(
+            t_module, d_module, max_new_tokens=args.new_tokens,
+            bucket_lens=(args.prompt_len,), speculate_k=args.spec_k,
+        )
+        if args.mode != "batcher":
+            print(json.dumps({
+                "metric": "serving_mode_auto", "mode": "batcher",
+                "rule": "speculative predictor serves via the micro-batcher",
+            }))
+            args.mode = "batcher"
+
+    cfg = serving_config("serve_1p5b" if spec_predict is not None else preset)
+    if spec_predict is not None:
+        qmodule = None  # the spec predictor holds its own module pair
+    elif args.checkpoint:
         # REAL weights: geometry from the checkpoint's config.json,
         # serving knobs (cache size, kv_quant, attention impl) from the
         # preset; kernels stream to int8 on load without an fp tree ever
@@ -129,6 +187,19 @@ def main() -> None:
     def trainer(params: dict, features: list) -> dict:
         return params
 
+    mode_decision = None
+    if args.mode == "auto":
+        # encode the measured crossover (BASELINE.md round 3) instead of
+        # making the operator choose blind: engine iff one decode chunk
+        # costs at least one host<->device round trip
+        from unionml_tpu.serving.auto import choose_serving_mode
+
+        mode_decision = choose_serving_mode(
+            qmodule, qparams, chunk_steps=args.chunk_steps
+        )
+        args.mode = mode_decision["mode"]
+        print(json.dumps({"metric": "serving_mode_auto", **mode_decision}))
+
     if args.mode == "engine":
         from unionml_tpu.serving.engine import DecodeEngine
 
@@ -159,10 +230,13 @@ def main() -> None:
             ),
         )
     else:
-        predict = make_lm_predictor(
-            qmodule, max_new_tokens=args.new_tokens,
-            bucket_lens=(args.prompt_len,),
-        )
+        if spec_predict is not None:
+            predict = spec_predict
+        else:
+            predict = make_lm_predictor(
+                qmodule, max_new_tokens=args.new_tokens,
+                bucket_lens=(args.prompt_len,),
+            )
 
         @model.predictor
         def predictor(params: dict, prompts: list) -> list:
@@ -184,6 +258,9 @@ def main() -> None:
 
     model.artifact = ModelArtifact(qparams, {}, {})
 
+    if mode_decision is not None:
+        # /stats records the auto decision and its evidence
+        serving_kwargs["extra_stats"] = {"mode_decision": mode_decision}
     serving = ServingApp(model, **serving_kwargs)
     host, port = serving.serve(port=0, blocking=False)
 
@@ -222,7 +299,8 @@ def main() -> None:
         return {
             k: stats[k]
             for k in ("queue_wait_ms", "prefill_ms", "decode_ms",
-                      "ttft_ms", "device_ms", "slot_occupancy")
+                      "ttft_ms", "device_ms", "slot_occupancy",
+                      "mode_decision")
             if k in stats
         }
 

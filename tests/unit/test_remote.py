@@ -518,3 +518,117 @@ def test_tpuvm_image_execution_runs_in_container(tpuvm_model, monkeypatch):
                                   hyperparameters={"max_iter": 200}, n=200)
     assert artifact.metrics["test"] > 0.8
     assert any(e[0] == "docker" and e[1] == "build" for e in capture)
+
+
+# ---------------------------------------------------------------------------
+# Stage.resources are consumed at launch (reference: unionml/defaults.py:5
+# sizes the task container; here the launcher derives the runner env)
+
+
+def test_resources_env_derivation():
+    from unionml_tpu.defaults import Resources, cpu_count, resources_env
+
+    host_only = Resources(cpu="2", mem="1Gi", chips=0)
+    env = resources_env(host_only)
+    assert env["JAX_PLATFORMS"] == "cpu"  # never grab the accelerator
+    assert env["OMP_NUM_THREADS"] == "2"
+    device = Resources(cpu="500m", mem="8Gi", chips=1)
+    env = resources_env(device)
+    assert "JAX_PLATFORMS" not in env     # the accelerator stays visible
+    assert env["OMP_NUM_THREADS"] == "1"  # fractional cpu rounds up to 1
+    assert cpu_count(Resources(cpu="nonsense")) == 1
+
+
+def test_workflow_resources_take_stage_maxima():
+    from unionml_tpu.defaults import Resources
+    from unionml_tpu.remote.backend import _mem_bytes, _workflow_resources
+    from unionml_tpu.stage import Workflow, stage_from_fn
+
+    wf = Workflow("wf")
+    reader = stage_from_fn(
+        lambda: [], name="reader", owner=None,
+        resources=Resources(cpu="1", mem="512Mi", chips=0),
+    )
+    trainer = stage_from_fn(
+        lambda: None, name="trainer", owner=None,
+        resources=Resources(cpu="4", mem="8Gi", chips=1, accelerator="tpu-v5e"),
+    )
+    wf.add_node(reader, {})
+    wf.add_node(trainer, {})
+    env = _workflow_resources(wf)
+    assert env.cpu == "4" and env.chips == 1 and env.mem == "8Gi"
+    assert env.accelerator == "tpu-v5e"
+    assert _mem_bytes("512Mi") < _mem_bytes("1Gi") < _mem_bytes("2G")
+
+
+def test_manifest_env_backcompat_and_chips0():
+    from unionml_tpu.remote.backend import _manifest_env
+
+    # pre-round-4 manifests carry no resources: no overrides
+    assert _manifest_env({"app": "x:y"}, "train") == {}
+    manifest = {
+        "resources": {
+            "prep": {"cpu": "2", "mem": "1Gi", "chips": 0, "accelerator": None},
+            "train": {"cpu": "4", "mem": "8Gi", "chips": 1, "accelerator": "tpu-v5e"},
+        }
+    }
+    assert _manifest_env(manifest, "prep")["JAX_PLATFORMS"] == "cpu"
+    assert "JAX_PLATFORMS" not in _manifest_env(manifest, "train")
+    assert _manifest_env(manifest, "unknown") == {}
+
+
+def test_local_backend_applies_resources_env(fixture_model, monkeypatch):
+    """The launched runner's environment carries the derived resource env
+    (train workflow: device resources → thread caps, no platform pin)."""
+    import subprocess as sp
+
+    import unionml_tpu.remote.backend as backend_mod
+
+    model = fixture_model
+    backend = model._remote
+    backend.deploy(model, app_version="rv1")
+    manifest_path = backend.deployment_dir("rv1") / ".unionml_manifest.json"
+    assert "resources" in manifest_path.read_text()
+
+    captured = {}
+    real_popen = sp.Popen
+
+    def capture_popen(cmd, **kwargs):
+        captured["env"] = kwargs.get("env", {})
+        return real_popen(["true"], stdout=kwargs.get("stdout"),
+                          stderr=kwargs.get("stderr"))
+
+    monkeypatch.setattr(backend_mod.subprocess, "Popen", capture_popen)
+    record = backend.execute(
+        model, workflow=model.train_workflow_name, app_version="rv1",
+        inputs={}, wait=False,
+    )
+    assert record is not None
+    assert captured["env"]["OMP_NUM_THREADS"] == "4"
+    # device workflow (chips=1): the launcher must NOT pin the platform —
+    # whatever JAX_PLATFORMS the ambient env carries passes through
+    import os as _os
+
+    assert captured["env"].get("JAX_PLATFORMS") == _os.environ.get(
+        "JAX_PLATFORMS"
+    )
+
+
+def test_tpuvm_resources_env_in_ssh_command(tpuvm_model, monkeypatch):
+    model, tmp_path = tpuvm_model
+    backend = _make_tpuvm_backend(tmp_path, ["hostA"])
+    capture = []
+    _fake_transport(monkeypatch, backend, capture=capture, stub=True)
+    model._backend = backend
+    backend.deploy(model, app_version="v1")
+    record = backend.execute(model, workflow="train", app_version="v1",
+                             inputs={}, wait=False)
+    launched = backend._procs[record.execution_id]
+    try:
+        cmds = {e[1]: e[2] for e in capture if e[0] == "ssh"}
+        assert "OMP_NUM_THREADS=4" in cmds["hostA"]
+    finally:
+        for _, proc, log in launched["procs"]:
+            proc.wait(timeout=30)
+            log.close()
+        backend._procs.pop(record.execution_id, None)

@@ -34,7 +34,7 @@ from typing import IO, Any, Callable, Dict, List, NamedTuple, Optional, Tuple, T
 from unionml_tpu import type_guards
 from unionml_tpu._logging import logger
 from unionml_tpu.dataset import Dataset
-from unionml_tpu.defaults import DEFAULT_RESOURCES
+from unionml_tpu.defaults import DEFAULT_DEVICE_RESOURCES
 from unionml_tpu.stage import Stage, Workflow, stage_from_fn
 from unionml_tpu.tracking import TrackedInstance
 
@@ -275,7 +275,7 @@ class Model(TrackedInstance):
             return lambda f: self.trainer(f, **train_task_kwargs)
         type_guards.guard_trainer(fn, self.model_type, self._expected_data_types())
         self._trainer = fn
-        self._train_task_kwargs = {"resources": DEFAULT_RESOURCES, **train_task_kwargs}
+        self._train_task_kwargs = {"resources": DEFAULT_DEVICE_RESOURCES, **train_task_kwargs}
         self._train_task = None
         return fn
 
@@ -320,7 +320,7 @@ class Model(TrackedInstance):
             "accumulate_steps": accumulate_steps,
         }
         self._trainer = self._make_step_trainer()
-        self._train_task_kwargs = {"resources": DEFAULT_RESOURCES, **train_task_kwargs}
+        self._train_task_kwargs = {"resources": DEFAULT_DEVICE_RESOURCES, **train_task_kwargs}
         self._train_task = None
         return fn
 
@@ -370,7 +370,7 @@ class Model(TrackedInstance):
         type_guards.guard_predictor(fn, self.model_type, self._dataset.feature_type)
         self._predictor = fn
         self._predict_step_options = {"jit": jit, "batch_axis": batch_axis}
-        self._predict_task_kwargs = {"resources": DEFAULT_RESOURCES, **predict_task_kwargs}
+        self._predict_task_kwargs = {"resources": DEFAULT_DEVICE_RESOURCES, **predict_task_kwargs}
         self._predict_task = None
         self._predict_from_features_task = None
         return fn

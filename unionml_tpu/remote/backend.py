@@ -35,6 +35,76 @@ from pathlib import Path
 from typing import Any, Dict, List, Optional
 
 from unionml_tpu._logging import logger
+from unionml_tpu.defaults import Resources, cpu_count, resources_env
+
+
+def _workflow_resources(workflow) -> Resources:
+    """The launch-time resource envelope of a workflow: the max over its
+    stages (one launcher process hosts the whole DAG, so it must satisfy
+    the hungriest stage)."""
+    reqs = [node.stage.resources for node in workflow.nodes]
+    if not reqs:
+        return Resources()
+    return Resources(
+        cpu=str(max(cpu_count(r) for r in reqs)),
+        mem=max((r.mem for r in reqs), key=_mem_bytes),
+        chips=max(r.chips for r in reqs),
+        accelerator=next(
+            (r.accelerator for r in reqs if r.accelerator is not None), None
+        ),
+    )
+
+
+def _mem_bytes(mem: str) -> int:
+    """Parse k8s-style memory ("1Gi", "512Mi", "2G") for comparison."""
+    units = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "K": 10**3, "M": 10**6, "G": 10**9}
+    raw = str(mem).strip()
+    for suffix, mult in sorted(units.items(), key=lambda kv: -len(kv[0])):
+        if raw.endswith(suffix):
+            try:
+                return int(float(raw[: -len(suffix)]) * mult)
+            except ValueError:
+                return 0
+    try:
+        return int(float(raw))
+    except ValueError:
+        return 0
+
+
+def _model_resources_table(model) -> Dict[str, Dict[str, Any]]:
+    """Per-workflow resource records for the deploy manifest. Workflows
+    that cannot build yet (a trainer-only app has no predictor) are
+    simply absent — deploy must not demand more of the model than
+    execution will (the pre-round-4 behavior recorded names only)."""
+    table: Dict[str, Dict[str, Any]] = {}
+    for build in (
+        model.train_workflow,
+        model.predict_workflow,
+        model.predict_from_features_workflow,
+    ):
+        try:
+            wf = build()
+        except Exception:
+            continue
+        table[wf.name] = asdict(_workflow_resources(wf))
+    return table
+
+
+def _manifest_env(manifest: Dict[str, Any], workflow: str) -> Dict[str, str]:
+    """Runner env derived from the deployed manifest's resource record
+    (absent on pre-round-4 manifests → no overrides, old behavior)."""
+    table = manifest.get("resources") or {}
+    rec = table.get(workflow)
+    if rec is None:
+        # executions may name workflows by their short form ("train")
+        # while the manifest records "<model>.train"
+        rec = next(
+            (r for name, r in table.items() if name.endswith(f".{workflow}")),
+            None,
+        )
+    if not rec:
+        return {}
+    return resources_env(Resources(**rec))
 
 DEFAULT_ROOT_ENV = "UNIONML_TPU_HOME"
 DEFAULT_ROOT = "~/.unionml_tpu"
@@ -123,6 +193,11 @@ class BaseBackend:
                 model.predict_workflow_name,
                 model.predict_from_features_workflow_name,
             ],
+            # per-workflow resource maxima (reference parity:
+            # unionml/defaults.py:5 sizes the task container; here the
+            # launcher derives the runner env from these — defaults.py
+            # resources_env)
+            "resources": _model_resources_table(model),
         }
         (dest / ".unionml_manifest.json").write_text(json.dumps(manifest, indent=2))
         logger.info(f"deployed {n} files to {dest}")
@@ -266,6 +341,12 @@ class LocalBackend(BaseBackend):
         )
         env["UNIONML_TPU_HOME"] = str(self.root)
         env["UNIONML_TPU_PROJECT"] = self.project
+        res_env = _manifest_env(manifest, record.workflow)
+        if res_env:
+            env.update(res_env)
+            logger.info(
+                f"resources applied to {record.workflow}: {res_env}"
+            )
         log = open(Path(record.exec_dir) / "runner.log", "w")
         proc = subprocess.Popen(cmd, cwd=dep_dir, env=env, stdout=log, stderr=log)
         (Path(record.exec_dir) / "pid").write_text(str(proc.pid))
@@ -556,6 +637,13 @@ class TPUVMBackend(BaseBackend):
                 "UNIONML_TPU_HOME": str(self.root),
                 "UNIONML_TPU_PROJECT": self.project,
             }
+            res_env = _manifest_env(manifest, record.workflow)
+            if res_env:
+                env.update(res_env)
+                logger.info(
+                    f"resources applied to {record.workflow} on {host}: "
+                    f"{res_env}"
+                )
             if len(self.hosts) > 1:
                 # single-host VMs skip jax.distributed entirely
                 env.update({

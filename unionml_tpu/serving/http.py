@@ -76,6 +76,7 @@ class ServingApp:
         warmup: Optional[Any] = None,
         stats: Optional[Any] = None,
         stream: Optional[Any] = None,
+        extra_stats: Optional[dict] = None,
         **batcher_kwargs,
     ):
         """``warmup``: optional callable invoked with the loaded model
@@ -93,7 +94,12 @@ class ServingApp:
         token chunks`` enabling ``POST /predict/stream`` (SSE). Wrap
         ``DecodeEngine.generate_stream`` — the batcher path computes all
         tokens in one device call, so it has nothing incremental to
-        stream."""
+        stream.
+
+        ``extra_stats``: optional static dict merged into every
+        ``GET /stats`` response (deployment metadata — e.g. the
+        serving-mode auto-selection decision from
+        :func:`unionml_tpu.serving.auto.choose_serving_mode`)."""
         self.model = model
         self.remote = remote
         self.app_version = app_version
@@ -103,6 +109,7 @@ class ServingApp:
         self.warmup = warmup
         self._stats_fn = stats
         self._stream_fn = stream
+        self._extra_stats = dict(extra_stats or {})
         self._batcher = None
         self._batcher_kwargs = batcher_kwargs
         self._server: Optional[ThreadingHTTPServer] = None
@@ -151,10 +158,12 @@ class ServingApp:
 
     def stats(self) -> dict:
         if self._stats_fn is not None:
-            return dict(self._stats_fn())
-        if self._batcher is not None:
-            return self._batcher.stats()
-        return {"engine": "direct"}  # per-request predictor calls: no queue
+            base = dict(self._stats_fn())
+        elif self._batcher is not None:
+            base = self._batcher.stats()
+        else:
+            base = {"engine": "direct"}  # per-request predictors: no queue
+        return {**base, **self._extra_stats} if self._extra_stats else base
 
     def reset_stats(self) -> None:
         """Zero the batcher's observability window (no-op for direct or
