@@ -99,6 +99,13 @@ def main() -> None:
         args.requests = min(args.requests, 3)
     spec_predict = None
     if preset in ("serve_spec", "tiny_spec"):
+        if args.checkpoint:
+            # silently serving random weights while reporting them as
+            # the checkpoint's numbers would poison the record
+            raise SystemExit(
+                "--checkpoint is not supported with the speculative "
+                "presets (they build a synthetic target/draft pair)"
+            )
         # speculative decoding at the HTTP boundary: 8B target + 1.5B
         # draft behind make_speculative_predictor, served through the
         # row-list micro-batcher (the engine has no speculative path)
@@ -143,10 +150,10 @@ def main() -> None:
             }))
             args.mode = "batcher"
 
-    cfg = serving_config("serve_1p5b" if spec_predict is not None else preset)
     if spec_predict is not None:
-        qmodule = None  # the spec predictor holds its own module pair
-    elif args.checkpoint:
+        cfg = None      # the spec predictor holds its own module pair;
+        qmodule = None  # the per-preset serving config never applies
+    elif (cfg := serving_config(preset)) and args.checkpoint:
         # REAL weights: geometry from the checkpoint's config.json,
         # serving knobs (cache size, kv_quant, attention impl) from the
         # preset; kernels stream to int8 on load without an fp tree ever

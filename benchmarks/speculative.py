@@ -66,7 +66,9 @@ def make_boosted_target(target_config):
             boost = self.param("boost", nn.initializers.zeros, ())
             out = Llama(self.config, name="inner")(tokens, **kwargs)
             logits, cache = out if isinstance(out, tuple) else (out, None)
-            if tokens.shape[1] > 1:
+            # prefill passes logit_index (one position's logits, never
+            # compared to a next input) — boost only the verify shape
+            if tokens.shape[1] > 1 and kwargs.get("logit_index") is None:
                 nudge = boost * jax.nn.one_hot(
                     tokens[:, 1:], logits.shape[-1], dtype=logits.dtype
                 )

@@ -109,24 +109,26 @@ def make_speculative_generator(
         # the NEXT forward (standard KV bookkeeping)
         t_cache = init_cache(t_cfg, batch, total)
         d_cache = init_cache(d_cfg, batch, total)
-        t_logits, t_cache = target.apply(
-            {"params": target_params}, tokens, cache=t_cache,
-            cache_index=jnp.int32(0),
-        )
-        _, d_cache = draft.apply(
-            {"params": draft_params}, tokens, cache=d_cache,
-            cache_index=jnp.int32(0),
-        )
         if true_lens is None:
             true_lens = jnp.full((batch,), prompt_len, jnp.int32)
         else:
             true_lens = jnp.asarray(true_lens, jnp.int32)
-        # each row's first token reads its last REAL position (causal
+        # head on each row's last REAL position only (logit_index): the
+        # full-sequence head would materialize [B, S, vocab] fp32 — the
+        # same last-position trick the plain generator uses (causal
         # prefill: positions < true_len never attend the right-padding)
-        last_logits = jnp.take_along_axis(
-            t_logits, (true_lens - 1)[:, None, None], axis=1
-        )[:, 0]
-        first = jnp.argmax(last_logits, -1).astype(jnp.int32)  # [B]
+        t_logits, t_cache = target.apply(
+            {"params": target_params}, tokens, cache=t_cache,
+            cache_index=jnp.int32(0), logit_index=true_lens - 1,
+        )
+        # the draft's prefill logits are never read: logit_index=0 makes
+        # the head a [B, 1, V] stub that XLA dead-code-eliminates
+        _, d_cache = draft.apply(
+            {"params": draft_params}, tokens, cache=d_cache,
+            cache_index=jnp.int32(0),
+            logit_index=jnp.zeros((batch,), jnp.int32),
+        )
+        first = jnp.argmax(t_logits[:, 0], -1).astype(jnp.int32)  # [B]
 
         out = jnp.full((batch, max_new_tokens + k + 1), pad_id, jnp.int32)
         out = out.at[:, 0].set(first)

@@ -45,19 +45,25 @@ def _workflow_resources(workflow) -> Resources:
     reqs = [node.stage.resources for node in workflow.nodes]
     if not reqs:
         return Resources()
+    hungriest = max(reqs, key=lambda r: r.chips)
     return Resources(
         cpu=str(max(cpu_count(r) for r in reqs)),
         mem=max((r.mem for r in reqs), key=_mem_bytes),
-        chips=max(r.chips for r in reqs),
-        accelerator=next(
-            (r.accelerator for r in reqs if r.accelerator is not None), None
-        ),
+        chips=hungriest.chips,
+        # the accelerator TYPE must come from the stage that asked for
+        # the most chips — pairing max-chips with another stage's type
+        # would provision the wrong hardware
+        accelerator=hungriest.accelerator
+        or next((r.accelerator for r in reqs if r.accelerator), None),
     )
 
 
 def _mem_bytes(mem: str) -> int:
     """Parse k8s-style memory ("1Gi", "512Mi", "2G") for comparison."""
-    units = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "K": 10**3, "M": 10**6, "G": 10**9}
+    units = {
+        "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+        "K": 10**3, "M": 10**6, "G": 10**9, "T": 10**12, "P": 10**15,
+    }
     raw = str(mem).strip()
     for suffix, mult in sorted(units.items(), key=lambda kv: -len(kv[0])):
         if raw.endswith(suffix):
@@ -84,7 +90,11 @@ def _model_resources_table(model) -> Dict[str, Dict[str, Any]]:
     ):
         try:
             wf = build()
-        except Exception:
+        except ValueError:
+            # the registration guards ("has no predictor/trainer") — a
+            # trainer-only app legitimately lacks predict workflows.
+            # Anything else (a real dataset/model bug) must fail the
+            # deploy, not silently drop the workflow's resource record.
             continue
         table[wf.name] = asdict(_workflow_resources(wf))
     return table
