@@ -260,3 +260,116 @@ def test_merge_pretrained_rejects_unknown_and_mismatched(tmp_path):
         merge_pretrained(base, {"b": {"w": np.zeros((2, 2))}})
     with pytest.raises(ValueError, match="shape"):
         merge_pretrained(base, {"a": {"w": np.zeros((3, 2))}})
+
+
+# ---------------------------------------------------------------------------
+# Mixtral (MoE) mapping
+
+
+@pytest.fixture(scope="module")
+def tiny_moe():
+    cfg = LlamaConfig.tiny(num_experts=4, num_selected=2, dtype="float32")
+    params = Llama(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    return cfg, params
+
+
+def test_moe_roundtrip_bit_exact(tiny_moe, tmp_path):
+    cfg, params = tiny_moe
+    export_llama_safetensors(params, cfg, str(tmp_path))
+    loaded, loaded_cfg = load_llama_checkpoint(
+        str(tmp_path), dtype=jnp.float32, strict=True
+    )
+    assert loaded_cfg.num_experts == 4 and loaded_cfg.num_selected == 2
+    _assert_trees_equal(params, loaded)
+    # the router must stay fp32 even under a bf16 serving load
+    bf16, _ = load_llama_checkpoint(str(tmp_path), cfg)
+    assert bf16["block_0"]["moe"]["router_kernel"].dtype == jnp.float32
+    assert bf16["block_0"]["moe"]["w_gate"].dtype == jnp.bfloat16
+
+
+def test_moe_streamed_int8_matches_quantize_params(tiny_moe, tmp_path):
+    cfg, params = tiny_moe
+    export_llama_safetensors(params, cfg, str(tmp_path))
+    streamed, _ = load_llama_checkpoint(str(tmp_path), cfg, quantize=True)
+    reference = quantize_params(params, LLAMA_QUANT_PATTERNS)
+    moe_s = streamed["block_0"]["moe"]
+    moe_r = reference["block_0"]["moe"]
+    for name in ("w_gate", "w_up", "w_down"):
+        np.testing.assert_array_equal(
+            np.asarray(moe_s[f"{name}_q"]), np.asarray(moe_r[f"{name}_q"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(moe_s[f"{name}_scale"]),
+            np.asarray(moe_r[f"{name}_scale"]),
+        )
+    # and the quantized tree actually runs
+    qcfg = LlamaConfig.tiny(num_experts=4, num_selected=2, quantized=True)
+    logits = Llama(qcfg).apply(
+        {"params": streamed}, jnp.zeros((1, 4), jnp.int32)
+    )
+    assert logits.shape == (1, 4, cfg.vocab_size)
+
+
+# ---------------------------------------------------------------------------
+# ViT mapping
+
+
+def test_vit_roundtrip_bit_exact(tmp_path):
+    from unionml_tpu.models import ViT, ViTConfig
+    from unionml_tpu.models.convert import (
+        export_vit_safetensors,
+        load_vit_checkpoint,
+    )
+
+    cfg = ViTConfig.tiny()
+    cfg = type(cfg)(**{**cfg.__dict__, "qkv_bias": True, "dtype": "float32"})
+    module = ViT(cfg)
+    params = module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, cfg.image_size, cfg.image_size, 3))
+    )["params"]
+    export_vit_safetensors(params, cfg, str(tmp_path))
+    loaded, loaded_cfg = load_vit_checkpoint(
+        str(tmp_path), num_classes=cfg.num_classes, dtype=jnp.float32,
+        image_size=cfg.image_size, patch_size=cfg.patch_size,
+    )
+    assert loaded_cfg.qkv_bias and loaded_cfg.hidden_dim == cfg.hidden_dim
+    _assert_trees_equal(params, loaded)
+
+
+def test_vit_biasfree_roundtrip(tmp_path):
+    """The zoo's default (qkv_bias=False) ViT round-trips too — bias
+    specs are emitted only when the config carries biases."""
+    from unionml_tpu.models import ViT, ViTConfig
+    from unionml_tpu.models.convert import (
+        export_vit_safetensors,
+        load_vit_checkpoint,
+    )
+
+    cfg = ViTConfig.tiny()
+    cfg = type(cfg)(**{**cfg.__dict__, "dtype": "float32"})
+    module = ViT(cfg)
+    params = module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, cfg.image_size, cfg.image_size, 3))
+    )["params"]
+    export_vit_safetensors(params, cfg, str(tmp_path))
+    loaded, loaded_cfg = load_vit_checkpoint(
+        str(tmp_path), num_classes=cfg.num_classes, dtype=jnp.float32,
+        image_size=cfg.image_size, patch_size=cfg.patch_size,
+    )
+    assert not loaded_cfg.qkv_bias
+    _assert_trees_equal(params, loaded)
+
+
+def test_llama_export_preserves_rope_scaling_and_eps(tmp_path):
+    cfg = LlamaConfig.tiny(
+        rope_scaling=(8.0, 1.0, 4.0, 32), norm_eps=1e-6, dtype="float32"
+    )
+    params = Llama(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    export_llama_safetensors(params, cfg, str(tmp_path))
+    _, loaded_cfg = load_llama_checkpoint(str(tmp_path), dtype=jnp.float32)
+    assert loaded_cfg.rope_scaling == (8.0, 1.0, 4.0, 32)
+    assert loaded_cfg.norm_eps == 1e-6

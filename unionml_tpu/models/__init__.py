@@ -38,10 +38,13 @@ from unionml_tpu.models.convert import (
     bert_config_from_hf,
     export_bert_safetensors,
     export_llama_safetensors,
+    export_vit_safetensors,
     llama_config_from_hf,
     load_bert_checkpoint,
     load_llama_checkpoint,
+    load_vit_checkpoint,
     merge_pretrained,
+    vit_config_from_hf,
 )
 from unionml_tpu.models.generate import (
     PrefixCache,

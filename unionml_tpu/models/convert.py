@@ -46,14 +46,19 @@ from unionml_tpu.models.llama import LlamaConfig
 
 __all__ = [
     "TensorSpec",
+    "GroupSpec",
     "llama_tensor_specs",
     "bert_tensor_specs",
+    "vit_tensor_specs",
     "llama_config_from_hf",
     "bert_config_from_hf",
+    "vit_config_from_hf",
     "load_llama_checkpoint",
     "load_bert_checkpoint",
+    "load_vit_checkpoint",
     "export_llama_safetensors",
     "export_bert_safetensors",
+    "export_vit_safetensors",
     "merge_pretrained",
 ]
 
@@ -78,6 +83,29 @@ class TensorSpec:
     # absent-from-checkpoint tolerated (e.g. the pooler in bare-encoder
     # BERT checkpoints) — the loader skips instead of raising
     optional: bool = False
+    # never cast to the serving dtype (fp32-by-contract leaves: the MoE
+    # router master weights)
+    keep_dtype: bool = False
+
+
+@dataclass(frozen=True)
+class GroupSpec:
+    """N checkpoint tensors ↔ one stacked framework param (MoE experts:
+    HF Mixtral stores per-expert ``w1/w2/w3`` matrices; the zoo stacks
+    them as ``[E, K, N]`` so expert parallelism can shard the leading
+    axis). The transforms are PER ELEMENT (stacking/unstacking along the
+    leading axis is the loader's job) so the streaming contract holds:
+    one expert tensor is resident at a time, written into a
+    preallocated stack — never ``E`` tensors plus a stacked copy.
+    ``quantizable`` groups stream through the per-(expert, out-channel)
+    int8 recipe one expert at a time (bit-identical to
+    ``quantize_params``'s vmapped form — vmap of the same 2D kernel)."""
+
+    path: Tuple[str, ...]
+    hf_names: Tuple[str, ...]
+    to_framework: Callable[[np.ndarray], np.ndarray]
+    to_hf: Callable[[np.ndarray], np.ndarray]
+    quantizable: bool = False
 
 
 def _ident(w: np.ndarray) -> np.ndarray:
@@ -128,24 +156,23 @@ def _head_bias(heads: int, head_dim: int):
 # Llama
 
 
-def llama_tensor_specs(config: LlamaConfig) -> List[TensorSpec]:
-    """The HF-Llama ↔ :class:`~unionml_tpu.models.llama.Llama` tensor map.
+def llama_tensor_specs(config: LlamaConfig) -> List[Any]:
+    """The HF-Llama/Mixtral ↔ :class:`~unionml_tpu.models.llama.Llama`
+    tensor map.
 
-    Covers the dense (non-MoE) family: embed, per-block attention
-    q/k/v/o + norms + SwiGLU MLP, final norm, LM head (falling back to
-    the tied ``model.embed_tokens.weight`` when ``lm_head.weight`` is
-    absent, as in Llama-3.2-1B/3B checkpoints).
+    Dense family: embed, per-block attention q/k/v/o + norms + SwiGLU
+    MLP, final norm, LM head (falling back to the tied
+    ``model.embed_tokens.weight`` when ``lm_head.weight`` is absent, as
+    in Llama-3.2-1B/3B checkpoints). With ``config.num_experts`` the MLP
+    entries become the Mixtral block-sparse layout: fp32 router + three
+    per-expert :class:`GroupSpec` stacks.
     """
-    if config.num_experts:
-        raise NotImplementedError(
-            "HF MoE (Mixtral) checkpoint mapping is not implemented; "
-            "llama_tensor_specs covers the dense Llama family"
-        )
     hd = config.head_dim
     qf, qi = _split_heads(config.num_heads, hd)
     kf, ki = _split_heads(config.num_kv_heads, hd)
     of, oi = _merge_heads(config.num_heads, hd)
-    specs: List[TensorSpec] = [
+
+    specs: List[Any] = [
         TensorSpec(
             ("embed", "embedding"), "model.embed_tokens.weight", _ident, _ident
         ),
@@ -160,10 +187,43 @@ def llama_tensor_specs(config: LlamaConfig) -> List[TensorSpec]:
             TensorSpec((b, "attn", "o", "kernel"), f"{L}.self_attn.o_proj.weight", of, oi, True),
             TensorSpec((b, "attn_norm", "scale"), f"{L}.input_layernorm.weight", _ident, _ident),
             TensorSpec((b, "mlp_norm", "scale"), f"{L}.post_attention_layernorm.weight", _ident, _ident),
-            TensorSpec((b, "mlp", "gate", "kernel"), f"{L}.mlp.gate_proj.weight", _t, _t, True),
-            TensorSpec((b, "mlp", "up", "kernel"), f"{L}.mlp.up_proj.weight", _t, _t, True),
-            TensorSpec((b, "mlp", "down", "kernel"), f"{L}.mlp.down_proj.weight", _t, _t, True),
         ]
+        if config.num_experts:
+            # Mixtral block-sparse MoE: per-expert w1 (gate) / w3 (up) /
+            # w2 (down) stack into the zoo's [E, K, N] layout (expert
+            # parallelism shards the leading axis); the router stays
+            # fp32 BY CONTRACT (tiny routing updates round to zero in
+            # bf16 — ops/moe.py), hence keep_dtype. Routing semantics
+            # match: both renormalize the top-k softmax weights.
+            M = f"{L}.block_sparse_moe"
+            experts = range(config.num_experts)
+            specs += [
+                TensorSpec(
+                    (b, "moe", "router_kernel"), f"{M}.gate.weight",
+                    _t, _t, keep_dtype=True,
+                ),
+                GroupSpec(
+                    (b, "moe", "w_gate"),
+                    tuple(f"{M}.experts.{e}.w1.weight" for e in experts),
+                    _t, _t, True,
+                ),
+                GroupSpec(
+                    (b, "moe", "w_up"),
+                    tuple(f"{M}.experts.{e}.w3.weight" for e in experts),
+                    _t, _t, True,
+                ),
+                GroupSpec(
+                    (b, "moe", "w_down"),
+                    tuple(f"{M}.experts.{e}.w2.weight" for e in experts),
+                    _t, _t, True,
+                ),
+            ]
+        else:
+            specs += [
+                TensorSpec((b, "mlp", "gate", "kernel"), f"{L}.mlp.gate_proj.weight", _t, _t, True),
+                TensorSpec((b, "mlp", "up", "kernel"), f"{L}.mlp.up_proj.weight", _t, _t, True),
+                TensorSpec((b, "mlp", "down", "kernel"), f"{L}.mlp.down_proj.weight", _t, _t, True),
+            ]
     specs.append(
         TensorSpec(
             ("final_norm", "scale"), "model.norm.weight", _ident, _ident
@@ -198,6 +258,11 @@ def llama_config_from_hf(config_json: Dict[str, Any], **overrides: Any) -> Llama
         norm_eps=float(config_json.get("rms_norm_eps", 1e-5)),
         max_len=config_json.get("max_position_embeddings", 8192),
     )
+    if config_json.get("num_local_experts"):
+        # Mixtral block-sparse MoE (routing semantics match: both this
+        # zoo and HF renormalize the top-k softmax weights)
+        kwargs["num_experts"] = config_json["num_local_experts"]
+        kwargs["num_selected"] = config_json.get("num_experts_per_tok", 2)
     scaling = config_json.get("rope_scaling")
     if scaling:
         # Llama-3.1/3.2 long-context checkpoints; silently dropping this
@@ -308,6 +373,182 @@ def bert_config_from_hf(config_json: Dict[str, Any], **overrides: Any) -> BertCo
 
 
 # ---------------------------------------------------------------------------
+# ViT
+
+
+def vit_tensor_specs(config: "ViTConfig") -> List[TensorSpec]:
+    """The HF-ViT ↔ :class:`~unionml_tpu.models.vit.ViT` tensor map.
+
+    Pre-LN blocks map one-to-one (``layernorm_before``→``ln1``,
+    ``layernorm_after``→``ln2``); the patch conv transposes torch OIHW →
+    flax HWIO; q/k/v/o carry biases (``ViTConfig.qkv_bias=True``). The
+    classification ``head`` maps from ``classifier.*`` when present
+    (ViTForImageClassification) and is otherwise the fine-tune target.
+    """
+    hd = config.hidden_dim // config.num_heads
+    qf, qi = _split_heads(config.num_heads, hd)
+    of, oi = _merge_heads(config.num_heads, hd)
+    bf, bi = _head_bias(config.num_heads, hd)
+
+    def conv_fwd(w: np.ndarray) -> np.ndarray:   # OIHW → HWIO
+        return np.ascontiguousarray(w.transpose(2, 3, 1, 0))
+
+    def conv_inv(w: np.ndarray) -> np.ndarray:
+        return np.ascontiguousarray(w.transpose(3, 2, 0, 1))
+
+    specs: List[TensorSpec] = [
+        TensorSpec(("cls",), "embeddings.cls_token", _ident, _ident),
+        TensorSpec(("pos_embed",), "embeddings.position_embeddings", _ident, _ident),
+        TensorSpec(
+            ("patch_embed", "kernel"),
+            "embeddings.patch_embeddings.projection.weight", conv_fwd, conv_inv,
+        ),
+        TensorSpec(
+            ("patch_embed", "bias"),
+            "embeddings.patch_embeddings.projection.bias", _ident, _ident,
+        ),
+    ]
+    hf_names = {"q": "query", "k": "key", "v": "value"}
+    for i in range(config.num_layers):
+        b = f"block_{i}"
+        L = f"encoder.layer.{i}"
+        for ours, theirs in hf_names.items():
+            specs.append(TensorSpec(
+                (b, "attn", ours, "kernel"),
+                f"{L}.attention.attention.{theirs}.weight", qf, qi,
+            ))
+            if config.qkv_bias:
+                # bias-free configs (the zoo's trained-from-scratch
+                # default) have no bias params to fill — emitting the
+                # specs anyway would reject bias-free checkpoints
+                specs.append(TensorSpec(
+                    (b, "attn", ours, "bias"),
+                    f"{L}.attention.attention.{theirs}.bias", bf, bi,
+                ))
+        specs.append(TensorSpec(
+            (b, "attn", "o", "kernel"),
+            f"{L}.attention.output.dense.weight", of, oi,
+        ))
+        if config.qkv_bias:
+            specs.append(TensorSpec(
+                (b, "attn", "o", "bias"),
+                f"{L}.attention.output.dense.bias", _ident, _ident,
+            ))
+        specs += [
+            TensorSpec((b, "ln1", "scale"), f"{L}.layernorm_before.weight", _ident, _ident),
+            TensorSpec((b, "ln1", "bias"), f"{L}.layernorm_before.bias", _ident, _ident),
+            TensorSpec((b, "ln2", "scale"), f"{L}.layernorm_after.weight", _ident, _ident),
+            TensorSpec((b, "ln2", "bias"), f"{L}.layernorm_after.bias", _ident, _ident),
+            TensorSpec((b, "mlp", "up", "kernel"), f"{L}.intermediate.dense.weight", _t, _t),
+            TensorSpec((b, "mlp", "up", "bias"), f"{L}.intermediate.dense.bias", _ident, _ident),
+            TensorSpec((b, "mlp", "down", "kernel"), f"{L}.output.dense.weight", _t, _t),
+            TensorSpec((b, "mlp", "down", "bias"), f"{L}.output.dense.bias", _ident, _ident),
+        ]
+    specs += [
+        TensorSpec(("ln_final", "scale"), "layernorm.weight", _ident, _ident),
+        TensorSpec(("ln_final", "bias"), "layernorm.bias", _ident, _ident),
+        TensorSpec(("head", "kernel"), "classifier.weight", _t, _t, optional=True),
+        TensorSpec(("head", "bias"), "classifier.bias", _ident, _ident, optional=True),
+    ]
+    return specs
+
+
+def vit_config_from_hf(config_json: Dict[str, Any], **overrides: Any):
+    """Build a :class:`~unionml_tpu.models.vit.ViTConfig` from an HF
+    ``config.json`` dict (checkpoint-faithful: qkv biases + erf GELU)."""
+    from unionml_tpu.models.vit import ViTConfig
+
+    act = config_json.get("hidden_act", "gelu")
+    if act not in ("gelu", "gelu_new", "gelu_pytorch_tanh"):
+        raise NotImplementedError(
+            f"hidden_act {act!r} is not supported (gelu variants only)"
+        )
+    kwargs: Dict[str, Any] = dict(
+        image_size=config_json.get("image_size", 224),
+        patch_size=config_json.get("patch_size", 16),
+        hidden_dim=config_json["hidden_size"],
+        num_layers=config_json["num_hidden_layers"],
+        num_heads=config_json["num_attention_heads"],
+        mlp_dim=config_json["intermediate_size"],
+        qkv_bias=config_json.get("qkv_bias", True),
+        gelu_exact=(act == "gelu"),
+    )
+    if config_json.get("id2label"):
+        kwargs["num_classes"] = len(config_json["id2label"])
+    kwargs.update(overrides)
+    return ViTConfig(**kwargs)
+
+
+def load_vit_checkpoint(
+    path: str,
+    config: Any = None,
+    *,
+    dtype: Any = jnp.float32,
+    device: Any = None,
+    **config_overrides: Any,
+) -> Tuple[Dict[str, Any], Any]:
+    """Stream an HF ViT safetensors checkpoint into framework params.
+
+    Returns ``(params, config)``. Handles both bare ``ViTModel`` names
+    and ``ViTForImageClassification`` checkpoints (``vit.`` prefix +
+    ``classifier`` head); without a classifier the ``head`` is absent —
+    combine with a fresh init via :func:`merge_pretrained`.
+    """
+    if config is None:
+        cfg_path = os.path.join(path, "config.json") if os.path.isdir(path) else None
+        if cfg_path is None or not os.path.exists(cfg_path):
+            raise FileNotFoundError(
+                "config=None needs a checkpoint DIRECTORY with config.json "
+                f"(got {path!r})"
+            )
+        with open(cfg_path) as f:
+            config = vit_config_from_hf(json.load(f), **config_overrides)
+    specs = vit_tensor_specs(config)
+    reader = _CheckpointReader(path)
+    if specs[0].hf_name not in reader and f"vit.{specs[0].hf_name}" in reader:
+        import dataclasses
+
+        specs = [
+            s if s.hf_name.startswith("classifier")
+            else dataclasses.replace(s, hf_name=f"vit.{s.hf_name}")
+            for s in specs
+        ]
+    params = _load_checkpoint(
+        path, specs, quantize=False, dtype=dtype, device=device, strict=False,
+        reader=reader,
+    )
+    return params, config
+
+
+def export_vit_safetensors(
+    params: Any,
+    config: Any,
+    directory: str,
+    *,
+    max_shard_bytes: Optional[int] = None,
+) -> List[str]:
+    """Write framework ViT params as an HF-layout checkpoint."""
+    config_json = {
+        "architectures": ["ViTForImageClassification"],
+        "model_type": "vit",
+        "image_size": config.image_size,
+        "patch_size": config.patch_size,
+        "hidden_size": config.hidden_dim,
+        "num_hidden_layers": config.num_layers,
+        "num_attention_heads": config.num_heads,
+        "intermediate_size": config.mlp_dim,
+        "qkv_bias": config.qkv_bias,
+        "hidden_act": "gelu" if config.gelu_exact else "gelu_pytorch_tanh",
+        "id2label": {str(i): str(i) for i in range(config.num_classes)},
+    }
+    return _export_checkpoint(
+        params, vit_tensor_specs(config), directory,
+        config_json=config_json, max_shard_bytes=max_shard_bytes,
+        skip_missing=True,
+    )
+
+
+# ---------------------------------------------------------------------------
 # Checkpoint IO
 
 
@@ -397,6 +638,43 @@ def _load_checkpoint(
     put = (lambda x: jax.device_put(x, device)) if device is not None else jnp.asarray
 
     for spec in specs:
+        if isinstance(spec, GroupSpec):
+            absent = [n for n in spec.hf_names if n not in reader]
+            if absent:
+                missing.extend(absent)
+                continue
+            if quantize and spec.quantizable:
+                # one expert at a time through the 2D int8 recipe —
+                # bit-identical to quantize_params' vmapped form (vmap
+                # of the same kernel), with ONE expert tensor resident
+                qs, scales = [], []
+                for n in spec.hf_names:
+                    w = spec.to_framework(reader.read(n))
+                    q, scale = _quantize_on_device(
+                        put(np.ascontiguousarray(w, np.float32))
+                    )
+                    qs.append(q)
+                    scales.append(scale)
+                    del w
+                parent, leaf = spec.path[:-1], spec.path[-1]
+                _set_path(params, parent + (f"{leaf}_q",), jnp.stack(qs))
+                _set_path(params, parent + (f"{leaf}_scale",), jnp.stack(scales))
+            else:
+                stacked = None
+                for e, n in enumerate(spec.hf_names):
+                    w = spec.to_framework(reader.read(n))
+                    if stacked is None:
+                        stacked = np.empty(
+                            (len(spec.hf_names),) + w.shape, w.dtype
+                        )
+                    stacked[e] = w
+                    del w
+                arr = put(stacked)
+                del stacked
+                if jnp.issubdtype(arr.dtype, jnp.floating):
+                    arr = arr.astype(dtype)
+                _set_path(params, spec.path, arr)
+            continue
         name = spec.hf_name
         if name not in reader:
             if spec.fallback is not None and spec.fallback in reader:
@@ -420,7 +698,7 @@ def _load_checkpoint(
             _set_path(params, parent + ("scale",), scale)
         else:
             arr = put(w)
-            if jnp.issubdtype(arr.dtype, jnp.floating):
+            if jnp.issubdtype(arr.dtype, jnp.floating) and not spec.keep_dtype:
                 arr = arr.astype(dtype)
             _set_path(params, spec.path, arr)
         del w  # one tensor resident at a time — the streaming contract
@@ -431,9 +709,14 @@ def _load_checkpoint(
             f"tensors (first: {missing[:3]}); wrong config geometry?"
         )
     if strict:
-        expected = {s.hf_name for s in specs} | {
-            s.fallback for s in specs if s.fallback
-        }
+        expected = set()
+        for s in specs:
+            if isinstance(s, GroupSpec):
+                expected.update(s.hf_names)
+            else:
+                expected.add(s.hf_name)
+                if s.fallback:
+                    expected.add(s.fallback)
         extra = [n for n in reader.names() if n not in expected]
         if extra:
             raise KeyError(
@@ -589,7 +872,11 @@ def _export_checkpoint(
         w = np.asarray(node)
         if w.dtype == np.dtype("V2"):  # raw bf16 view
             w = w.view(np.uint16)
-        flat.append((spec.hf_name, spec.to_hf(np.ascontiguousarray(w))))
+        if isinstance(spec, GroupSpec):
+            for e, hf_name in enumerate(spec.hf_names):
+                flat.append((hf_name, spec.to_hf(np.ascontiguousarray(w[e]))))
+        else:
+            flat.append((spec.hf_name, spec.to_hf(np.ascontiguousarray(w))))
 
     # shard greedily in spec order so related tensors stay together
     shards: List[List[Tuple[str, np.ndarray]]] = [[]]
@@ -644,10 +931,15 @@ def export_llama_safetensors(
     """
     specs = llama_tensor_specs(config)
     if tie_lm_head:
-        specs = [s for s in specs if s.hf_name != "lm_head.weight"]
+        specs = [
+            s for s in specs
+            if getattr(s, "hf_name", None) != "lm_head.weight"
+        ]
     config_json = {
-        "architectures": ["LlamaForCausalLM"],
-        "model_type": "llama",
+        "architectures": [
+            "MixtralForCausalLM" if config.num_experts else "LlamaForCausalLM"
+        ],
+        "model_type": "mixtral" if config.num_experts else "llama",
         "vocab_size": config.vocab_size,
         "hidden_size": config.hidden_dim,
         "num_hidden_layers": config.num_layers,
@@ -655,9 +947,20 @@ def export_llama_safetensors(
         "num_key_value_heads": config.num_kv_heads,
         "intermediate_size": config.mlp_dim,
         "rope_theta": config.rope_theta,
+        "rms_norm_eps": config.norm_eps,
         "max_position_embeddings": config.max_len,
         "tie_word_embeddings": tie_lm_head,
     }
+    if config.rope_scaling is not None:
+        factor, low, high, orig = config.rope_scaling
+        config_json["rope_scaling"] = {
+            "rope_type": "llama3", "factor": factor,
+            "low_freq_factor": low, "high_freq_factor": high,
+            "original_max_position_embeddings": orig,
+        }
+    if config.num_experts:
+        config_json["num_local_experts"] = config.num_experts
+        config_json["num_experts_per_tok"] = config.num_selected
     return _export_checkpoint(
         params, specs, directory,
         config_json=config_json, max_shard_bytes=max_shard_bytes,

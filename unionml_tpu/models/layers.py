@@ -270,6 +270,9 @@ class Attention(nn.Module):
     quantized: bool = False  # int8 weight-only projections (serving)
     lora_rank: int = 0  # >0: trainable low-rank adapters on q/k/v/o
     lora_alpha: float = 16.0
+    # biases on q/k/v/o (HF ViT/BERT-style checkpoints carry them; the
+    # zoo's trained-from-scratch defaults stay bias-free)
+    use_bias: bool = False
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
 
@@ -308,6 +311,7 @@ class Attention(nn.Module):
             quantized=self.quantized, features=feats, axis=-1,
             dtype=self.dtype, param_dtype=self.param_dtype, name=name,
             lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+            use_bias=self.use_bias,
         )
         q = dense((self.num_heads, head_dim), "q")(x)
         if kv is not None:
@@ -332,6 +336,7 @@ class Attention(nn.Module):
                 quantized=self.quantized, features=features, axis=(-2, -1),
                 dtype=self.dtype, param_dtype=self.param_dtype, name="o",
                 lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+                use_bias=self.use_bias,
             )(out)
         k = dense((kv_heads, head_dim), "k")(x)
         v = dense((kv_heads, head_dim), "v")(x)
@@ -437,6 +442,7 @@ class Attention(nn.Module):
             quantized=self.quantized, features=features, axis=(-2, -1),
             dtype=self.dtype, param_dtype=self.param_dtype, name="o",
             lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+            use_bias=self.use_bias,
         )(out)
         if cache is not None:
             return out, new_cache

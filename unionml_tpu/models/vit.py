@@ -32,6 +32,11 @@ class ViTConfig:
     # "fused" = Pallas LayerNorm kernel pair incl. residual-add fusion
     # (ops/fused_norm.py); "xla" = plain fp32-stats LayerNorm
     norm_impl: str = "xla"
+    # HF ViT checkpoints carry q/k/v/o biases and use erf GELU; the
+    # trained-from-scratch defaults stay bias-free/tanh. Checkpoint
+    # loaders (models/convert.py) set both for faithful inference.
+    qkv_bias: bool = False
+    gelu_exact: bool = False
     dtype: str = "bfloat16"
 
     @staticmethod
@@ -64,9 +69,13 @@ class ViTBlock(nn.Module):
             else nn.LayerNorm(dtype=dtype, name=name)
         )
         attn = Attention(
-            num_heads=cfg.num_heads, attn_impl=cfg.attn_impl, dtype=dtype, name="attn"
+            num_heads=cfg.num_heads, attn_impl=cfg.attn_impl,
+            use_bias=cfg.qkv_bias, dtype=dtype, name="attn",
         )
-        mlp = MlpBlock(hidden_dim=cfg.mlp_dim, dtype=dtype, name="mlp")
+        mlp = MlpBlock(
+            hidden_dim=cfg.mlp_dim, gelu_approximate=not cfg.gelu_exact,
+            dtype=dtype, name="mlp",
+        )
         if cfg.norm_impl == "fused":
             # fuse the mid-block residual add into ln2's pass (one fewer
             # [B*S, D] HBM round trip each way); param tree unchanged

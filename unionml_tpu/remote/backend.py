@@ -52,9 +52,9 @@ def _workflow_resources(workflow) -> Resources:
         chips=hungriest.chips,
         # the accelerator TYPE must come from the stage that asked for
         # the most chips — pairing max-chips with another stage's type
-        # would provision the wrong hardware
-        accelerator=hungriest.accelerator
-        or next((r.accelerator for r in reqs if r.accelerator), None),
+        # would provision the wrong hardware; if the hungriest stage
+        # left it unset, record None honestly rather than guess
+        accelerator=hungriest.accelerator,
     )
 
 
