@@ -45,6 +45,7 @@ NAV = [
     ("Tutorial: MNIST", "tutorials/mnist.md"),
     ("Tutorial: Vision", "tutorials/vision.md"),
     ("Tutorial: LLM serving", "tutorials/llm_serving.md"),
+    ("Tutorial: Checkpoints", "tutorials/checkpoints.md"),
     ("API reference", "api_reference.md"),
     ("CLI reference", "cli_reference.md"),
 ]
