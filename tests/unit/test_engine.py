@@ -211,8 +211,9 @@ def test_stats_archive_is_lightweight(tiny_llama):
     try:
         engine.generate(params, [[1, 2, 3], [4, 5, 6]])
         with engine._lock:
+            # (queue_wait, prefill, decode, ttft) float tuples only
             assert all(
-                isinstance(rec, tuple) and len(rec) == 3 for rec in engine._completed
+                isinstance(rec, tuple) and len(rec) == 4 for rec in engine._completed
             )
         s = engine.stats()
         assert s["completed_requests"] == 2
