@@ -154,6 +154,12 @@ def main() -> None:
         cfg = None      # the spec predictor holds its own module pair;
         qmodule = None  # the per-preset serving config never applies
     elif (cfg := serving_config(preset)) and args.checkpoint:
+        if getattr(cfg, "weight_bits", 8) == 4:
+            raise SystemExit(
+                "--checkpoint streams to int8; the serve_8b_w4 preset "
+                "would mislabel an int8 run — use serve_8b with "
+                "--checkpoint, or the w4 preset without it"
+            )
         # REAL weights: geometry from the checkpoint's config.json,
         # serving knobs (cache size, kv_quant, attention impl) from the
         # preset; kernels stream to int8 on load without an fp tree ever
@@ -169,9 +175,11 @@ def main() -> None:
     else:
         qcfg = LlamaConfig(**{**cfg.__dict__, "quantized": True})
         qmodule = Llama(qcfg)
-        if preset == "serve_8b":
-            # synthetic int8 weights: an 8B master tree can't be materialized
-            # on-chip to quantize from (see serve_latency.random_quantized_params)
+        if preset.startswith("serve_8b"):
+            # synthetic quantized weights: an 8B master tree can't be
+            # materialized on-chip to quantize from (see
+            # serve_latency.random_quantized_params); serve_8b_w4 runs
+            # the packed-int4 decode kernel
             from benchmarks.serve_latency import random_quantized_params
 
             qparams = random_quantized_params(qmodule)

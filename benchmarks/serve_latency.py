@@ -36,6 +36,12 @@ def serving_config(preset: str):
         # bf16 (16 GB) exceeds one v5e chip's HBM; int8 weights (~8.6 GB)
         # fit with room for bucketed KV caches -> int8-only legs.
         return LlamaConfig.llama3_8b()
+    if preset == "serve_8b_w4":
+        # packed-int4 weights (~4.3 GB): the ops/int4_matmul.py Pallas
+        # decode path — halves the weight traffic that bounds 8B decode
+        return LlamaConfig(**{
+            **LlamaConfig.llama3_8b().__dict__, "weight_bits": 4,
+        })
     if preset == "serve_moe":
         # ~1.1B-total-param 8-expert top-2 MoE (~0.4B active per token)
         return LlamaConfig(
@@ -93,7 +99,9 @@ def random_quantized_params(qmodule, seed: int = 0):
         name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
         parent = tuple(p.key if hasattr(p, "key") else str(p) for p in path[:-1])
         siblings = sibling_names[parent]
-        is_quant_scale = (name == "scale" and "kernel_q" in siblings) or (
+        is_quant_scale = (
+            name == "scale" and ("kernel_q" in siblings or "kernel_p" in siblings)
+        ) or (
             name.endswith("_scale") and f"{name[: -len('_scale')]}_q" in siblings
         )
         key, sub = jax.random.split(key)
@@ -146,8 +154,8 @@ def main() -> None:
     cfg = serving_config(preset)
     rng = np.random.default_rng(0)
 
-    if preset == "serve_8b":
-        # bf16 8B exceeds single-chip HBM: int8-only, synthetic weights
+    if preset.startswith("serve_8b"):
+        # bf16 8B exceeds single-chip HBM: quantized-only, synthetic weights
         legs = (True,)
         module, params, fp_params = None, None, None
     else:
@@ -162,7 +170,7 @@ def main() -> None:
         if quantized:
             qcfg = LlamaConfig(**{**cfg.__dict__, "quantized": True})
             qmodule = Llama(qcfg)
-            if preset == "serve_8b":
+            if preset.startswith("serve_8b"):
                 qparams = random_quantized_params(qmodule)
             else:
                 # quantize from the fp32 masters (the production path), not

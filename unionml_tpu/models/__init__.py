@@ -17,6 +17,7 @@ from unionml_tpu.models.bert import (
     mlm_step,
 )
 from unionml_tpu.models.llama import (
+    LLAMA_INT4_PARTITION_RULES,
     LLAMA_LORA_PARTITION_RULES,
     LLAMA_MOE_PARTITION_RULES,
     LLAMA_PARTITION_RULES,
@@ -99,6 +100,7 @@ __all__ = [
     "EncoderDecoder", "EncDecConfig", "ENCDEC_PARTITION_RULES",
     "init_decoder_cache", "make_seq2seq_generator", "make_seq2seq_predictor", "seq2seq_step",
     "LLAMA_QUANT_PARTITION_RULES", "LLAMA_MOE_PARTITION_RULES",
+    "LLAMA_INT4_PARTITION_RULES",
     "LLAMA_LORA_PARTITION_RULES", "LORA_PARTITION_RULES",
     "LoRADenseGeneral", "LoRATrainState", "create_lora_train_state",
     "merge_lora", "merge_param_trees", "split_lora_params",

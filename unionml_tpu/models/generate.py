@@ -588,10 +588,13 @@ def serving_params(params, dtype=jnp.bfloat16):
                 if isinstance(v, Mapping) or not hasattr(v, "dtype"):
                     out[k] = walk(v)
                     continue
-                # a scale is quant metadata only next to its int8 sibling
-                # (QuantizedDenseGeneral: kernel_q+scale; MoE experts:
+                # a scale is quant metadata only next to its int8/int4
+                # sibling (QuantizedDenseGeneral: kernel_q+scale;
+                # Int4DenseGeneral: kernel_p+scale; MoE experts:
                 # w_*_q + w_*_scale) — norm params also named "scale" cast
-                is_quant_scale = (k == "scale" and "kernel_q" in node) or (
+                is_quant_scale = (
+                    k == "scale" and ("kernel_q" in node or "kernel_p" in node)
+                ) or (
                     k.endswith("_scale") and f"{k[: -len('_scale')]}_q" in node
                 )
                 if k == "router_kernel" or is_quant_scale:

@@ -46,6 +46,7 @@ def make_dense(
     use_bias: bool = False,
     lora_rank: int = 0,
     lora_alpha: float = 16.0,
+    weight_bits: int = 8,
 ):
     """Dense-projection factory shared by every matmul site that supports
     the int8 weight-only serving path (Attention qkv/o, gated MLP,
@@ -56,6 +57,9 @@ def make_dense(
     ``kernel_q``+``scale``) plus trainable ``lora_a``/``lora_b`` adapters
     (QLoRA when combined with ``quantized=True``)."""
     if lora_rank > 0:
+        # adapters compose with the fp or INT8 base only — silently
+        # dropping an int4 request would train against the wrong base
+        assert weight_bits == 8, "LoRA/QLoRA requires weight_bits=8"
         from unionml_tpu.models.lora import LoRADenseGeneral
 
         return LoRADenseGeneral(
@@ -64,9 +68,15 @@ def make_dense(
             dtype=dtype, param_dtype=param_dtype, name=name,
         )
     if quantized:
+        assert not use_bias, "quantized dense layers are bias-free"
+        if weight_bits == 4:
+            from unionml_tpu.models.quantization import Int4DenseGeneral
+
+            return Int4DenseGeneral(
+                features=features, axis=axis, dtype=dtype, name=name
+            )
         from unionml_tpu.models.quantization import QuantizedDenseGeneral
 
-        assert not use_bias, "quantized dense layers are bias-free"
         return QuantizedDenseGeneral(features=features, axis=axis, dtype=dtype, name=name)
     return nn.DenseGeneral(
         features=features, axis=axis, use_bias=use_bias, dtype=dtype,
@@ -267,7 +277,8 @@ class Attention(nn.Module):
     causal: bool = False
     attn_impl: str = "xla"
     sequence_axis: Optional[str] = None
-    quantized: bool = False  # int8 weight-only projections (serving)
+    quantized: bool = False  # weight-only quantized projections (serving)
+    weight_bits: int = 8     # 8 = int8; 4 = packed-int4 (decode bandwidth)
     lora_rank: int = 0  # >0: trainable low-rank adapters on q/k/v/o
     lora_alpha: float = 16.0
     # biases on q/k/v/o (HF ViT/BERT-style checkpoints carry them; the
@@ -311,7 +322,7 @@ class Attention(nn.Module):
             quantized=self.quantized, features=feats, axis=-1,
             dtype=self.dtype, param_dtype=self.param_dtype, name=name,
             lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
-            use_bias=self.use_bias,
+            use_bias=self.use_bias, weight_bits=self.weight_bits,
         )
         q = dense((self.num_heads, head_dim), "q")(x)
         if kv is not None:
@@ -336,7 +347,7 @@ class Attention(nn.Module):
                 quantized=self.quantized, features=features, axis=(-2, -1),
                 dtype=self.dtype, param_dtype=self.param_dtype, name="o",
                 lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
-                use_bias=self.use_bias,
+                use_bias=self.use_bias, weight_bits=self.weight_bits,
             )(out)
         k = dense((kv_heads, head_dim), "k")(x)
         v = dense((kv_heads, head_dim), "v")(x)
@@ -442,7 +453,7 @@ class Attention(nn.Module):
             quantized=self.quantized, features=features, axis=(-2, -1),
             dtype=self.dtype, param_dtype=self.param_dtype, name="o",
             lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
-            use_bias=self.use_bias,
+            use_bias=self.use_bias, weight_bits=self.weight_bits,
         )(out)
         if cache is not None:
             return out, new_cache
@@ -454,7 +465,8 @@ class MlpBlock(nn.Module):
 
     hidden_dim: int
     gated: bool = False  # True → SwiGLU
-    quantized: bool = False  # int8 weight-only (bias-free gated form only)
+    quantized: bool = False  # weight-only quantized (bias-free gated form only)
+    weight_bits: int = 8
     lora_rank: int = 0  # >0: trainable low-rank adapters on gate/up/down
     lora_alpha: float = 16.0
     # tanh-approximate GELU by default (one transcendental cheaper on the
@@ -473,6 +485,7 @@ class MlpBlock(nn.Module):
             quantized=self.quantized, features=feats, dtype=self.dtype,
             param_dtype=self.param_dtype, use_bias=not self.gated, name=name,
             lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+            weight_bits=self.weight_bits,
         )
         if self.gated:
             gate = nn.silu(dense(self.hidden_dim, "gate")(x))

@@ -51,7 +51,11 @@ class LlamaConfig:
     # "fused" = Pallas RMSNorm kernel pair (ops/fused_norm.py)
     norm_impl: str = "xla"
     sequence_axis: Optional[str] = None
-    quantized: bool = False  # int8 weight-only matmuls (serving path)
+    quantized: bool = False  # weight-only quantized matmuls (serving path)
+    # 8 = int8 (the default serving artifact); 4 = packed-int4 via the
+    # Pallas decode kernel (ops/int4_matmul.py) — halves decode weight
+    # traffic again. LoRA/QLoRA and MoE experts stay int8.
+    weight_bits: int = 8
     remat: bool = False  # gradient checkpointing per block (long-context training)
     # mixture-of-experts MLPs (0 = dense). Experts shard over the mesh's
     # `expert` axis via LLAMA_MOE_PARTITION_RULES; GSPMD inserts the
@@ -126,6 +130,7 @@ class LlamaBlock(nn.Module):
             attn_impl=cfg.attn_impl,
             sequence_axis=cfg.sequence_axis,
             quantized=cfg.quantized,
+            weight_bits=cfg.weight_bits,
             lora_rank=cfg.lora_rank,
             lora_alpha=cfg.lora_alpha,
             dtype=dtype,
@@ -162,6 +167,7 @@ class LlamaBlock(nn.Module):
         else:
             x = x + MlpBlock(
                 hidden_dim=cfg.mlp_dim, gated=True, quantized=cfg.quantized,
+                weight_bits=cfg.weight_bits,
                 lora_rank=cfg.lora_rank, lora_alpha=cfg.lora_alpha,
                 dtype=dtype, name="mlp",
             )(h)
@@ -223,6 +229,7 @@ class Llama(nn.Module):
         x = RMSNorm(eps=cfg.norm_eps, dtype=dtype, impl=cfg.norm_impl, name="final_norm")(x)
         logits = make_dense(
             quantized=cfg.quantized, features=cfg.vocab_size,
+            weight_bits=cfg.weight_bits,
             dtype=jnp.float32, name="lm_head",
         )(x.astype(jnp.float32))
         if cache is not None:
@@ -287,6 +294,49 @@ LLAMA_QUANT_PARTITION_RULES = LLAMA_PARTITION_RULES + (
 from unionml_tpu.models.lora import LORA_PARTITION_RULES  # noqa: E402
 
 LLAMA_LORA_PARTITION_RULES = LORA_PARTITION_RULES + LLAMA_QUANT_PARTITION_RULES
+
+# packed-int4 serving (weight_bits=4): kernel_p is [K, N/2] (packed
+# output channels) with scale [N]. Megatron layout as int8; a `tensor`
+# shard of the packed/scale columns is self-consistent only when each
+# device's channel range is a multiple of the packing tile — validate
+# with assert_int4_tp_compatible (8B passes tp=2; k/v break at tp=4).
+LLAMA_INT4_PARTITION_RULES = LLAMA_QUANT_PARTITION_RULES + (
+    PartitionRule(r"attn/(q|k|v)/kernel_p$", (None, "tensor")),
+    PartitionRule(r"attn/o/kernel_p$", ("tensor", None)),
+    PartitionRule(r"mlp/(gate|up)/kernel_p$", (None, "tensor")),
+    PartitionRule(r"mlp/down/kernel_p$", ("tensor", None)),
+    # the lm_head stays REPLICATED under int4: 8B's 128256 channels make
+    # 501 tiles of 256 — indivisible by any tensor degree (2.1 GB packed
+    # per device; int4 is the single-chip density play)
+)
+
+
+def assert_int4_tp_compatible(config: "LlamaConfig", tensor: int) -> None:
+    """Refuse tensor-parallel degrees whose per-device channel ranges
+    split an int4 packing tile — a misaligned shard pairs nibbles with
+    the wrong output channels and decodes GARBAGE with no exception.
+    Call before sharding a ``weight_bits=4`` tree (8B passes tp<=4;
+    gate/up break at tp=8)."""
+    from unionml_tpu.ops.int4_matmul import tile_for
+
+    if tensor <= 1 or config.weight_bits != 4:
+        return
+    # column-parallel sites only (o/down shard K — row sharding leaves
+    # output channels whole; the lm_head is replicated under int4)
+    sites = (
+        ("attn/q", config.num_heads * config.head_dim, config.hidden_dim),
+        ("attn/k", config.num_kv_heads * config.head_dim, config.hidden_dim),
+        ("mlp/gate", config.mlp_dim, config.hidden_dim),
+    )
+    for name, n, k in sites:
+        tile = tile_for(n, k)
+        if tile and (n // tensor) % tile:
+            raise ValueError(
+                f"int4 layer {name}: {n} channels / tensor={tensor} = "
+                f"{n // tensor} per device, not a multiple of the packing "
+                f"tile {tile} — the shard would unpack wrong channels. "
+                "Lower the tensor degree or serve this model int8."
+            )
 
 # MoE configs (num_experts > 0): expert weights [E, d, h] shard E over the
 # `expert` mesh axis (GSPMD turns the one-hot dispatch einsums into

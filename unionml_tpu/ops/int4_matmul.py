@@ -1,0 +1,211 @@
+"""Packed-int4 weight-only matmul (Pallas) — the decode bandwidth lever.
+
+8B int8 serving sits at the HBM bound: every decoded token streams the
+full weight set (BASELINE.md rounds 2-4; p50 468 ms is within ~3% of
+the int8-traffic bound). int4 weights halve the bytes again — but this
+backend cannot move native ``jnp.int4`` across the jit boundary (plugin
+arg-signature recursion) and XLA materializes any unpack it is shown
+(measured 0.65-1.02x — worse or nil). So the int4 path stores TWO
+NIBBLES PER int8 BYTE and a Pallas kernel unpacks in VMEM, feeding the
+MXU directly — HBM reads stay at the packed width. Measured on the
+decode-faithful stream probe (32 layers of resident MLP weights per
+step, one v5e): int8 20.1 ms/step → int4 **13.0 ms/step (1.54x)**.
+
+Packing layout (``pack_int4``): output channels are tiled by ``TILE_N``;
+within tile ``j`` the LOW nibbles hold channels ``[j*T, j*T + T/2)`` and
+the HIGH nibbles ``[j*T + T/2, (j+1)*T)``, so the kernel's two
+per-nibble matmuls write contiguous slabs and the output needs no
+permutation. Mosaic constraints honored: nibble math runs in int32
+(int8 shifts don't legalize), scales apply OUTSIDE the kernel (1D fp32
+operands hit XLA/Mosaic layout mismatches), and the Pallas path engages
+only for row counts ≤ ``MAX_PALLAS_ROWS`` and tile-divisible N — other
+shapes (prefill's flattened rows, tiny test geometries) fall back to an
+XLA unpack with identical semantics (prefill is compute-amortized; the
+bandwidth lever only matters for decode).
+
+Quantization (``quantize_kernel_int4``): symmetric per-output-channel
+absmax/7 — coarser than int8's /127; serving quality at 4-bit normally
+wants group-wise scales, which compose with this kernel (scales are
+outside) but are not implemented here. The shipped recipe is the
+latency configuration; quality evaluation needs real weights.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "MAX_PALLAS_ROWS",
+    "int4_matmul",
+    "pack_int4",
+    "quantize_kernel_int4",
+    "unpack_int4",
+]
+
+TILE_N = 512          # output-channel tile; N must divide by a tile choice
+MAX_PALLAS_ROWS = 64  # decode/verify row counts; larger rows → XLA path
+
+
+# per-program VMEM budget for the weight-side buffers: packed int8 +
+# int32 nibble temps + bf16 operands ≈ 9 bytes per packed element; the
+# v5e scoped-vmem limit is 16 MB per kernel (leave headroom for x/out)
+_VMEM_WEIGHT_BYTES = 11_000_000
+
+
+def _grid_for(n: int, k: int):
+    """Pick ``(tile_n, k_block)`` for N output channels at contraction
+    width K. Mosaic needs the packed block's last dim (tile/2) to
+    divide 128 or equal the full packed width, so multi-tile means
+    tile ∈ {512, 256}; any even N works single-tile. Big K blows the
+    scoped-VMEM budget (the int32 unpack temps scale with K x TILE), so
+    K splits into grid blocks with output accumulation — k_block halves
+    until the weight-side buffers fit (K=14336 down-projections run
+    tile 256 x k_block 7168). Returns ``(0, 0)`` when N is odd (cannot
+    pack two nibbles per byte)."""
+    if n % 2:
+        return 0, 0
+    candidates = [t for t in (512, 256) if n % t == 0] or [n]
+    for t in candidates:
+        kb = k
+        while 9 * kb * (t // 2) > _VMEM_WEIGHT_BYTES and kb % 2 == 0:
+            kb //= 2
+        if 9 * kb * (t // 2) <= _VMEM_WEIGHT_BYTES and (
+            kb == k or kb % 128 == 0
+        ):
+            return t, kb
+    return 0, 0
+
+
+def pack_int4(nibbles: jnp.ndarray, tile_n: int) -> jnp.ndarray:
+    """Pack int8 nibble values (in [-8, 7]) ``[K, N]`` → ``[K, N/2]``
+    int8, tile-slab order (see module docstring)."""
+    k, n = nibbles.shape
+    t = nibbles.reshape(k, n // tile_n, tile_n)
+    lo = t[:, :, : tile_n // 2]
+    hi = t[:, :, tile_n // 2 :]
+    p = (lo.astype(jnp.uint8) & 0xF) | ((hi.astype(jnp.uint8) & 0xF) << 4)
+    return p.reshape(k, n // 2).astype(jnp.int8)
+
+
+def unpack_int4(packed: jnp.ndarray, tile_n: int) -> jnp.ndarray:
+    """Inverse of :func:`pack_int4`: ``[K, N/2]`` int8 → ``[K, N]`` int8
+    nibble values (the XLA-fallback dequant and the test oracle)."""
+    k, half = packed.shape
+    q = packed.astype(jnp.int32)
+    hi = q >> 4
+    lo = ((q & 15) ^ 8) - 8
+    t = jnp.concatenate(
+        [
+            lo.reshape(k, half // (tile_n // 2), tile_n // 2),
+            hi.reshape(k, half // (tile_n // 2), tile_n // 2),
+        ],
+        axis=2,
+    )
+    return t.reshape(k, 2 * half).astype(jnp.int8)
+
+
+def _kernel(x_ref, wp_ref, o_ref):
+    from jax.experimental import pallas as pl
+
+    q = wp_ref[...].astype(jnp.int32)  # int8 shifts don't legalize in Mosaic
+    hi = q >> 4                        # arithmetic shift == floor(q/16)
+    lo = ((q & 15) ^ 8) - 8            # sign-extend the low nibble
+    xb = x_ref[...]
+    # weights convert to the CALLER'S compute dtype (the lm_head keeps
+    # its fp32-logits contract; everything else runs bf16 on the MXU)
+    y_lo = jax.lax.dot_general(
+        xb, lo.astype(xb.dtype),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    y_hi = jax.lax.dot_general(
+        xb, hi.astype(xb.dtype),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    partial_out = jnp.concatenate([y_lo, y_hi], axis=1)
+
+    # K is blocked over the innermost grid dim with output accumulation
+    @pl.when(pl.program_id(1) == 0)
+    def _zero():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    o_ref[...] += partial_out
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n", "tile_n", "k_block", "interpret")
+)
+def _pallas_int4(x, packed, *, n: int, tile_n: int, k_block: int, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    rows, k = x.shape
+    grid = (n // tile_n, k // k_block)  # k innermost: accumulation order
+    return pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((rows, k_block), lambda j, kb: (0, kb)),
+            pl.BlockSpec((k_block, tile_n // 2), lambda j, kb: (kb, j)),
+        ],
+        out_specs=pl.BlockSpec((rows, tile_n), lambda j, kb: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((rows, n), jnp.float32),
+        interpret=interpret,
+    )(x, packed)
+
+
+def int4_matmul(
+    x: jnp.ndarray,
+    packed: jnp.ndarray,
+    scale: jnp.ndarray,
+    *,
+    tile_n: int,
+    dtype=jnp.bfloat16,
+) -> jnp.ndarray:
+    """``x [rows, K] @ W4`` where ``W4`` is ``pack_int4``-packed
+    ``[K, N/2]`` with per-output-channel fp32 ``scale [N]``.
+
+    Decode-sized row counts on TPU run the Pallas kernel (HBM reads at
+    the packed width); anything else takes the XLA unpack path — same
+    math, standard traffic. The compute dtype follows ``dtype`` when it
+    is a float type (fp32 for the LM head's logits contract, bf16
+    otherwise), matching ``QuantizedDenseGeneral``'s behavior.
+    """
+    rows = x.shape[0]
+    n = scale.shape[0]
+    compute = dtype if jnp.issubdtype(dtype, jnp.floating) else jnp.bfloat16
+    _, k_block = _grid_for(n, x.shape[1])
+    use_pallas = 0 < rows <= MAX_PALLAS_ROWS and tile_n > 0 and k_block > 0
+    if use_pallas:
+        interpret = jax.default_backend() != "tpu"
+        y = _pallas_int4(
+            x.astype(compute), packed, n=n, tile_n=tile_n,
+            k_block=k_block, interpret=interpret,
+        )
+    else:
+        w = unpack_int4(packed, tile_n).astype(compute)
+        y = jax.lax.dot_general(
+            x.astype(compute), w,
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+    return (y * scale).astype(dtype)
+
+
+def quantize_kernel_int4(w2d: jnp.ndarray, tile_n: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-output-channel int4: ``[K, N]`` fp → ``(packed
+    [K, N/2] int8, scale [N] fp32)``. ``tile_n`` must match the serving
+    call's tile (it bakes the slab order into the packing)."""
+    w = jnp.asarray(w2d, jnp.float32)
+    absmax = jnp.max(jnp.abs(w), axis=0)                 # [N]
+    scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
+    nib = jnp.clip(jnp.round(w / scale), -8, 7).astype(jnp.int8)
+    return pack_int4(nib, tile_n), scale.astype(jnp.float32)
+
+
+def tile_for(n: int, k: int) -> int:
+    """The tile the serving layer should bake for ``N`` output channels
+    at contraction width ``K`` (0 = no conforming tile; the layer must
+    stay int8)."""
+    return _grid_for(n, k)[0]
