@@ -36,6 +36,11 @@ def serving_config(preset: str):
         # bf16 (16 GB) exceeds one v5e chip's HBM; int8 weights (~8.6 GB)
         # fit with room for bucketed KV caches -> int8-only legs.
         return LlamaConfig.llama3_8b()
+    if preset == "serve_1p5b_w4":
+        # packed-int4 at the 1.5B scale: the second confirmation point
+        # for the ops/int4_matmul.py decode kernel
+        base = serving_config("serve_1p5b")
+        return LlamaConfig(**{**base.__dict__, "weight_bits": 4})
     if preset == "serve_8b_w4":
         # packed-int4 weights (~4.3 GB): the ops/int4_matmul.py Pallas
         # decode path — halves the weight traffic that bounds 8B decode
@@ -158,6 +163,13 @@ def main() -> None:
         # bf16 8B exceeds single-chip HBM: quantized-only, synthetic weights
         legs = (True,)
         module, params, fp_params = None, None, None
+    elif preset.endswith("_w4"):
+        # w4 presets measure the quantized leg only (the fp leg is the
+        # base preset's, already recorded)
+        legs = (True,)
+        module, params = None, None
+        tokens0 = jnp.zeros((1, 8), jnp.int32)
+        fp_params = jax.jit(Llama(cfg).init)(jax.random.PRNGKey(0), tokens0)["params"]
     else:
         legs = (False, True)
         module = Llama(cfg)
@@ -175,7 +187,10 @@ def main() -> None:
             else:
                 # quantize from the fp32 masters (the production path), not
                 # the bf16 serving copy: scales from bf16 weights double-round
-                qparams = quantize_params(fp_params, LLAMA_QUANT_PATTERNS)
+                qparams = quantize_params(
+                    fp_params, LLAMA_QUANT_PATTERNS,
+                    bits=getattr(cfg, "weight_bits", 8),
+                )
             run_module, run_params = qmodule, qparams
         else:
             run_module, run_params = module, params
