@@ -150,3 +150,29 @@ def test_int4_untileable_layer_falls_back_to_int8_module():
     assert "kernel_p" in q4["block_0"]["attn"]["q"]
     logits = Llama(cfg).apply({"params": q4}, jnp.zeros((1, 4), jnp.int32))
     assert logits.shape == (1, 4, 97)           # ...and it loads/runs
+
+
+def test_int4_engine_matches_generator():
+    """The continuous-batching engine serves int4 trees (slot-decode rows
+    hit the kernel's decode path) token-identically to the solo
+    generator."""
+    from unionml_tpu.serving.engine import DecodeEngine
+
+    cfg = int4_cfg()
+    fp_cfg = LlamaConfig(**{**cfg.__dict__, "quantized": False, "weight_bits": 8})
+    params = Llama(fp_cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    q4 = quantize_params(params, LLAMA_QUANT_PATTERNS, bits=4)
+    module = Llama(cfg)
+    prompt = [7, 3, 9, 2, 5]
+    gen = make_generator(module, max_new_tokens=6, max_len=64)
+    want = np.asarray(gen(q4, jnp.asarray([prompt], jnp.int32)))[0].tolist()
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=6, prompt_buckets=(8,), chunk_steps=3
+    )
+    try:
+        got = engine.generate(q4, [prompt])[0]
+    finally:
+        engine.close()
+    assert got == want
