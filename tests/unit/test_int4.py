@@ -176,3 +176,38 @@ def test_int4_engine_matches_generator():
     finally:
         engine.close()
     assert got == want
+
+
+def test_streamed_int4_checkpoint_matches_quantize_params(tmp_path):
+    """load_llama_checkpoint(quantize=True) with weight_bits=4 streams
+    straight to the packed layout, bit-identical to the in-memory
+    quantize_params(bits=4) over a direct load."""
+    from unionml_tpu.models.convert import (
+        export_llama_safetensors,
+        load_llama_checkpoint,
+    )
+
+    fp_cfg = LlamaConfig.tiny(dtype="float32")
+    params = Llama(fp_cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    export_llama_safetensors(params, fp_cfg, str(tmp_path))
+    streamed, cfg = load_llama_checkpoint(
+        str(tmp_path), quantize=True, quantized=True, weight_bits=4,
+    )
+    assert cfg.weight_bits == 4
+    direct, _ = load_llama_checkpoint(str(tmp_path), fp_cfg, dtype=jnp.float32)
+    reference = quantize_params(direct, LLAMA_QUANT_PATTERNS, bits=4)
+    q_attn = streamed["block_0"]["attn"]["q"]
+    assert set(q_attn) == {"kernel_p", "scale"}
+    np.testing.assert_array_equal(
+        np.asarray(q_attn["kernel_p"]),
+        np.asarray(reference["block_0"]["attn"]["q"]["kernel_p"]),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(streamed["lm_head"]["kernel_p"]),
+        np.asarray(reference["lm_head"]["kernel_p"]),
+    )
+    # and the streamed tree serves through the weight_bits=4 module
+    logits = Llama(cfg).apply({"params": streamed}, jnp.zeros((1, 4), jnp.int32))
+    assert np.isfinite(np.asarray(logits)).all()

@@ -630,6 +630,7 @@ def _load_checkpoint(
     device: Any,
     strict: bool,
     reader: Optional[_CheckpointReader] = None,
+    bits: int = 8,
 ) -> Dict[str, Any]:
     if reader is None:
         reader = _CheckpointReader(path)
@@ -690,11 +691,25 @@ def _load_checkpoint(
             # projection contracts its LEADING dims, everything else its
             # single leading input dim
             k = int(np.prod(w.shape[:-1])) if spec.path[-2] == "o" else w.shape[0]
-            q, scale = _quantize_on_device(
-                put(np.ascontiguousarray(w, np.float32).reshape(k, -1))
-            )
+            w2d = put(np.ascontiguousarray(w, np.float32).reshape(k, -1))
             parent = spec.path[:-1]
-            _set_path(params, parent + ("kernel_q",), q)
+            tile = 0
+            if bits == 4:
+                from unionml_tpu.ops.int4_matmul import (
+                    quantize_kernel_int4,
+                    tile_for,
+                )
+
+                tile = tile_for(w2d.shape[1], k)
+            if tile:
+                # streamed packed-int4 (quantize_params(bits=4) parity;
+                # untileable widths fall through to int8 like the
+                # in-memory path and the serving module's fallback)
+                q, scale = quantize_kernel_int4(w2d, tile)
+                _set_path(params, parent + ("kernel_p",), q)
+            else:
+                q, scale = _quantize_on_device(w2d)
+                _set_path(params, parent + ("kernel_q",), q)
             _set_path(params, parent + ("scale",), scale)
         else:
             arr = put(w)
@@ -743,10 +758,13 @@ def load_llama_checkpoint(
     read from the checkpoint directory's ``config.json``
     (``config_overrides`` pass through — e.g. ``max_len=8192``).
     ``quantize`` defaults to ``config.quantized``: the result then holds
-    int8 ``kernel_q``+``scale`` trees bit-identical to
-    ``quantize_params(fp_load, LLAMA_QUANT_PATTERNS)`` without ever
-    materializing the fp tree (peak memory ~ one layer's kernel). Float
-    leaves on the fp path are cast to ``dtype`` (serving residency —
+    quantized trees bit-identical to ``quantize_params(fp_load,
+    LLAMA_QUANT_PATTERNS, bits=config.weight_bits)`` without ever
+    materializing the fp tree (peak memory ~ one layer's kernel) — int8
+    ``kernel_q``+``scale`` by default, packed-int4 ``kernel_p`` when the
+    config carries ``weight_bits=4`` (untileable widths fall back to
+    int8, mirroring the serving module). Float leaves on the fp path are
+    cast to ``dtype`` (serving residency —
     :func:`~unionml_tpu.models.generate.serving_params` semantics).
     """
     if config is None:
@@ -763,6 +781,7 @@ def load_llama_checkpoint(
     params = _load_checkpoint(
         path, llama_tensor_specs(config),
         quantize=quantize, dtype=dtype, device=device, strict=strict,
+        bits=config.weight_bits,
     )
     return params, config
 
