@@ -578,8 +578,10 @@ def test_manifest_env_backcompat_and_chips0():
 
 
 def test_local_backend_applies_resources_env(fixture_model, monkeypatch):
-    """The launched runner's environment carries the derived resource env
-    (train workflow: device resources → thread caps, no platform pin)."""
+    """The launched runner's environment carries the derived resource env.
+    The sklearn fixture is a HOST-ONLY model family, so its stages default
+    to chips=0 (Resources docstring promise): the runner env pins
+    JAX_PLATFORMS=cpu and caps threadpools at the host default."""
     import subprocess as sp
 
     import unionml_tpu.remote.backend as backend_mod
@@ -604,9 +606,27 @@ def test_local_backend_applies_resources_env(fixture_model, monkeypatch):
         inputs={}, wait=False,
     )
     assert record is not None
+    assert captured["env"]["OMP_NUM_THREADS"] == "1"
+    # host-only workflow (chips=0): the launcher pins JAX_PLATFORMS=cpu so
+    # a data-prep/sklearn run never grabs the accelerator a co-tenant
+    # serving process is using
+    assert captured["env"].get("JAX_PLATFORMS") == "cpu"
+
+    # device workflow (chips=1): redeploy with explicit device resources —
+    # the launcher must apply the thread caps but NOT pin the platform
+    # (whatever JAX_PLATFORMS the ambient env carries passes through)
+    from unionml_tpu.defaults import DEFAULT_DEVICE_RESOURCES
+
+    model._train_task_kwargs["resources"] = DEFAULT_DEVICE_RESOURCES
+    model._train_task = None  # force stage regeneration with new resources
+    backend.deploy(model, app_version="rv2")
+    captured.clear()
+    record = backend.execute(
+        model, workflow=model.train_workflow_name, app_version="rv2",
+        inputs={}, wait=False,
+    )
+    assert record is not None
     assert captured["env"]["OMP_NUM_THREADS"] == "4"
-    # device workflow (chips=1): the launcher must NOT pin the platform —
-    # whatever JAX_PLATFORMS the ambient env carries passes through
     import os as _os
 
     assert captured["env"].get("JAX_PLATFORMS") == _os.environ.get(
@@ -626,7 +646,10 @@ def test_tpuvm_resources_env_in_ssh_command(tpuvm_model, monkeypatch):
     launched = backend._procs[record.execution_id]
     try:
         cmds = {e[1]: e[2] for e in capture if e[0] == "ssh"}
-        assert "OMP_NUM_THREADS=4" in cmds["hostA"]
+        # sklearn app = host-only family: chips=0 defaults flow into the
+        # SSH launch line (thread cap + platform pin)
+        assert "OMP_NUM_THREADS=1" in cmds["hostA"]
+        assert "JAX_PLATFORMS=cpu" in cmds["hostA"]
     finally:
         for _, proc, log in launched["procs"]:
             proc.wait(timeout=30)

@@ -34,7 +34,7 @@ from typing import IO, Any, Callable, Dict, List, NamedTuple, Optional, Tuple, T
 from unionml_tpu import type_guards
 from unionml_tpu._logging import logger
 from unionml_tpu.dataset import Dataset
-from unionml_tpu.defaults import DEFAULT_DEVICE_RESOURCES
+from unionml_tpu.defaults import DEFAULT_DEVICE_RESOURCES, DEFAULT_RESOURCES
 from unionml_tpu.stage import Stage, Workflow, stage_from_fn
 from unionml_tpu.tracking import TrackedInstance
 
@@ -275,9 +275,23 @@ class Model(TrackedInstance):
             return lambda f: self.trainer(f, **train_task_kwargs)
         type_guards.guard_trainer(fn, self.model_type, self._expected_data_types())
         self._trainer = fn
-        self._train_task_kwargs = {"resources": DEFAULT_DEVICE_RESOURCES, **train_task_kwargs}
+        self._train_task_kwargs = {
+            "resources": self._default_stage_resources(), **train_task_kwargs
+        }
         self._train_task = None
         return fn
+
+    def _default_stage_resources(self):
+        """Host-only model families (sklearn / torch-cpu / keras classes)
+        default to ``chips=0`` so their runner env gets the
+        ``JAX_PLATFORMS=cpu`` guard :mod:`unionml_tpu.defaults` promises;
+        everything else (JAX pytree apps, the two-tier ``train_step``
+        path) advertises a chip. Override per stage with
+        ``resources=Resources(...)``."""
+        mt = self.model_type
+        if is_sklearn_model(mt) or is_pytorch_model(mt) or is_keras_model(mt):
+            return DEFAULT_RESOURCES
+        return DEFAULT_DEVICE_RESOURCES
 
     def train_step(
         self,
@@ -370,7 +384,9 @@ class Model(TrackedInstance):
         type_guards.guard_predictor(fn, self.model_type, self._dataset.feature_type)
         self._predictor = fn
         self._predict_step_options = {"jit": jit, "batch_axis": batch_axis}
-        self._predict_task_kwargs = {"resources": DEFAULT_DEVICE_RESOURCES, **predict_task_kwargs}
+        self._predict_task_kwargs = {
+            "resources": self._default_stage_resources(), **predict_task_kwargs
+        }
         self._predict_task = None
         self._predict_from_features_task = None
         return fn

@@ -1007,6 +1007,10 @@ def export_bert_safetensors(
         "num_hidden_layers": config.num_layers,
         "num_attention_heads": config.num_heads,
         "intermediate_size": config.mlp_dim,
+        # bert_config_from_hf defaults a MISSING hidden_act to erf-gelu;
+        # omitting it here would silently swap a tanh-gelu BERT's
+        # activation on reload (the ViT exporter records it too)
+        "hidden_act": "gelu" if config.gelu_exact else "gelu_pytorch_tanh",
     }
     return _export_checkpoint(
         params, specs, directory,

@@ -300,7 +300,13 @@ LLAMA_LORA_PARTITION_RULES = LORA_PARTITION_RULES + LLAMA_QUANT_PARTITION_RULES
 # shard of the packed/scale columns is self-consistent only when each
 # device's channel range is a multiple of the packing tile — validate
 # with assert_int4_tp_compatible (8B passes tp=2; k/v break at tp=4).
-LLAMA_INT4_PARTITION_RULES = LLAMA_QUANT_PARTITION_RULES + (
+LLAMA_INT4_PARTITION_RULES = (
+    # OVERRIDE (first match wins): the int4 lm_head kernel_p is
+    # replicated (see below), so its [vocab] fp32 scale must be too —
+    # the inherited int8 rule would shard it against a replicated
+    # kernel, inserting a gather on every decode step
+    PartitionRule(r"lm_head/scale$", ()),
+) + LLAMA_QUANT_PARTITION_RULES + (
     PartitionRule(r"attn/(q|k|v)/kernel_p$", (None, "tensor")),
     PartitionRule(r"attn/o/kernel_p$", ("tensor", None)),
     PartitionRule(r"mlp/(gate|up)/kernel_p$", (None, "tensor")),
@@ -315,8 +321,8 @@ def assert_int4_tp_compatible(config: "LlamaConfig", tensor: int) -> None:
     """Refuse tensor-parallel degrees whose per-device channel ranges
     split an int4 packing tile — a misaligned shard pairs nibbles with
     the wrong output channels and decodes GARBAGE with no exception.
-    Call before sharding a ``weight_bits=4`` tree (8B passes tp<=4;
-    gate/up break at tp=8)."""
+    Call before sharding a ``weight_bits=4`` tree (8B passes tp=2; k/v
+    break at tp=4 — 1024 channels / 4 = 256 per device vs tile 512)."""
     from unionml_tpu.ops.int4_matmul import tile_for
 
     if tensor <= 1 or config.weight_bits != 4:

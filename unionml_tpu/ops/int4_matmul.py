@@ -64,7 +64,7 @@ def _grid_for(n: int, k: int):
     scoped-VMEM budget (the int32 unpack temps scale with K x TILE), so
     K splits into grid blocks with output accumulation — k_block halves
     until the weight-side buffers fit (K=14336 down-projections run
-    tile 256 x k_block 7168). Returns ``(0, 0)`` when N is odd (cannot
+    tile 512 x k_block 3584). Returns ``(0, 0)`` when N is odd (cannot
     pack two nibbles per byte)."""
     if n % 2:
         return 0, 0
