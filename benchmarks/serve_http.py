@@ -60,6 +60,13 @@ def main() -> None:
         "shallow pipelines or joins queue behind the chunk backlog)",
     )
     parser.add_argument(
+        "--prefill-chunk", type=int, default=None,
+        help="engine mode: admit buckets larger than this in chunked "
+        "prefill programs so resident decodes never stall behind a long "
+        "prompt (default: 512 when --prompt-len >= 4096, like the "
+        "generator's long-context rule; 0 disables)",
+    )
+    parser.add_argument(
         "--checkpoint", default=None,
         help="HF safetensors checkpoint directory — serve REAL weights, "
         "streamed to int8 on load (models/convert.py); geometry comes "
@@ -226,10 +233,19 @@ def main() -> None:
             # can swap in an 8B-class model under any preset
             per_step_ms = 11.0 if qcfg.hidden_dim >= 4096 else 3.3
             depth = max(2, int(round(120.0 / (args.chunk_steps * per_step_ms))))
+        prefill_chunk = args.prefill_chunk
+        if prefill_chunk is None:
+            # auto only when the bucket divides evenly — an explicit flag
+            # still surfaces DecodeEngine's divisibility error
+            prefill_chunk = (
+                512 if args.prompt_len >= 4096 and args.prompt_len % 512 == 0
+                else 0
+            )
         engine = DecodeEngine(
             qmodule, slots=args.clients, max_new_tokens=args.new_tokens,
             prompt_buckets=(args.prompt_len,), chunk_steps=args.chunk_steps,
             pipeline_depth=depth,
+            prefill_chunk=prefill_chunk or None,
         )
 
         @model.predictor

@@ -431,3 +431,118 @@ def test_stream_consumer_disconnect_frees_slot(tiny_llama):
         assert len(out[0]) == 4
     finally:
         engine.close()
+
+
+def test_chunked_prefill_token_identity(tiny_llama):
+    """Buckets above prefill_chunk admit via lead-chunk programs + a
+    final splice; every request (short prompt in a long bucket, exact
+    multiples, ragged tails) matches its solo generation."""
+    module, params = tiny_llama
+    engine = DecodeEngine(
+        module, slots=4, max_new_tokens=8, prompt_buckets=(8, 64),
+        prefill_chunk=16, chunk_steps=4,
+    )
+    try:
+        rng = np.random.default_rng(11)
+        # 5/8 → monolithic bucket 8; 9 → 1 (final-only) chunk in bucket
+        # 64; 16/33 → ragged; 64 → full 4-chunk cover
+        prompts = [
+            rng.integers(1, 97, size=n).tolist() for n in (5, 8, 9, 16, 33, 64)
+        ]
+        outs = engine.generate(params, prompts)
+        for prompt, out in zip(prompts, outs):
+            assert out == _solo(module, params, prompt, 8)
+    finally:
+        engine.close()
+
+
+def test_chunked_prefill_with_system_prefix(tiny_llama):
+    """Chunked admission composes with the shared system prefix: the
+    fresh cache seeds the prefix rows before the lead chunks run."""
+    module, params = tiny_llama
+    rng = np.random.default_rng(13)
+    prefix = rng.integers(1, 97, 7).tolist()
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=6, prompt_buckets=(32,),
+        prefill_chunk=8, chunk_steps=3, system_prefix=prefix,
+    )
+    try:
+        prompts = [rng.integers(1, 97, size=n).tolist() for n in (9, 20, 32)]
+        outs = engine.generate(params, prompts)
+        for prompt, out in zip(prompts, outs):
+            assert out == _solo(module, params, prefix + prompt, 6)
+    finally:
+        engine.close()
+
+
+def test_chunked_prefill_with_kv_quant(tiny_llama):
+    """Long-bucket chunked admission over the int8 KV cache: lead chunks
+    carry the quantized (k_q, v_q, scales) layout through the fresh
+    cache and the final splice."""
+    import dataclasses
+
+    module, params = tiny_llama
+    qmodule = Llama(dataclasses.replace(module.config, kv_quant=True))
+    engine = DecodeEngine(
+        qmodule, slots=2, max_new_tokens=8, prompt_buckets=(48,),
+        prefill_chunk=16, chunk_steps=4,
+    )
+    try:
+        rng = np.random.default_rng(17)
+        prompts = [rng.integers(1, 97, size=n).tolist() for n in (10, 48)]
+        outs = engine.generate(params, prompts)
+        for prompt, out in zip(prompts, outs):
+            assert out == _solo(qmodule, params, prompt, 8)
+    finally:
+        engine.close()
+
+
+def test_decode_interleaves_with_chunked_admission(tiny_llama):
+    """While a long prompt admits chunk-by-chunk, resident slots keep
+    decoding: at least one decode chunk is dispatched strictly between
+    the first and last prefill-chunk dispatches of the admission."""
+    module, params = tiny_llama
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=180, prompt_buckets=(8, 64),
+        prefill_chunk=8, chunk_steps=2, pipeline_depth=2,
+    )
+    try:
+        engine.warmup(params)
+        events = []
+        lock = threading.Lock()
+        real_step, real_decode = engine._prefill_step, engine._decode_chunk
+
+        def rec_step(*a, **k):
+            with lock:
+                events.append("prefill_step")
+            return real_step(*a, **k)
+
+        def rec_decode(*a, **k):
+            with lock:
+                events.append("decode")
+            return real_decode(*a, **k)
+
+        engine._prefill_step = rec_step
+        engine._decode_chunk = rec_decode
+
+        # occupy a slot with a LONG decode (180 tokens = 90 chunks, far
+        # more than can dispatch during the sleep), then admit a 64-token
+        # prompt (8 lead chunks): its admission must not stall the decode
+        rng = np.random.default_rng(19)
+        bg = threading.Thread(
+            target=lambda: engine.generate(
+                params, [rng.integers(1, 97, 8).tolist()]
+            )
+        )
+        bg.start()
+        time.sleep(0.05)  # let the background request admit + start decoding
+        out = engine.generate(
+            params, [rng.integers(1, 97, 64).tolist()], max_new_tokens=4
+        )
+        bg.join(timeout=60)
+        first = events.index("prefill_step")
+        last = len(events) - 1 - events[::-1].index("prefill_step")
+        assert "decode" in events[first:last], events
+        assert len(out[0]) == 4
+    finally:
+        engine.close()

@@ -16,6 +16,13 @@ This engine holds a **fixed-slot decode batch** resident on device:
 - a new request's prompt is **prefilled into a free slot** between
   decode steps (its own small ``[1, bucket]`` program, then one
   ``dynamic_update_slice`` of the produced KV rows into the slot);
+  buckets larger than ``prefill_chunk`` admit **chunked**: the lead
+  chunks fill a standalone fresh cache one ``[1, chunk]`` program at a
+  time with decode chunks interleaved between them, so resident slots
+  keep streaming tokens while an 8k-class prompt admits instead of
+  head-of-line-blocking behind its whole prefill (the long-context
+  serving path; only ``ceil(true_len / chunk)`` chunk programs run, so
+  a short prompt in a long bucket pays for its own length);
 - decode runs in **chunks of ``chunk_steps`` inside one
   ``lax.scan``**, and up to ``pipeline_depth`` chunks are **dispatched
   asynchronously** — the dispatcher thread never blocks on a chunk's
@@ -41,7 +48,8 @@ This engine holds a **fixed-slot decode batch** resident on device:
 
 TPU-first notes: every program has static shapes (slots, bucket set,
 chunk length are fixed at construction — XLA compiles
-``len(prompt_buckets) + 1`` executables total); the per-slot cache write
+``len(prompt_buckets) + 1`` executables total, plus three per chunked
+bucket: fresh-init, lead chunk, final chunk); the per-slot cache write
 is a vmapped ``dynamic_update_slice`` (one scatter); state is donated
 through both programs so the multi-GB cache never copies.
 
@@ -94,6 +102,25 @@ def _splice_rows(dst_tree, src_tree, b_start, r_start):
         )
         for dst_layer, src_layer in zip(dst_tree, src_tree)
     )
+
+
+@dataclass
+class _Admission:
+    """A chunked prefill in progress: host cursor over the lead chunks.
+
+    The fresh cache lives here (device-side), not in the engine state —
+    lead chunk dispatches donate it forward while decode chunks donate
+    the resident state, so the two program streams never contend for a
+    buffer and interleave freely in dispatch order."""
+
+    req: "_Request"
+    slot: int
+    bucket: int
+    chunk: int                      # prefill_chunk (tokens per program)
+    n_chunks: int                   # total programs incl. the final
+    padded: np.ndarray              # [bucket] right-padded prompt
+    fresh: Any                      # [1, P + bucket] cache being filled
+    next_chunk: int = 0
 
 
 @dataclass
@@ -151,6 +178,20 @@ class DecodeEngine:
             (pipeline_depth + 1) * chunk_steps`` — decode attention reads
             all of it every step, so keep the bucket set tight for the
             traffic you serve.
+        prefill_chunk: when set, a bucket LARGER than this prefills in
+            ``prefill_chunk``-token programs instead of one monolithic
+            ``[1, bucket]`` pass. The lead chunks fill a standalone fresh
+            cache that never touches the resident state, so the
+            dispatcher interleaves DECODE chunks between them — resident
+            slots keep streaming tokens while a long prompt admits,
+            instead of head-of-line-blocking behind its whole prefill
+            (the long-context admission path; VMEM for the prefill
+            score buffer is bounded by the chunk, the same knob
+            :func:`~unionml_tpu.models.generate.make_generator` uses for
+            8k contexts). Only ``ceil(true_len / prefill_chunk)`` chunk
+            programs run per admission — a short prompt routed into a
+            long bucket pays for its own length, not the bucket's.
+            Chunked buckets must divide evenly by ``prefill_chunk``.
         chunk_steps: decode steps per dispatched chunk (join granularity).
         pipeline_depth: max decode chunks in flight before their token
             readbacks are harvested. Size it so ``depth * chunk compute``
@@ -169,6 +210,7 @@ class DecodeEngine:
         slots: int = 8,
         max_new_tokens: int = 32,
         prompt_buckets: Sequence[int] = (64,),
+        prefill_chunk: Optional[int] = None,
         chunk_steps: int = 8,
         pipeline_depth: int = 8,
         temperature: float = 0.0,
@@ -194,6 +236,20 @@ class DecodeEngine:
         self.slots = slots
         self.max_new_tokens = max_new_tokens
         self.buckets = tuple(sorted(set(int(b) for b in prompt_buckets)))
+        self.prefill_chunk = None if prefill_chunk is None else int(prefill_chunk)
+        if self.prefill_chunk is not None:
+            if self.prefill_chunk < 1:
+                raise ValueError("prefill_chunk must be >= 1")
+            bad = [
+                b for b in self.buckets
+                if b > self.prefill_chunk and b % self.prefill_chunk
+            ]
+            if bad:
+                raise ValueError(
+                    f"buckets {bad} are not multiples of prefill_chunk "
+                    f"{self.prefill_chunk} — chunked prefill needs even "
+                    "chunk coverage (pad the bucket or change the chunk)"
+                )
         self.chunk_steps = chunk_steps
         self.pipeline_depth = max(1, pipeline_depth)
         self.eos_id = eos_id
@@ -249,6 +305,9 @@ class DecodeEngine:
         # (admission spans the prefill dispatch): bind()'s busy check must
         # see them or a concurrent swap lands mid-admission
         self._admitting = 0
+        # chunked admission in progress (dispatcher thread only); its
+        # reserved slot keeps occupant None until the final chunk lands
+        self._admission: Optional[_Admission] = None
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._lock = threading.Lock()
         # dispatch→harvest pipeline: FIFO of in-flight readbacks; the
@@ -333,15 +392,27 @@ class DecodeEngine:
 
             self._seed_prefix = jax.jit(seed_prefix, donate_argnums=(1,))
 
-        def prefill(params, state, slot, tokens, true_len, key, prefix_rows):
-            """Run one prompt (padded to its bucket) through a fresh
-            [1, prefix + bucket] cache seeded with the shared prefix
-            rows, splice the SUFFIX KV rows into ``slot`` (the slot's
-            prefix rows were broadcast at seed time and never rewritten)."""
-            bucket = tokens.shape[0]
+        import functools
+
+        def build_fresh(prefix_rows, bucket: int):
+            """A fresh [1, P + bucket] cache seeded with the shared
+            prefix rows (traced into both prefill forms)."""
             fresh = init_cache(cfg, 1, P + bucket)
             if P:
                 fresh = _splice_rows(fresh, prefix_rows, 0, 0)
+            return fresh
+
+        def finish_prefill(params, state, fresh, slot, toks, start, true_len, key):
+            """The SINGLE home for the prefill tail (monolithic and
+            chunked admissions both trace it — a desynced invariant here
+            would corrupt one path silently): run ``toks`` (the whole
+            right-padded bucket at ``start=0``, or the final chunk at its
+            suffix offset) against ``fresh``, sample the first token at
+            the last REAL position, splice the whole suffix into ``slot``
+            (garbage rows above ``true_len`` stay masked False in the
+            resident kv_mask)."""
+            bucket = fresh[0][0].shape[1] - P
+            c = toks.shape[1]
             kv_mask = jnp.concatenate(
                 [
                     jnp.ones((1, P), bool),
@@ -350,12 +421,12 @@ class DecodeEngine:
                 axis=1,
             )
             logits, filled = module.apply(
-                {"params": params}, tokens[None],
-                positions=P + jnp.arange(bucket)[None, :],
-                cache=fresh, cache_index=jnp.int32(P), kv_mask=kv_mask,
+                {"params": params}, toks,
+                positions=P + start + jnp.arange(c)[None, :],
+                cache=fresh, cache_index=P + start, kv_mask=kv_mask,
                 # head on the last REAL position only — the full-bucket
                 # head would materialize [1, bucket, vocab] fp32
-                logit_index=jnp.reshape(true_len - 1, (1,)),
+                logit_index=jnp.reshape(true_len - 1 - start, (1,)),
             )
             first = sample(logits[:, 0], key)[0]
             # suffix rows only ([P, P + bucket)): the slot's prefix rows
@@ -377,7 +448,48 @@ class DecodeEngine:
                 "done": state["done"].at[slot].set(False),
             }, first
 
+        def prefill(params, state, slot, tokens, true_len, key, prefix_rows):
+            """Monolithic admission: fresh build + full-bucket finish in
+            ONE program (short buckets; one dispatch per admission)."""
+            fresh = build_fresh(prefix_rows, tokens.shape[0])
+            return finish_prefill(
+                params, state, fresh, slot, tokens[None], jnp.int32(0),
+                true_len, key,
+            )
+
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
+
+        # ---- chunked prefill (long buckets): lead chunks fill a fresh
+        # [1, P + bucket] cache WITHOUT touching the resident state, so
+        # decode chunks interleave between them; only the final chunk
+        # (finish_prefill) splices into the slot and samples token 0 ----
+
+        @functools.partial(jax.jit, static_argnames=("bucket",))
+        def init_fresh(prefix_rows, *, bucket):
+            return build_fresh(prefix_rows, bucket)
+
+        self._init_fresh = init_fresh
+
+        def prefill_step(params, fresh, toks, start):
+            """One lead chunk: tokens are fully real (the host only runs
+            chunks covering the true length; the final, possibly padded,
+            chunk goes through ``finish_prefill``)."""
+            lf = fresh[0][0].shape[1]          # P + bucket (static)
+            c = toks.shape[1]
+            kv_mask = (jnp.arange(lf) < P + start + c)[None, :]
+            _, fresh = module.apply(
+                {"params": params}, toks,
+                positions=P + start + jnp.arange(c)[None, :],
+                cache=fresh, cache_index=P + start, kv_mask=kv_mask,
+                # head output unused → DCE'd; the chunk only fills cache
+                logit_index=jnp.zeros((1,), jnp.int32),
+            )
+            return fresh
+
+        self._prefill_step = jax.jit(prefill_step, donate_argnums=(1,))
+        # donate the resident state only: no output matches the fresh
+        # cache's [1, P + bucket] shape, so donating it would just warn
+        self._prefill_final = jax.jit(finish_prefill, donate_argnums=(1,))
 
         def decode_chunk(params, state, active, keys):
             """``chunk_steps`` decode steps for every slot in one scan."""
@@ -594,6 +706,10 @@ class DecodeEngine:
         self._stop.set()
         self._worker.join(timeout=5.0)
         self._harvester.join(timeout=5.0)
+        with self._lock:
+            adm, self._admission = self._admission, None
+        if adm is not None:
+            self._drop_admission(adm.req, RuntimeError("decode engine closed"))
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -623,12 +739,10 @@ class DecodeEngine:
         self._key, *subs = self._jax.random.split(self._key, num + 1)
         return subs
 
-    def _admit(self, req: _Request):
-        """Dispatch ``req``'s prefill into a free slot WITHOUT blocking on
-        the first token (its readback is harvested later, in dispatch
-        order). Dispatcher thread only; occupancy mutates under the lock."""
-        import jax.numpy as jnp
-
+    def _admission_preamble(self, req: _Request):
+        """The shared start of every admission (monolithic and chunked —
+        ONE home so timing/padding policy cannot desync): pick the free
+        slot, stamp queue-wait, right-pad the prompt to its bucket."""
         with self._lock:
             slot = self._occupant.index(None)
         t0 = time.perf_counter()
@@ -637,6 +751,15 @@ class DecodeEngine:
         bucket = self._bucket_for(len(req.prompt))
         padded = np.full(bucket, self.pad_id, np.int32)
         padded[: len(req.prompt)] = req.prompt
+        return slot, bucket, padded
+
+    def _admit(self, req: _Request):
+        """Dispatch ``req``'s prefill into a free slot WITHOUT blocking on
+        the first token (its readback is harvested later, in dispatch
+        order). Dispatcher thread only; occupancy mutates under the lock."""
+        import jax.numpy as jnp
+
+        slot, _bucket, padded = self._admission_preamble(req)
         (key,) = self._next_key()
         self._state, first = self._prefill(
             self._params, self._state, jnp.int32(slot), jnp.asarray(padded),
@@ -769,15 +892,30 @@ class DecodeEngine:
             self._admitting += 1
         return req
 
-    def _admit_or_drop(self, req: _Request) -> None:
-        """Dispatcher: prefill a dequeued request (counted in
-        ``_admitting`` by ``_pop_request``), or drop it if its waiter
-        already timed out (no point burning a slot on it)."""
+    def _drop_admission(self, req: _Request, exc: BaseException) -> None:
+        """Fail a request still mid-admission and release its count.
+        Idempotent (keyed on the request event): the dispatcher's own
+        error path and a concurrent ``_fail_all`` from the harvester must
+        not double-release ``_admitting``."""
+        with self._lock:
+            if req.event.is_set():
+                return
+            req.error = exc
+            self._admitting -= 1
+        req.event.set()
+        req.finish_stream()
+
+    def _start_admission(self, req: _Request) -> None:
+        """Dispatcher: begin admitting a dequeued request (counted in
+        ``_admitting`` by ``_pop_request``). Short buckets prefill in one
+        monolithic dispatch; buckets larger than ``prefill_chunk`` start
+        a chunked admission whose lead chunks are dispatched one per loop
+        pass, interleaved with decode chunks."""
         try:
             if req.abandoned:
-                req.error = TimeoutError("request abandoned before admission")
-                req.event.set()
-                req.finish_stream()
+                self._drop_admission(
+                    req, TimeoutError("request abandoned before admission")
+                )
                 return
             if self._state is None:
                 self._state = self._init_state()
@@ -786,15 +924,78 @@ class DecodeEngine:
                     self._state, self._prefix_rows = self._seed_prefix(
                         self._params, self._state
                     )
-            try:
+            bucket = self._bucket_for(len(req.prompt))
+            chunk = self.prefill_chunk
+            if chunk is None or bucket <= chunk:
                 self._admit(req)
-            except BaseException as exc:
-                req.error = exc
-                req.event.set()
-                req.finish_stream()
-        finally:
+                with self._lock:
+                    self._admitting -= 1
+                return
+            slot, bucket, padded = self._admission_preamble(req)
+            # only the chunks covering the TRUE length run — a short
+            # prompt routed into a long bucket pays for its own length
+            n_chunks = -(-len(req.prompt) // chunk)
+            fresh = self._init_fresh(self._prefix_rows, bucket=bucket)
             with self._lock:
+                self._admission = _Admission(
+                    req=req, slot=slot, bucket=bucket, chunk=chunk,
+                    n_chunks=n_chunks, padded=padded, fresh=fresh,
+                )
+        except BaseException as exc:
+            with self._lock:
+                self._admission = None
+            self._drop_admission(req, exc)
+
+    def _advance_admission(self, adm: _Admission) -> None:
+        """Dispatch ONE prefill chunk of the in-progress admission (the
+        final chunk finishes into the slot); decode chunks dispatch
+        between calls, so resident slots never stall behind a long
+        prompt's whole prefill. ``_fail_all``/``close`` may concurrently
+        null ``_admission`` — every transition re-checks identity under
+        the lock so the admission is completed or dropped exactly once."""
+        import jax.numpy as jnp
+
+        req = adm.req
+        try:
+            if req.abandoned:
+                with self._lock:
+                    if self._admission is not adm:
+                        return
+                    self._admission = None
+                self._drop_admission(
+                    req, TimeoutError("request abandoned during admission")
+                )
+                return
+            start = adm.next_chunk * adm.chunk
+            toks = jnp.asarray(adm.padded[None, start: start + adm.chunk])
+            if adm.next_chunk < adm.n_chunks - 1:
+                adm.fresh = self._prefill_step(
+                    self._params, adm.fresh, toks, jnp.int32(start)
+                )
+                adm.next_chunk += 1
+                return
+            (key,) = self._next_key()
+            self._state, first = self._prefill_final(
+                self._params, self._state, adm.fresh, jnp.int32(adm.slot),
+                toks, jnp.int32(start), jnp.int32(len(req.prompt)), key,
+            )
+            _start_host_copy(first)
+            with self._lock:
+                if self._admission is not adm:
+                    # raced with _fail_all/close: the request was already
+                    # failed and its count released — do not re-admit
+                    return
+                self._admission = None
+                self._occupant[adm.slot] = req
+                self._slot_gen[adm.slot] += 1
+                req._expected = 1
                 self._admitting -= 1
+            self._inflight.put(("prefill", adm.slot, req, first))
+        except BaseException as exc:
+            with self._lock:
+                if self._admission is adm:
+                    self._admission = None
+            self._drop_admission(req, exc)
 
     def _run(self):
         """Dispatcher: admit queued requests into free slots and keep up
@@ -809,10 +1010,15 @@ class DecodeEngine:
         while not self._stop.is_set():
             try:
                 progressed = False
-                req = self._pop_request()
-                if req is not None:
-                    self._admit_or_drop(req)
+                adm = self._admission
+                if adm is not None:
+                    self._advance_admission(adm)
                     progressed = True
+                else:
+                    req = self._pop_request()
+                    if req is not None:
+                        self._start_admission(req)
+                        progressed = True
                 if self._dispatch_chunk():
                     progressed = True
                 if not progressed:
@@ -841,6 +1047,10 @@ class DecodeEngine:
 
     def _fail_all(self, exc: BaseException) -> None:
         logger.info(f"decode engine error: {exc!r}")
+        with self._lock:
+            adm, self._admission = self._admission, None
+        if adm is not None:
+            self._drop_admission(adm.req, exc)
         with self._lock:
             for slot, req in enumerate(self._occupant):
                 if req is not None:
