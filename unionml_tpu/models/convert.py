@@ -631,6 +631,8 @@ def _load_checkpoint(
     strict: bool,
     reader: Optional[_CheckpointReader] = None,
     bits: int = 8,
+    group_size: int = 0,
+    tensor: int = 1,
 ) -> Dict[str, Any]:
     if reader is None:
         reader = _CheckpointReader(path)
@@ -700,17 +702,30 @@ def _load_checkpoint(
                     tile_for,
                 )
 
-                tile = tile_for(w2d.shape[1], k)
-            if tile:
+                # column-parallel sites pack for the tree's TP degree
+                # (quantize_params parity: the tile must divide each
+                # device's channel count)
+                col_parallel = spec.path[-2] in ("q", "k", "v", "gate", "up")
+                tile = tile_for(
+                    w2d.shape[1], k, shards=tensor if col_parallel else 1
+                )
+            if tile and (group_size == 0 or k % group_size == 0):
                 # streamed packed-int4 (quantize_params(bits=4) parity;
                 # untileable widths fall through to int8 like the
                 # in-memory path and the serving module's fallback)
-                q, scale = quantize_kernel_int4(w2d, tile)
+                q, scale = quantize_kernel_int4(
+                    w2d, tile, group_size=group_size
+                )
                 _set_path(params, parent + ("kernel_p",), q)
+                _set_path(
+                    params,
+                    parent + (("scale_g" if group_size else "scale"),),
+                    scale,
+                )
             else:
                 q, scale = _quantize_on_device(w2d)
                 _set_path(params, parent + ("kernel_q",), q)
-            _set_path(params, parent + ("scale",), scale)
+                _set_path(params, parent + ("scale",), scale)
         else:
             arr = put(w)
             if jnp.issubdtype(arr.dtype, jnp.floating) and not spec.keep_dtype:
@@ -782,6 +797,7 @@ def load_llama_checkpoint(
         path, llama_tensor_specs(config),
         quantize=quantize, dtype=dtype, device=device, strict=strict,
         bits=config.weight_bits,
+        group_size=config.int4_group, tensor=config.int4_tp,
     )
     return params, config
 

@@ -590,10 +590,12 @@ def serving_params(params, dtype=jnp.bfloat16):
                     continue
                 # a scale is quant metadata only next to its int8/int4
                 # sibling (QuantizedDenseGeneral: kernel_q+scale;
-                # Int4DenseGeneral: kernel_p+scale; MoE experts:
-                # w_*_q + w_*_scale) — norm params also named "scale" cast
+                # Int4DenseGeneral: kernel_p+scale or group-wise
+                # scale_g; MoE experts: w_*_q + w_*_scale) — norm params
+                # also named "scale" cast
                 is_quant_scale = (
-                    k == "scale" and ("kernel_q" in node or "kernel_p" in node)
+                    k in ("scale", "scale_g")
+                    and ("kernel_q" in node or "kernel_p" in node)
                 ) or (
                     k.endswith("_scale") and f"{k[: -len('_scale')]}_q" in node
                 )

@@ -47,6 +47,8 @@ def make_dense(
     lora_rank: int = 0,
     lora_alpha: float = 16.0,
     weight_bits: int = 8,
+    int4_group: int = 0,
+    int4_shards: int = 1,
 ):
     """Dense-projection factory shared by every matmul site that supports
     the int8 weight-only serving path (Attention qkv/o, gated MLP,
@@ -73,7 +75,8 @@ def make_dense(
             from unionml_tpu.models.quantization import Int4DenseGeneral
 
             return Int4DenseGeneral(
-                features=features, axis=axis, dtype=dtype, name=name
+                features=features, axis=axis, dtype=dtype, name=name,
+                group_size=int4_group, shards=int4_shards,
             )
         from unionml_tpu.models.quantization import QuantizedDenseGeneral
 
@@ -279,6 +282,8 @@ class Attention(nn.Module):
     sequence_axis: Optional[str] = None
     quantized: bool = False  # weight-only quantized projections (serving)
     weight_bits: int = 8     # 8 = int8; 4 = packed-int4 (decode bandwidth)
+    int4_group: int = 0      # >0: group-wise int4 scales (scale_g [K/g, N])
+    int4_tp: int = 1         # TP degree the int4 packing must survive
     lora_rank: int = 0  # >0: trainable low-rank adapters on q/k/v/o
     lora_alpha: float = 16.0
     # biases on q/k/v/o (HF ViT/BERT-style checkpoints carry them; the
@@ -318,13 +323,17 @@ class Attention(nn.Module):
         batch, seq, features = x.shape
         kv_heads = self.num_kv_heads or self.num_heads
         head_dim = self.head_dim or features // self.num_heads
-        dense = lambda feats, name: make_dense(  # noqa: E731
+        dense = lambda feats, name, shards=1: make_dense(  # noqa: E731
             quantized=self.quantized, features=feats, axis=-1,
             dtype=self.dtype, param_dtype=self.param_dtype, name=name,
             lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
             use_bias=self.use_bias, weight_bits=self.weight_bits,
+            int4_group=self.int4_group, int4_shards=shards,
         )
-        q = dense((self.num_heads, head_dim), "q")(x)
+        # q/k/v are COLUMN-parallel under TP (N sharded): their int4
+        # packing tile must divide the per-device channel count. o is
+        # row-parallel (K sharded, N whole) — shards stays 1.
+        q = dense((self.num_heads, head_dim), "q", self.int4_tp)(x)
         if kv is not None:
             if self.causal or self.rope or cache is not None:
                 raise ValueError(
@@ -348,9 +357,10 @@ class Attention(nn.Module):
                 dtype=self.dtype, param_dtype=self.param_dtype, name="o",
                 lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
                 use_bias=self.use_bias, weight_bits=self.weight_bits,
+                int4_group=self.int4_group,
             )(out)
-        k = dense((kv_heads, head_dim), "k")(x)
-        v = dense((kv_heads, head_dim), "v")(x)
+        k = dense((kv_heads, head_dim), "k", self.int4_tp)(x)
+        v = dense((kv_heads, head_dim), "v", self.int4_tp)(x)
 
         if positions is None:
             base = jnp.asarray(cache_index if cache_index is not None else 0)
@@ -454,6 +464,7 @@ class Attention(nn.Module):
             dtype=self.dtype, param_dtype=self.param_dtype, name="o",
             lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
             use_bias=self.use_bias, weight_bits=self.weight_bits,
+            int4_group=self.int4_group,
         )(out)
         if cache is not None:
             return out, new_cache
@@ -467,6 +478,8 @@ class MlpBlock(nn.Module):
     gated: bool = False  # True → SwiGLU
     quantized: bool = False  # weight-only quantized (bias-free gated form only)
     weight_bits: int = 8
+    int4_group: int = 0      # >0: group-wise int4 scales (scale_g [K/g, N])
+    int4_tp: int = 1         # TP degree the int4 packing must survive
     lora_rank: int = 0  # >0: trainable low-rank adapters on gate/up/down
     lora_alpha: float = 16.0
     # tanh-approximate GELU by default (one transcendental cheaper on the
@@ -481,15 +494,19 @@ class MlpBlock(nn.Module):
         features = x.shape[-1]
         if self.quantized:
             assert self.gated, "quantized MlpBlock supports the bias-free gated form"
-        dense = lambda feats, name: make_dense(  # noqa: E731
+        dense = lambda feats, name, shards=1: make_dense(  # noqa: E731
             quantized=self.quantized, features=feats, dtype=self.dtype,
             param_dtype=self.param_dtype, use_bias=not self.gated, name=name,
             lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
             weight_bits=self.weight_bits,
+            int4_group=self.int4_group, int4_shards=shards,
         )
         if self.gated:
-            gate = nn.silu(dense(self.hidden_dim, "gate")(x))
-            up = dense(self.hidden_dim, "up")(x)
+            # gate/up are column-parallel under TP (N sharded): their
+            # int4 tile must divide the per-device width; down is
+            # row-parallel and keeps shards=1
+            gate = nn.silu(dense(self.hidden_dim, "gate", self.int4_tp)(x))
+            up = dense(self.hidden_dim, "up", self.int4_tp)(x)
             return dense(features, "down")(gate * up)
         h = nn.gelu(
             dense(self.hidden_dim, "up")(x), approximate=self.gelu_approximate

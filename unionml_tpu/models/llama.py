@@ -56,6 +56,13 @@ class LlamaConfig:
     # Pallas decode kernel (ops/int4_matmul.py) — halves decode weight
     # traffic again. LoRA/QLoRA and MoE experts stay int8.
     weight_bits: int = 8
+    # int4 quality/parallelism knobs (weight_bits=4 only). int4_group>0:
+    # group-wise scales [K/g, N] (quantize_params(group_size=...) must
+    # match). int4_tp>1: the tensor degree the packing tiles must
+    # survive (quantize_params(tensor=...) must match) — serving at any
+    # DIVISOR of int4_tp stays slab-aligned; a finer split does not.
+    int4_group: int = 0
+    int4_tp: int = 1
     remat: bool = False  # gradient checkpointing per block (long-context training)
     # mixture-of-experts MLPs (0 = dense). Experts shard over the mesh's
     # `expert` axis via LLAMA_MOE_PARTITION_RULES; GSPMD inserts the
@@ -131,6 +138,8 @@ class LlamaBlock(nn.Module):
             sequence_axis=cfg.sequence_axis,
             quantized=cfg.quantized,
             weight_bits=cfg.weight_bits,
+            int4_group=cfg.int4_group,
+            int4_tp=cfg.int4_tp,
             lora_rank=cfg.lora_rank,
             lora_alpha=cfg.lora_alpha,
             dtype=dtype,
@@ -168,6 +177,7 @@ class LlamaBlock(nn.Module):
             x = x + MlpBlock(
                 hidden_dim=cfg.mlp_dim, gated=True, quantized=cfg.quantized,
                 weight_bits=cfg.weight_bits,
+                int4_group=cfg.int4_group, int4_tp=cfg.int4_tp,
                 lora_rank=cfg.lora_rank, lora_alpha=cfg.lora_alpha,
                 dtype=dtype, name="mlp",
             )(h)
@@ -230,6 +240,11 @@ class Llama(nn.Module):
         logits = make_dense(
             quantized=cfg.quantized, features=cfg.vocab_size,
             weight_bits=cfg.weight_bits,
+            # lm_head is ROW-parallel under int4 TP (kernel_p K-sharded,
+            # partial logits psum'd by GSPMD): 8B's 128256 channels have
+            # no power-of-two tile split, but K=hidden always divides —
+            # so shards stays 1 and the packing tile ignores TP
+            int4_group=cfg.int4_group,
             dtype=jnp.float32, name="lm_head",
         )(x.astype(jnp.float32))
         if cache is not None:
@@ -296,24 +311,33 @@ from unionml_tpu.models.lora import LORA_PARTITION_RULES  # noqa: E402
 LLAMA_LORA_PARTITION_RULES = LORA_PARTITION_RULES + LLAMA_QUANT_PARTITION_RULES
 
 # packed-int4 serving (weight_bits=4): kernel_p is [K, N/2] (packed
-# output channels) with scale [N]. Megatron layout as int8; a `tensor`
-# shard of the packed/scale columns is self-consistent only when each
-# device's channel range is a multiple of the packing tile — validate
-# with assert_int4_tp_compatible (8B passes tp=2; k/v break at tp=4).
+# output channels). Megatron layout as int8 for q/k/v/gate/up (N
+# sharded — a `tensor` shard of the packed/scale columns is
+# self-consistent because the packing tile divides the per-device
+# channel count when the tree is quantized with tensor=int4_tp; validate
+# with assert_int4_tp_compatible) and o/down (K sharded). The lm_head is
+# ROW-parallel (K sharded): 8B's 128256 channels have no power-of-two
+# tile split, but K=hidden always divides, with GSPMD psum-ing the
+# partial logits. Group-wise scales (`scale_g` [K/g, N]) follow their
+# kernel: column-parallel sites shard N, row-parallel sites shard the
+# K-group rows.
 LLAMA_INT4_PARTITION_RULES = (
-    # OVERRIDE (first match wins): the int4 lm_head kernel_p is
-    # replicated (see below), so its [vocab] fp32 scale must be too —
-    # the inherited int8 rule would shard it against a replicated
-    # kernel, inserting a gather on every decode step
+    # OVERRIDES (first match wins) of the inherited int8 lm_head rules:
+    # the int4 lm_head is K-sharded, so its per-channel [vocab] scale is
+    # replicated (the int8 rule would shard it against unsharded partial
+    # logits, inserting a gather every decode step)
     PartitionRule(r"lm_head/scale$", ()),
+    PartitionRule(r"lm_head/scale_g$", ("tensor", None)),
+    PartitionRule(r"lm_head/kernel_p$", ("tensor", None)),
+    PartitionRule(r"attn/(q|k|v)/scale_g$", (None, "tensor")),
+    PartitionRule(r"attn/o/scale_g$", ("tensor", None)),
+    PartitionRule(r"mlp/(gate|up)/scale_g$", (None, "tensor")),
+    PartitionRule(r"mlp/down/scale_g$", ("tensor", None)),
 ) + LLAMA_QUANT_PARTITION_RULES + (
     PartitionRule(r"attn/(q|k|v)/kernel_p$", (None, "tensor")),
     PartitionRule(r"attn/o/kernel_p$", ("tensor", None)),
     PartitionRule(r"mlp/(gate|up)/kernel_p$", (None, "tensor")),
     PartitionRule(r"mlp/down/kernel_p$", ("tensor", None)),
-    # the lm_head stays REPLICATED under int4: 8B's 128256 channels make
-    # 501 tiles of 256 — indivisible by any tensor degree (2.1 GB packed
-    # per device; int4 is the single-chip density play)
 )
 
 
@@ -321,27 +345,37 @@ def assert_int4_tp_compatible(config: "LlamaConfig", tensor: int) -> None:
     """Refuse tensor-parallel degrees whose per-device channel ranges
     split an int4 packing tile — a misaligned shard pairs nibbles with
     the wrong output channels and decodes GARBAGE with no exception.
-    Call before sharding a ``weight_bits=4`` tree (8B passes tp=2; k/v
-    break at tp=4 — 1024 channels / 4 = 256 per device vs tile 512)."""
+    Call before sharding a ``weight_bits=4`` tree.
+
+    With ``config.int4_tp`` set (the degree ``quantize_params(tensor=…)``
+    packed for), any ``tensor`` DIVIDING it is slab-aligned — 8B packs
+    for tp=8 with tiles q 512 / k,v 128 / gate,up 256. A tree packed at
+    the default ``int4_tp=1`` keeps the old single-chip rule (8B then
+    passes tp=2; k/v break at tp=4 — 1024/4 = 256 per device vs tile
+    512). The lm_head is exempt: it shards K, which any degree divides.
+    """
     from unionml_tpu.ops.int4_matmul import tile_for
 
     if tensor <= 1 or config.weight_bits != 4:
         return
-    # column-parallel sites only (o/down shard K — row sharding leaves
-    # output channels whole; the lm_head is replicated under int4)
+    # column-parallel sites only (o/down/lm_head shard K — row sharding
+    # leaves output channels whole)
     sites = (
         ("attn/q", config.num_heads * config.head_dim, config.hidden_dim),
         ("attn/k", config.num_kv_heads * config.head_dim, config.hidden_dim),
         ("mlp/gate", config.mlp_dim, config.hidden_dim),
     )
     for name, n, k in sites:
-        tile = tile_for(n, k)
+        tile = tile_for(n, k, shards=config.int4_tp)
         if tile and (n // tensor) % tile:
             raise ValueError(
                 f"int4 layer {name}: {n} channels / tensor={tensor} = "
                 f"{n // tensor} per device, not a multiple of the packing "
-                f"tile {tile} — the shard would unpack wrong channels. "
-                "Lower the tensor degree or serve this model int8."
+                f"tile {tile} (tree packed for int4_tp={config.int4_tp}) — "
+                "the shard would unpack wrong channels. Re-quantize with "
+                f"quantize_params(tensor={tensor}) and "
+                f"LlamaConfig(int4_tp={tensor}), serve at a divisor of "
+                f"{config.int4_tp}, or serve this model int8."
             )
 
 # MoE configs (num_experts > 0): expert weights [E, d, h] shard E over the

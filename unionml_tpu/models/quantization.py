@@ -85,24 +85,38 @@ class Int4DenseGeneral(nn.Module):
 
     Stores ``kernel_p`` int8 ``[K, N/2]`` (two nibbles per byte, the
     tile-slab order of :mod:`unionml_tpu.ops.int4_matmul`) + fp32
-    ``scale [N]``. Decode-sized row counts run the Pallas kernel so HBM
+    ``scale [N]`` — or ``scale_g [K/group_size, N]`` when ``group_size``
+    is set (group-wise scales, the 4-bit quality recipe; the distinct
+    name keeps the 2D leaf's partition rules separate from the 1D
+    scale's). Decode-sized row counts run the Pallas kernel so HBM
     weight reads stay at the packed width — measured 1.54x over int8 on
     the streamed MLP probe (BASELINE.md round 4); other shapes take the
     XLA unpack path with identical semantics.
+
+    ``shards``: the tensor-parallel degree the packing tile must
+    survive (``tile_for``'s shard-aligned slab rule) — set it on
+    COLUMN-parallel sites (q/k/v, gate/up) when the tree is packed for
+    TP; row-parallel sites (o, down, the K-sharded lm_head) keep 1.
+    MUST match the ``tensor=`` the tree was quantized with, or the
+    baked slab order and the layer's tile disagree and decode produces
+    garbage (guarded by ``assert_int4_tp_compatible``).
     """
 
     features: Union[int, Sequence[int]]
     axis: Union[int, Sequence[int]] = -1
     dtype: Any = jnp.bfloat16
+    group_size: int = 0
+    shards: int = 1
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         from unionml_tpu.ops.int4_matmul import int4_matmul, tile_for
 
         xt, lead, feats, k, n = _dense_geometry(x, self.axis, self.features)
-        tile = tile_for(n, k)
-        if tile == 0:
-            # untileable width (odd N, VMEM-oversized single tile): the
+        tile = tile_for(n, k, shards=self.shards)
+        if tile == 0 or (self.group_size and k % self.group_size):
+            # untileable width (odd N, VMEM-oversized single tile) or a
+            # K-group that doesn't divide this layer's contraction: the
             # SAME per-layer int8 fallback quantize_params(bits=4)
             # applies — param structure and math match kernel_q+scale,
             # so a mixed int4/int8 tree loads as one module family
@@ -119,9 +133,16 @@ class Int4DenseGeneral(nn.Module):
         kernel_p = self.param(
             "kernel_p", nn.initializers.zeros, (k, n // 2), jnp.int8
         )
-        scale = self.param("scale", nn.initializers.ones, (n,), jnp.float32)
+        if self.group_size:
+            scale = self.param(
+                "scale_g", nn.initializers.ones,
+                (k // self.group_size, n), jnp.float32,
+            )
+        else:
+            scale = self.param("scale", nn.initializers.ones, (n,), jnp.float32)
         y = int4_matmul(
-            xt.reshape(-1, k), kernel_p, scale, tile_n=tile, dtype=self.dtype
+            xt.reshape(-1, k), kernel_p, scale, tile_n=tile,
+            dtype=self.dtype, group_size=self.group_size,
         )
         return y.reshape(lead + feats)
 
@@ -146,13 +167,32 @@ LLAMA_QUANT_PATTERNS = (
 )
 
 
-def quantize_params(params: Any, patterns: Sequence[str], *, bits: int = 8) -> Any:
+def quantize_params(
+    params: Any,
+    patterns: Sequence[str],
+    *,
+    bits: int = 8,
+    group_size: int = 0,
+    tensor: int = 1,
+) -> Any:
     """Convert fp dense kernels to the quantized param structure.
 
     ``bits=4`` produces the packed-int4 layout (``kernel_p`` + ``scale``
     — :class:`Int4DenseGeneral`) for matching DENSE kernels; MoE expert
     blocks stay int8 (no int4 expert kernel). Layers with an odd output
     width also stay int8.
+
+    ``group_size`` (bits=4 only): group-wise scales ``scale_g [K/g, N]``
+    instead of per-channel ``[N]`` — the 4-bit quality recipe. The model
+    config must carry the same ``int4_group`` so the module declares the
+    matching leaf.
+
+    ``tensor`` (bits=4 only): the tensor-parallel degree to pack for —
+    COLUMN-parallel sites (q/k/v, gate/up) bake a tile dividing their
+    per-device channel count so a ``tensor``-axis shard of the packed
+    columns stays a valid slab packing (row-parallel o/down and the
+    K-sharded lm_head are unaffected). The model config must carry the
+    same ``int4_tp``.
 
     ``patterns`` is required (use :data:`LLAMA_QUANT_PATTERNS` for the
     Llama zoo model): a catch-all would silently mis-split kernels whose
@@ -208,15 +248,29 @@ def quantize_params(params: Any, patterns: Sequence[str], *, bits: int = 8) -> A
                         tile_for,
                     )
 
-                    tile = tile_for(w2d.shape[1], w2d.shape[0])
-                    if tile:
-                        p, scale = quantize_kernel_int4(w2d, tile)
-                        out = {"kernel_p": p, "scale": scale}
+                    # column-parallel sites shard N: their tile must
+                    # divide the per-device channel count (matches the
+                    # shards= each Int4DenseGeneral site declares)
+                    col_parallel = path and path[-1] in (
+                        "q", "k", "v", "gate", "up"
+                    )
+                    shards = tensor if col_parallel else 1
+                    tile = tile_for(w2d.shape[1], w2d.shape[0], shards=shards)
+                    if tile and (
+                        group_size == 0 or w2d.shape[0] % group_size == 0
+                    ):
+                        p, scale = quantize_kernel_int4(
+                            w2d, tile, group_size=group_size
+                        )
+                        out = {
+                            "kernel_p": p,
+                            ("scale_g" if group_size else "scale"): scale,
+                        }
                         for extra, v in tree.items():
                             if extra != "kernel":
                                 out[extra] = v
                         return out
-                    # odd output width: int8 fallback below
+                    # odd output width / indivisible K-group: int8 below
                 q, scale = _quantize_kernel_2d(w2d)
                 out = {"kernel_q": q, "scale": scale}
                 for extra, v in tree.items():

@@ -56,21 +56,32 @@ MAX_PALLAS_ROWS = 64  # decode/verify row counts; larger rows → XLA path
 _VMEM_WEIGHT_BYTES = 11_000_000
 
 
-def _grid_for(n: int, k: int):
+def _grid_for(n: int, k: int, shards: int = 1, group_size: int = 0):
     """Pick ``(tile_n, k_block)`` for N output channels at contraction
     width K. Mosaic needs the packed block's last dim (tile/2) to
     divide 128 or equal the full packed width, so multi-tile means
-    tile ∈ {512, 256}; any even N works single-tile. Big K blows the
-    scoped-VMEM budget (the int32 unpack temps scale with K x TILE), so
-    K splits into grid blocks with output accumulation — k_block halves
-    until the weight-side buffers fit (K=14336 down-projections run
-    tile 512 x k_block 3584). Returns ``(0, 0)`` when N is odd (cannot
-    pack two nibbles per byte)."""
-    if n % 2:
+    tile ∈ {512, 256, 128}; any even N works single-tile. Big K blows
+    the scoped-VMEM budget (the int32 unpack temps scale with K x TILE),
+    so K splits into grid blocks with output accumulation — k_block
+    halves until the weight-side buffers fit (K=14336 down-projections
+    run tile 512 x k_block 3584). Returns ``(0, 0)`` when N is odd
+    (cannot pack two nibbles per byte).
+
+    ``shards``: tensor-parallel degree the packing must survive — the
+    tile must divide the PER-DEVICE channel count ``n // shards`` so
+    shard boundaries land on slab boundaries (any divisor of ``shards``
+    then also works at serve time). ``group_size``: group-wise scale
+    granularity — k_block additionally divides the group so each grid
+    step's partial product carries ONE scale row (see
+    :func:`int4_matmul`'s grouped path)."""
+    if n % 2 or n % max(1, shards):
         return 0, 0
-    candidates = [t for t in (512, 256) if n % t == 0] or [n]
+    local = n // max(1, shards)
+    candidates = [t for t in (512, 256, 128) if local % t == 0]
+    if not candidates and shards == 1:
+        candidates = [n]  # single-tile: any even width
     for t in candidates:
-        kb = k
+        kb = min(k, group_size) if group_size else k
         while 9 * kb * (t // 2) > _VMEM_WEIGHT_BYTES and kb % 2 == 0:
             kb //= 2
         if 9 * kb * (t // 2) <= _VMEM_WEIGHT_BYTES and (
@@ -156,6 +167,76 @@ def _pallas_int4(x, packed, *, n: int, tile_n: int, k_block: int, interpret: boo
     )(x, packed)
 
 
+def _kernel_grouped(x_ref, wp_ref, s_ref, o_ref, *, ratio: int):
+    """Group-wise variant: ``k_block`` divides the scale group, so this
+    step's whole partial product carries ONE scale row — the scale
+    multiply rides the small fp32 partial, never a materialized weight
+    tile. ``s_ref`` holds the tile's FULL [K/g, tile] scale slab (a
+    (1, tile) block would violate Mosaic's second-minor-divisible-by-8
+    rule; the slab is ~64 KB and the kernel slices its group row
+    dynamically — ``ratio = group_size / k_block`` maps the K grid
+    index to it)."""
+    from jax.experimental import pallas as pl
+
+    q = wp_ref[...].astype(jnp.int32)
+    hi = q >> 4
+    lo = ((q & 15) ^ 8) - 8
+    xb = x_ref[...]
+    y_lo = jax.lax.dot_general(
+        xb, lo.astype(xb.dtype),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    y_hi = jax.lax.dot_general(
+        xb, hi.astype(xb.dtype),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    kb = pl.program_id(1)
+    row = kb if ratio == 1 else jax.lax.div(kb, jnp.int32(ratio))
+    # dynamic REF load (value-level dynamic_slice has no TC lowering)
+    scale_row = s_ref[pl.dslice(row, 1), :]
+    partial_out = jnp.concatenate([y_lo, y_hi], axis=1) * scale_row
+
+    @pl.when(kb == 0)
+    def _zero():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    o_ref[...] += partial_out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n", "tile_n", "k_block", "group_size", "interpret"),
+)
+def _pallas_int4_grouped(
+    x, packed, scale_slab, *, n: int, tile_n: int, k_block: int,
+    group_size: int, interpret: bool,
+):
+    """``scale``: fp32 [K/g, N] in NATURAL channel order — within tile
+    ``j`` the kernel's ``concat([y_lo, y_hi])`` partial spans channels
+    ``[j*t, (j+1)*t)`` contiguously (the pack layout's whole point), so
+    the per-block [1, tile] scale slice lines up with no reorder."""
+    from jax.experimental import pallas as pl
+
+    rows, k = x.shape
+    grid = (n // tile_n, k // k_block)
+    # k_block | group_size: K-block kb reads scale row kb / ratio
+    ratio = group_size // k_block
+    return pl.pallas_call(
+        functools.partial(_kernel_grouped, ratio=ratio),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((rows, k_block), lambda j, kb: (0, kb)),
+            pl.BlockSpec((k_block, tile_n // 2), lambda j, kb: (kb, j)),
+            # full scale-row slab per tile (first dim equal to the
+            # array's — Mosaic's block rule): the kernel slices its row
+            pl.BlockSpec((k // group_size, tile_n), lambda j, kb: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((rows, tile_n), lambda j, kb: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((rows, n), jnp.float32),
+        interpret=interpret,
+    )(x, packed, scale_slab)
+
+
 def int4_matmul(
     x: jnp.ndarray,
     packed: jnp.ndarray,
@@ -163,49 +244,124 @@ def int4_matmul(
     *,
     tile_n: int,
     dtype=jnp.bfloat16,
+    group_size: int = 0,
 ) -> jnp.ndarray:
     """``x [rows, K] @ W4`` where ``W4`` is ``pack_int4``-packed
-    ``[K, N/2]`` with per-output-channel fp32 ``scale [N]``.
+    ``[K, N/2]`` with fp32 ``scale``: per-output-channel ``[N]``
+    (``group_size=0``) or group-wise ``[K/group_size, N]`` — the
+    standard 4-bit quality recipe (each K-group of an output channel
+    carries its own scale; absmax outliers then poison ``group_size``
+    weights instead of the whole column).
 
     Decode-sized row counts on TPU run the Pallas kernel (HBM reads at
-    the packed width); anything else takes the XLA unpack path — same
-    math, standard traffic. The compute dtype follows ``dtype`` when it
-    is a float type (fp32 for the LM head's logits contract, bf16
-    otherwise), matching ``QuantizedDenseGeneral``'s behavior.
+    the packed width; grouped scales ride the small fp32 partials inside
+    the kernel — K-blocks divide the group, so no weight tile is ever
+    materialized at fp width); anything else takes the XLA unpack path —
+    same math, standard traffic. The compute dtype follows ``dtype``
+    when it is a float type (fp32 for the LM head's logits contract,
+    bf16 otherwise), matching ``QuantizedDenseGeneral``'s behavior.
     """
-    rows = x.shape[0]
-    n = scale.shape[0]
+    rows, k = x.shape
+    n = scale.shape[-1]
+    if group_size:
+        if scale.ndim != 2 or scale.shape[0] != k // group_size:
+            raise ValueError(
+                f"group_size={group_size} needs scale [K/g, N] = "
+                f"[{k // group_size}, {n}], got {scale.shape}"
+            )
+    elif scale.ndim != 1:
+        raise ValueError(
+            f"per-channel int4 needs scale [N], got {scale.shape} — pass "
+            "group_size for group-wise scales"
+        )
     compute = dtype if jnp.issubdtype(dtype, jnp.floating) else jnp.bfloat16
-    _, k_block = _grid_for(n, x.shape[1])
+    _, k_block = _grid_for(n, k, group_size=group_size)
     use_pallas = 0 < rows <= MAX_PALLAS_ROWS and tile_n > 0 and k_block > 0
+    if (
+        group_size and group_size % 128 and tile_n > 0
+        and 0 < rows <= MAX_PALLAS_ROWS
+    ):
+        # fires at trace time, once per compiled shape: the operator
+        # asked for the decode-bandwidth configuration but loses it
+        import warnings
+
+        warnings.warn(
+            f"int4 group_size={group_size} is not a multiple of 128: the "
+            "Pallas decode kernel cannot block K below 128 (Mosaic lane "
+            "rule, measured on v5e), so decode takes the XLA unpack path "
+            "at full-width weight reads. Use group_size=128 to keep the "
+            "packed-width bandwidth win (measured ~1.4% over "
+            "per-channel).",
+            stacklevel=2,
+        )
     if use_pallas:
         interpret = jax.default_backend() != "tpu"
+        if group_size:
+            y = _pallas_int4_grouped(
+                x.astype(compute), packed, scale, n=n, tile_n=tile_n,
+                k_block=k_block, group_size=group_size, interpret=interpret,
+            )
+            return y.astype(dtype)
         y = _pallas_int4(
             x.astype(compute), packed, n=n, tile_n=tile_n,
             k_block=k_block, interpret=interpret,
         )
-    else:
-        w = unpack_int4(packed, tile_n).astype(compute)
+        return (y * scale).astype(dtype)
+    w = unpack_int4(packed, tile_n)
+    if group_size:
+        # fallback (prefill / compute-bound shapes): dequantize at fp32
+        # so group scales keep their precision, then one matmul
+        w_f = w.astype(jnp.float32) * jnp.repeat(scale, group_size, axis=0)
         y = jax.lax.dot_general(
-            x.astype(compute), w,
+            x.astype(compute), w_f.astype(compute),
             (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
         )
+        return y.astype(dtype)
+    y = jax.lax.dot_general(
+        x.astype(compute), w.astype(compute),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
     return (y * scale).astype(dtype)
 
 
-def quantize_kernel_int4(w2d: jnp.ndarray, tile_n: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Symmetric per-output-channel int4: ``[K, N]`` fp → ``(packed
-    [K, N/2] int8, scale [N] fp32)``. ``tile_n`` must match the serving
+def quantize_kernel_int4(
+    w2d: jnp.ndarray, tile_n: int, group_size: int = 0
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int4: ``[K, N]`` fp → ``(packed [K, N/2] int8, scale)``.
+
+    ``group_size=0``: per-output-channel absmax/7, scale ``[N]``.
+    ``group_size=g``: per-(K-group, channel) absmax/7, scale ``[K/g, N]``
+    — the 4-bit quality recipe (g must divide K; 128 is the standard
+    point AND the smallest group the Pallas decode kernel can serve at
+    packed-width reads — Mosaic blocks K in multiples of 128; smaller
+    groups decode via the XLA path). ``tile_n`` must match the serving
     call's tile (it bakes the slab order into the packing)."""
     w = jnp.asarray(w2d, jnp.float32)
+    k, n = w.shape
+    if group_size:
+        if group_size < 1 or k % group_size:
+            raise ValueError(
+                f"group_size {group_size} must divide K={k}"
+            )
+        g = w.reshape(k // group_size, group_size, n)
+        absmax = jnp.max(jnp.abs(g), axis=1)             # [K/g, N]
+        scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
+        nib = jnp.clip(
+            jnp.round(g / scale[:, None, :]), -8, 7
+        ).astype(jnp.int8).reshape(k, n)
+        return pack_int4(nib, tile_n), scale.astype(jnp.float32)
     absmax = jnp.max(jnp.abs(w), axis=0)                 # [N]
     scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
     nib = jnp.clip(jnp.round(w / scale), -8, 7).astype(jnp.int8)
     return pack_int4(nib, tile_n), scale.astype(jnp.float32)
 
 
-def tile_for(n: int, k: int) -> int:
+def tile_for(n: int, k: int, shards: int = 1) -> int:
     """The tile the serving layer should bake for ``N`` output channels
     at contraction width ``K`` (0 = no conforming tile; the layer must
-    stay int8)."""
-    return _grid_for(n, k)[0]
+    stay int8). ``shards``: the tensor-parallel degree the packing must
+    survive — the tile must divide the per-device channel count so a
+    ``tensor``-axis shard of the packed/scale columns stays a valid
+    slab packing on every device (any divisor of ``shards`` also serves
+    correctly; a FINER split than packed for does not)."""
+    return _grid_for(n, k, shards=shards)[0]
