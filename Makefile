@@ -1,7 +1,7 @@
 # Developer entry points (reference analog: the upstream Makefile).
 # Tests force the CPU-simulated 8-device mesh via tests/conftest.py.
 
-.PHONY: test lint docs docs-site bench bench-all notebooks dryrun
+.PHONY: test test-quick lint docs docs-site bench bench-all notebooks dryrun
 
 docs:
 	python scripts/gen_api_reference.py
@@ -12,6 +12,12 @@ docs-site:
 
 test:
 	python -m pytest tests/ -x -q
+
+# the measured sub-minute spec-path modules (<5 min total on the 1-core
+# simulated mesh) — the iteration/CI-sharding tier; `make test` remains
+# the full matrix of record
+test-quick:
+	python -m pytest tests/ -m quick -q
 
 lint:
 	python scripts/lint_basics.py

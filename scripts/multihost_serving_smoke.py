@@ -1,0 +1,238 @@
+"""Multi-host TENSOR-PARALLEL SERVING smoke: jax.distributed inference.
+
+Training has a true 2-process validation (``multihost_smoke.py``);
+this is the serving counterpart: a pair of OS processes (CPU backend,
+Gloo collectives — the same control plane as TPU pods) hold a Llama
+whose parameters are tensor-sharded ACROSS the processes, and serve it
+through :func:`unionml_tpu.models.generate.make_lm_predictor` with
+host 0 fronting HTTP:
+
+- host 0 runs a :class:`~unionml_tpu.serving.http.ServingApp`; each
+  request's prompt is broadcast to every host
+  (``multihost_utils.broadcast_one_to_all`` — the standard multi-host
+  serving pattern: all controllers must enter the jitted computation in
+  lockstep), then every host runs the SAME sharded generate;
+- the single-process invocation (``--num-processes 1``) is the equality
+  reference: the pair's HTTP response must be token-identical.
+
+Launched by ``__graft_entry__.dryrun_multichip`` (leg 9) and
+``tests/integration/test_multihost.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+from typing import Dict, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+PROMPT = [7, 3, 9, 2, 11, 5]
+MAX_NEW = 6
+
+
+def _worker_env() -> Dict[str, str]:
+    return {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+
+
+def launch_single(*, local_devices: int, timeout: int = 300) -> dict:
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--local-devices", str(local_devices)],
+        capture_output=True, text=True, timeout=timeout, env=_worker_env(),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"single-process worker failed: {out.stderr[-2000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def launch_pair(
+    *, local_devices: int, timeout: int = 300, port: Optional[int] = None
+) -> dict:
+    import socket
+    import subprocess
+
+    if port is None:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--process-id", str(pid), "--num-processes", "2",
+             "--coordinator", f"127.0.0.1:{port}",
+             "--local-devices", str(local_devices)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=_worker_env(),
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=timeout))
+    except subprocess.TimeoutExpired:
+        tails = []
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            stdout, stderr = p.communicate()
+            tails.append(stderr[-1000:] if stderr else "")
+        raise RuntimeError(
+            f"multihost serving pair timed out after {timeout}s; worker "
+            f"stderr tails: {tails}"
+        )
+    for p, (stdout, stderr) in zip(procs, outs):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"multihost serving worker rc={p.returncode}: {stderr[-2000:]}"
+            )
+    return json.loads(outs[0][0].strip().splitlines()[-1])
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--process-id", type=int, default=0)
+    ap.add_argument("--num-processes", type=int, default=1)
+    ap.add_argument("--coordinator", default="127.0.0.1:12321")
+    ap.add_argument("--local-devices", type=int, default=8)
+    args = ap.parse_args()
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={args.local_devices}"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    if args.num_processes > 1:
+        from unionml_tpu.parallel import multihost_initialize
+
+        assert multihost_initialize(
+            args.coordinator, args.num_processes, args.process_id
+        ), "jax.distributed bring-up failed"
+        assert jax.process_count() == args.num_processes
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    from unionml_tpu.models import (
+        LLAMA_PARTITION_RULES,
+        Llama,
+        LlamaConfig,
+        make_lm_predictor,
+    )
+    from unionml_tpu.parallel import ShardingConfig
+
+    total = args.num_processes * args.local_devices
+    cfg = LlamaConfig.tiny(vocab_size=128)
+    module = Llama(cfg)
+    # every process derives the IDENTICAL full tree from the same seed,
+    # then assembles the cross-process tensor-sharded global arrays from
+    # its local copy (the standard way to materialize a sharded tree
+    # without a host ever holding someone else's shard exclusively)
+    host_params = jax.jit(module.init)(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    sc = ShardingConfig(
+        data=1, tensor=total, rules=LLAMA_PARTITION_RULES,
+        devices=jax.devices(),
+    )
+    mesh = sc.mesh()
+
+    from jax.tree_util import tree_map_with_path
+
+    def _path_str(path) -> str:
+        parts = []
+        for p in path:
+            key = getattr(p, "key", getattr(p, "name", getattr(p, "idx", p)))
+            parts.append(str(key))
+        return "/".join(parts)
+
+    def to_global(path, leaf):
+        local = np.asarray(leaf)
+        spec = sc.param_pspec(_path_str(path), leaf)
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            local.shape, sharding, lambda idx: local[idx]
+        )
+
+    params = tree_map_with_path(to_global, host_params)
+    predictor = make_lm_predictor(
+        module, max_new_tokens=MAX_NEW, bucket_lens=(16,),
+        max_len=16 + MAX_NEW,
+    )
+
+    if args.num_processes == 1:
+        tokens = predictor(params, [PROMPT])[0]
+        print(json.dumps({
+            "processes": 1, "devices": len(jax.devices()), "tokens": tokens,
+        }))
+        return
+
+    from jax.experimental import multihost_utils
+
+    plen = len(PROMPT)
+    if args.process_id == 0:
+        # host 0 fronts HTTP; its predictor body broadcasts each prompt
+        # so every host enters the sharded generate in lockstep
+        import urllib.request
+
+        from unionml_tpu import Dataset, Model
+        from unionml_tpu.model import ModelArtifact
+        from unionml_tpu.serving.http import ServingApp
+
+        dataset = Dataset(name="mh_serve_data", targets=[])
+
+        @dataset.reader
+        def reader() -> list:
+            return []
+
+        model = Model(name="mh_serve", init=lambda: {}, dataset=dataset)
+
+        @model.trainer
+        def trainer(obj: dict, features: list) -> dict:
+            return obj
+
+        @model.predictor
+        def serve_predict(obj: dict, prompts: list) -> list:
+            row = np.asarray(prompts[0], np.int32)
+            multihost_utils.broadcast_one_to_all(row)
+            return predictor(params, [row.tolist()])
+
+        model.artifact = ModelArtifact({})
+        app = ServingApp(model, batch=False)
+        host, port = app.serve(host="127.0.0.1", port=0, blocking=False)
+        body = json.dumps({"features": [PROMPT]}).encode()
+        req = urllib.request.Request(
+            f"http://{host}:{port}/predict", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        resp = json.loads(urllib.request.urlopen(req, timeout=240).read())
+        tokens = resp["predictions"][0] if isinstance(resp, dict) else resp[0]
+        app.shutdown()
+        print(json.dumps({
+            "processes": jax.process_count(),
+            "devices": len(jax.devices()),
+            "tokens": tokens,
+            "via": "http",
+        }))
+    else:
+        # worker host: receive the broadcast prompt, join the generate
+        row = multihost_utils.broadcast_one_to_all(
+            np.zeros((plen,), np.int32)
+        )
+        predictor(params, [np.asarray(row).tolist()])
+
+
+if __name__ == "__main__":
+    main()

@@ -32,3 +32,22 @@ def test_two_process_run_matches_single_process():
     assert abs(pair["checksum"] - single["checksum"]) <= 1e-5 * abs(single["checksum"])
     # and training actually trained
     assert pair["loss"] < 1.0
+
+
+from multihost_serving_smoke import (  # noqa: E402
+    launch_pair as serving_pair,
+    launch_single as serving_single,
+)
+
+
+def test_two_process_tp_serving_matches_single_process():
+    """Multi-host TP SERVING (round-5 gap): parameters tensor-sharded
+    ACROSS two processes, host 0 fronting HTTP and broadcasting each
+    prompt so both controllers enter the sharded generate in lockstep —
+    greedy tokens must be identical to the single-process TP run."""
+    single = serving_single(local_devices=8)
+    pair = serving_pair(local_devices=4)
+    assert single["processes"] == 1 and single["devices"] == 8
+    assert pair["processes"] == 2 and pair["devices"] == 8
+    assert pair["via"] == "http"
+    assert pair["tokens"] == single["tokens"]

@@ -17,6 +17,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# measured sub-minute module: part of the `-m quick` tier (Makefile
+# test-quick) so iteration/CI sharding get a <5-min spec-path pass
+pytestmark = pytest.mark.quick
+
 from unionml_tpu.models import (
     BertClassifier,
     BertConfig,

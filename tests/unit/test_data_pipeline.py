@@ -3,6 +3,12 @@ ordering, and the multi-host row-slicing contract. The true 2-process
 assembly runs in tests/integration/test_multihost.py; here the
 single-process semantics (process 0 owns every row) are pinned."""
 
+import pytest
+
+# measured sub-minute module: part of the `-m quick` tier (Makefile
+# test-quick) so iteration/CI sharding get a <5-min spec-path pass
+pytestmark = pytest.mark.quick
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -7,6 +7,10 @@ import numpy as np
 import pandas as pd
 import pytest
 
+# measured sub-minute module: part of the `-m quick` tier (Makefile
+# test-quick) so iteration/CI sharding get a <5-min spec-path pass
+pytestmark = pytest.mark.quick
+
 from unionml_tpu import Dataset
 from unionml_tpu.dataset import ReaderReturnTypeSource
 from unionml_tpu.stage import Stage

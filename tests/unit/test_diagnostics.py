@@ -4,6 +4,10 @@ the aux-subsystem obligations of SURVEY.md §5.1-5.4."""
 import numpy as np
 import pytest
 
+# measured sub-minute module: part of the `-m quick` tier (Makefile
+# test-quick) so iteration/CI sharding get a <5-min spec-path pass
+pytestmark = pytest.mark.quick
+
 import jax
 import jax.numpy as jnp
 

@@ -1,6 +1,12 @@
 """Generated API/CLI references must match the committed files
 (reference analog: Sphinx builds docs in CI, build.yml)."""
 
+import pytest
+
+# measured sub-minute module: part of the `-m quick` tier (Makefile
+# test-quick) so iteration/CI sharding get a <5-min spec-path pass
+pytestmark = pytest.mark.quick
+
 import subprocess
 import sys
 from pathlib import Path

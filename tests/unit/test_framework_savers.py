@@ -8,6 +8,10 @@ predict roundtrips."""
 import numpy as np
 import pytest
 
+# measured sub-minute module: part of the `-m quick` tier (Makefile
+# test-quick) so iteration/CI sharding get a <5-min spec-path pass
+pytestmark = pytest.mark.quick
+
 from unionml_tpu import Dataset, Model
 
 

@@ -8,6 +8,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# measured sub-minute module: part of the `-m quick` tier (Makefile
+# test-quick) so iteration/CI sharding get a <5-min spec-path pass
+pytestmark = pytest.mark.quick
+
 from unionml_tpu.models import Llama, LlamaConfig, make_generator
 from unionml_tpu.models.quantization import (
     LLAMA_QUANT_PATTERNS,
@@ -429,3 +433,43 @@ def test_group128_keeps_pallas_k_block():
             jnp.zeros((64, 256), jnp.int8),
             jnp.ones((4, 512), jnp.float32), tile_n=512, group_size=16,
         )
+
+
+def test_mosaic_gate_routes_128_tiles_to_xla(monkeypatch):
+    """tile 128 is a valid PACKING (TP-shardable k/v) but its packed
+    block width 64 breaks the Mosaic lane rule — the decode call must
+    take the XLA path, never the Pallas kernel (review finding: the
+    kernel would fail at serve time on real TPU, invisible to the
+    interpret-mode CI)."""
+    import unionml_tpu.ops.int4_matmul as m
+
+    def boom(*a, **k):
+        raise AssertionError("Pallas path engaged for a 128-tile")
+
+    monkeypatch.setattr(m, "_pallas_int4", boom)
+    rng = np.random.default_rng(5)
+    w = jnp.asarray(rng.normal(size=(64, 384)).astype(np.float32))
+    packed, scale = quantize_kernel_int4(w, 128)
+    x = jnp.asarray(rng.normal(size=(1, 64)), jnp.bfloat16)
+    y = int4_matmul(x, packed, scale, tile_n=128, dtype=jnp.float32)
+    wdq = np.asarray(unpack_int4(packed, 128), np.float32) * np.asarray(scale)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(x, np.float32) @ wdq, rtol=2e-2, atol=2e-2
+    )
+    # ...while a single FULL-width tile (Mosaic-exempt) and the 256/512
+    # tiles stay on the kernel
+    assert m._k_block_for(64, 384) == 64
+    monkeypatch.undo()
+
+
+def test_k_block_sized_for_callers_tile():
+    """int4_matmul's K grid must be sized for the tile it was CALLED
+    with, not a recomputed first-fit candidate (review finding: a
+    128-tile paired with a 512-sized k_block fragments the K grid)."""
+    from unionml_tpu.ops.int4_matmul import _k_block_for
+
+    # K=14336 at tile 512 must halve to 3584; at tile 256 it fits 7168
+    assert _k_block_for(14336, 512) == 3584
+    assert _k_block_for(14336, 256) == 7168
+    # grouped: k_block pins to the group regardless of tile
+    assert _k_block_for(14336, 512, group_size=128) == 128

@@ -6,6 +6,10 @@ from dataclasses import is_dataclass
 import numpy as np
 import pytest
 
+# measured sub-minute module: part of the `-m quick` tier (Makefile
+# test-quick) so iteration/CI sharding get a <5-min spec-path pass
+pytestmark = pytest.mark.quick
+
 from unionml_tpu import Model, ModelArtifact
 from unionml_tpu.stage import Stage, Workflow
 

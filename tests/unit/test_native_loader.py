@@ -5,6 +5,10 @@ depends on it), and the trainer integration must still converge."""
 import numpy as np
 import pytest
 
+# measured sub-minute module: part of the `-m quick` tier (Makefile
+# test-quick) so iteration/CI sharding get a <5-min spec-path pass
+pytestmark = pytest.mark.quick
+
 from unionml_tpu.data.native import BatchLoader, epoch_permutation, get_library
 
 

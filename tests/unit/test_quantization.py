@@ -1,6 +1,12 @@
 """Weight-only int8 serving quantization: the quantized model must load
 converted fp weights and generate nearly the same tokens."""
 
+import pytest
+
+# measured sub-minute module: part of the `-m quick` tier (Makefile
+# test-quick) so iteration/CI sharding get a <5-min spec-path pass
+pytestmark = pytest.mark.quick
+
 import dataclasses
 
 import numpy as np

@@ -655,3 +655,81 @@ def test_tpuvm_resources_env_in_ssh_command(tpuvm_model, monkeypatch):
             proc.wait(timeout=30)
             log.close()
         backend._procs.pop(record.execution_id, None)
+
+
+def test_elastic_train_step_survives_preemption(monkeypatch, tmp_path):
+    """SURVEY §5.3 e2e: a train_step registered with checkpoint_dir is
+    preemption-safe through the remote lifecycle. The runner is
+    HARD-KILLED (os._exit — no cleanup, no terminal status) mid-run;
+    LocalBackend.wait detects the dead pid, execute(max_restarts=1)
+    relaunches the same execution, the elastic trainer resumes from the
+    newest checkpoint, and the final state is BIT-IDENTICAL to an
+    uninterrupted run."""
+    import numpy as np
+
+    monkeypatch.setenv("UNIONML_TPU_HOME", str(tmp_path / "backend"))
+    sys.path.insert(0, str(APPS_DIR))
+    try:
+        import elastic_app
+
+        model = elastic_app.model
+        model._backend = None
+        model.remote(project="elastic-project")
+        backend = model._remote
+
+        # 48 train rows / batch 8 = 6 steps/epoch x 4 epochs = 24 steps;
+        # checkpoints at 2,4,...; the bomb kills the runner at step 5
+        monkeypatch.setenv("UNIONML_TEST_DIE_AT", "5")
+        trainer_kwargs = {"num_epochs": 4, "batch_size": 8, "seed": 0}
+        backend.deploy(model, app_version="e1")
+        record = backend.execute(
+            model, workflow="train", app_version="e1",
+            inputs={"trainer_kwargs": trainer_kwargs},
+            wait=True, max_restarts=1,
+        )
+        assert record.status == "SUCCEEDED"
+        log = (Path(record.exec_dir) / "runner.log").read_text()
+        assert "died without reporting" in log   # the kill really happened
+        assert "resuming from step" in log       # ...and the relaunch RESUMED
+        interrupted = backend.fetch_outputs(record)["model_object"]
+
+        # control: fresh deployment (fresh relative checkpoint dir), no bomb
+        monkeypatch.delenv("UNIONML_TEST_DIE_AT")
+        backend.deploy(model, app_version="e2")
+        record2 = backend.execute(
+            model, workflow="train", app_version="e2",
+            inputs={"trainer_kwargs": trainer_kwargs}, wait=True,
+        )
+        control = backend.fetch_outputs(record2)["model_object"]
+        np.testing.assert_array_equal(
+            np.asarray(interrupted["w"]), np.asarray(control["w"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(interrupted["b"]), np.asarray(control["b"])
+        )
+    finally:
+        sys.path.remove(str(APPS_DIR))
+
+
+def test_max_restarts_skips_deterministic_failures(fixture_model, monkeypatch):
+    """An app-REPORTED failure (reproducible crash) must surface
+    immediately — max_restarts only retries preemptions (runner died
+    without reporting), or every buggy run would retrain N times."""
+    model = fixture_model
+    backend = model._remote
+    backend.deploy(model, app_version="df1")
+    launches = []
+    real_launch = backend._launch
+
+    def counting_launch(*a, **k):
+        launches.append(1)
+        return real_launch(*a, **k)
+
+    monkeypatch.setattr(backend, "_launch", counting_launch)
+    with pytest.raises(RuntimeError, match="FAILED"):
+        backend.execute(
+            model, workflow="train", app_version="df1",
+            inputs={"bogus_kwarg": 1},   # deterministic TypeError in-app
+            wait=True, max_restarts=3,
+        )
+    assert len(launches) == 1, "deterministic failure was relaunched"

@@ -6,6 +6,10 @@ import json
 
 import pytest
 
+# measured sub-minute module: part of the `-m quick` tier (Makefile
+# test-quick) so iteration/CI sharding get a <5-min spec-path pass
+pytestmark = pytest.mark.quick
+
 from unionml_tpu.serving.serverless import (
     LocalObjectStore,
     gateway_handler,

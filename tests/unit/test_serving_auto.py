@@ -6,6 +6,10 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+# measured sub-minute module: part of the `-m quick` tier (Makefile
+# test-quick) so iteration/CI sharding get a <5-min spec-path pass
+pytestmark = pytest.mark.quick
+
 from unionml_tpu.models import Llama, LlamaConfig
 from unionml_tpu.serving.auto import (
     choose_serving_mode,

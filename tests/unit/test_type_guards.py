@@ -6,6 +6,10 @@ from typing import Dict, List, Optional, Tuple, Union
 import pandas as pd
 import pytest
 
+# measured sub-minute module: part of the `-m quick` tier (Makefile
+# test-quick) so iteration/CI sharding get a <5-min spec-path pass
+pytestmark = pytest.mark.quick
+
 from unionml_tpu import type_guards
 from unionml_tpu.type_guards import SignatureError
 

@@ -96,6 +96,21 @@ def to_microbatches(batch: Any, accumulate_steps: int, batch_size: int) -> Any:
     return jax.tree_util.tree_map(reshape, batch)
 
 
+def is_stream(features: Any) -> bool:
+    """The trainer-feed streaming rule, ONE home (run_step_trainer and
+    the checkpoint_dir elastic route must agree or a stream silently
+    np.asarray's into garbage): streams are callables (fresh iterable
+    per epoch), iterators (one pass), or re-iterable loader objects
+    (DataLoader-likes). Pytree containers and arrays are NOT streams —
+    they carry the (features[, targets]) array contract."""
+    return callable(features) or (
+        hasattr(features, "__iter__")
+        and not isinstance(features, (dict, list, tuple, str, bytes))
+        and not hasattr(features, "__array__")
+        and not hasattr(features, "shape")
+    )
+
+
 def batch_indices(
     n: int, batch_size: int, *, shuffle: bool, seed: int, drop_remainder: bool = True
 ) -> Iterable[np.ndarray]:
@@ -156,16 +171,7 @@ def run_step_trainer(
     """
     import jax
 
-    # streams: callables (fresh iterable per epoch), iterators (one pass),
-    # or re-iterable loader objects (DataLoader-likes). Pytree containers
-    # and arrays are NOT streams — they carry the (features[, targets])
-    # array contract.
-    streaming = callable(features) or (
-        hasattr(features, "__iter__")
-        and not isinstance(features, (dict, list, tuple, str, bytes))
-        and not hasattr(features, "__array__")
-        and not hasattr(features, "shape")
-    )
+    streaming = is_stream(features)
     if streaming:
         if targets is not None:
             raise ValueError(

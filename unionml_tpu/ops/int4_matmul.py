@@ -70,9 +70,12 @@ def _grid_for(n: int, k: int, shards: int = 1, group_size: int = 0):
     ``shards``: tensor-parallel degree the packing must survive — the
     tile must divide the PER-DEVICE channel count ``n // shards`` so
     shard boundaries land on slab boundaries (any divisor of ``shards``
-    then also works at serve time). ``group_size``: group-wise scale
-    granularity — k_block additionally divides the group so each grid
-    step's partial product carries ONE scale row (see
+    then also works at serve time). A 128-tile is a valid PACKING but
+    not a Pallas-servable block (its packed width 64 breaks the Mosaic
+    lane rule unless it spans the whole array) — ``int4_matmul`` routes
+    such layers through the XLA unpack path. ``group_size``: group-wise
+    scale granularity — k_block additionally divides the group so each
+    grid step's partial product carries ONE scale row (see
     :func:`int4_matmul`'s grouped path)."""
     if n % 2 or n % max(1, shards):
         return 0, 0
@@ -81,14 +84,25 @@ def _grid_for(n: int, k: int, shards: int = 1, group_size: int = 0):
     if not candidates and shards == 1:
         candidates = [n]  # single-tile: any even width
     for t in candidates:
-        kb = min(k, group_size) if group_size else k
-        while 9 * kb * (t // 2) > _VMEM_WEIGHT_BYTES and kb % 2 == 0:
-            kb //= 2
-        if 9 * kb * (t // 2) <= _VMEM_WEIGHT_BYTES and (
-            kb == k or kb % 128 == 0
-        ):
+        kb = _k_block_for(k, t, group_size)
+        if kb:
             return t, kb
     return 0, 0
+
+
+def _k_block_for(k: int, tile_n: int, group_size: int = 0) -> int:
+    """The K grid block for a GIVEN tile: halve from K (or the scale
+    group) until the weight-side VMEM buffers fit. Sized against the
+    caller's actual tile — a first-fit recompute against a different
+    candidate would fragment the K grid (review finding)."""
+    kb = min(k, group_size) if group_size else k
+    while 9 * kb * (tile_n // 2) > _VMEM_WEIGHT_BYTES and kb % 2 == 0:
+        kb //= 2
+    if 9 * kb * (tile_n // 2) <= _VMEM_WEIGHT_BYTES and (
+        kb == k or kb % 128 == 0
+    ):
+        return kb
+    return 0
 
 
 def pack_int4(nibbles: jnp.ndarray, tile_n: int) -> jnp.ndarray:
@@ -275,8 +289,16 @@ def int4_matmul(
             "group_size for group-wise scales"
         )
     compute = dtype if jnp.issubdtype(dtype, jnp.floating) else jnp.bfloat16
-    _, k_block = _grid_for(n, k, group_size=group_size)
-    use_pallas = 0 < rows <= MAX_PALLAS_ROWS and tile_n > 0 and k_block > 0
+    k_block = _k_block_for(k, tile_n, group_size) if tile_n > 0 else 0
+    # Mosaic lane rule: the packed operand's block width (tile/2) must
+    # be a multiple of 128 or span the whole packed array — a 128-tile
+    # (TP-packed k/v geometry served on one chip) is a valid PACKING but
+    # not a servable Pallas block, so it decodes via the XLA path
+    mosaic_ok = tile_n % 256 == 0 or tile_n == n
+    use_pallas = (
+        0 < rows <= MAX_PALLAS_ROWS and tile_n > 0 and k_block > 0
+        and mosaic_ok
+    )
     if (
         group_size and group_size % 128 and tile_n > 0
         and 0 < rows <= MAX_PALLAS_ROWS
