@@ -105,7 +105,8 @@ def random_quantized_params(qmodule, seed: int = 0):
         parent = tuple(p.key if hasattr(p, "key") else str(p) for p in path[:-1])
         siblings = sibling_names[parent]
         is_quant_scale = (
-            name == "scale" and ("kernel_q" in siblings or "kernel_p" in siblings)
+            name in ("scale", "scale_g")
+            and ("kernel_q" in siblings or "kernel_p" in siblings)
         ) or (
             name.endswith("_scale") and f"{name[: -len('_scale')]}_q" in siblings
         )

@@ -1,0 +1,173 @@
+"""Speculative decoding INSIDE the continuous-batching engine, measured.
+
+Round-4 left speculation usable only through the full-batch
+micro-batcher; the engine — the mode that wins exactly where
+speculation matters (8B-class, staggered traffic) — could not
+speculate. Round 5 adds per-slot draft chunks + one shared multi-token
+verify per round to :class:`~unionml_tpu.serving.engine.DecodeEngine`;
+this bench measures it on the real chip.
+
+Acceptance is CONTROLLED with the ``benchmarks/speculative.py``
+BoostedTarget instrument (synthetic weights agree at chance, so organic
+acceptance is ~0): the target's logits are nudged toward the next input
+token by ``boost``, which in the verify shape is exactly the draft's
+proposal — sweeping ``boost`` sweeps acceptance, REPORTED from the
+engine's own ``/stats`` acceptance counter, while every wall-clock
+number is the genuine program.
+
+Scenarios (one JSON line each; closed-loop, staggered clients):
+
+- plain engine (no draft): the baseline p50/p95;
+- speculative engine, 0.3B int8 draft, k=4: boost sweep → (observed
+  acceptance, p50/p95, ms/round) — where the crossover lands.
+
+Usage::
+
+    python benchmarks/speculative_engine.py            # on the TPU
+    UNIONML_TPU_BENCH_PRESET=tiny JAX_PLATFORMS=cpu \
+        python benchmarks/speculative_engine.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from benchmarks.serve_latency import serving_config
+    from benchmarks.speculative import make_boosted_target
+    from unionml_tpu.models import Llama, LlamaConfig
+    from unionml_tpu.serving.engine import DecodeEngine
+
+    tiny = os.environ.get("UNIONML_TPU_BENCH_PRESET") == "tiny" or (
+        jax.default_backend() == "cpu"
+    )
+    if tiny:
+        t_cfg = LlamaConfig.tiny(vocab_size=512)
+        d_cfg = LlamaConfig.tiny(
+            vocab_size=512, hidden_dim=32, num_layers=1, num_heads=2,
+            num_kv_heads=1, mlp_dim=64,
+        )
+        toks = jnp.zeros((1, 8), jnp.int32)
+        t_params = Llama(t_cfg).init(jax.random.PRNGKey(0), toks)["params"]
+        d_params = Llama(d_cfg).init(jax.random.PRNGKey(1), toks)["params"]
+        slots, prompt_len, new_tokens, reqs, boosts = 2, 8, 8, 2, (0.0, 1e9)
+    else:
+        from benchmarks.serve_latency import random_quantized_params
+
+        t_cfg = LlamaConfig(
+            **{**serving_config("serve_8b").__dict__, "quantized": True}
+        )
+        # ~0.3B draft (the round-4 curve's identified lever)
+        d_cfg = LlamaConfig(
+            vocab_size=128_256, hidden_dim=1024, num_layers=10,
+            num_heads=16, num_kv_heads=8, mlp_dim=2816, max_len=2048,
+            quantized=True,
+        )
+        t_params = random_quantized_params(Llama(t_cfg))
+        d_params = random_quantized_params(Llama(d_cfg))
+        slots, prompt_len, new_tokens, reqs = 8, 64, 32, 2
+        # boost sweep: 0 (chance), mid points, and "accept everything";
+        # override with UNIONML_TPU_SPEC_BOOSTS=2.0,3.5 to refine
+        env = os.environ.get("UNIONML_TPU_SPEC_BOOSTS")
+        boosts = (
+            tuple(float(b) for b in env.split(","))
+            if env else (0.0, 5.0, 8.0, 12.0, 1e9)
+        )
+
+    k = 4
+    chunk_rounds = 2          # speculative rounds per dispatched chunk
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(
+        1, min(t_cfg.vocab_size, d_cfg.vocab_size), size=(slots, prompt_len)
+    )
+
+    def closed_loop(gen_fn) -> dict:
+        lat = []
+        lock = threading.Lock()
+
+        def client(i):
+            time.sleep(0.03 * i)   # staggered: the engine's regime
+            for _ in range(reqs):
+                t0 = time.perf_counter()
+                gen_fn([prompts[i].tolist()])
+                with lock:
+                    lat.append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(slots)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        lat.sort()
+        return {
+            "p50_ms": round(lat[len(lat) // 2] * 1e3, 1),
+            "p95_ms": round(lat[int(len(lat) * 0.95) - 1] * 1e3, 1),
+            "n": len(lat),
+        }
+
+    target = Llama(t_cfg)
+    draft = Llama(d_cfg)
+
+    # ---- baseline: plain engine, no draft ----
+    plain = DecodeEngine(
+        target, slots=slots, max_new_tokens=new_tokens,
+        prompt_buckets=(prompt_len,), chunk_steps=8, pipeline_depth=2,
+    )
+    plain.warmup(t_params)
+    closed_loop(lambda p: plain.generate(t_params, p))
+    base = closed_loop(lambda p: plain.generate(t_params, p))
+    plain.close()
+    print(json.dumps({"metric": "spec_engine_plain_baseline", **base}), flush=True)
+
+    # ---- speculative engine over the boosted target ----
+    boosted = make_boosted_target(t_cfg)
+    engine = DecodeEngine(
+        boosted, draft_module=draft, speculate_k=k, slots=slots,
+        max_new_tokens=new_tokens, prompt_buckets=(prompt_len,),
+        chunk_steps=chunk_rounds, pipeline_depth=2,
+    )
+    for boost in boosts:
+        params = {
+            "target": {"inner": t_params, "boost": jnp.float32(boost)},
+            "draft": d_params,
+        }
+        engine.warmup(params)          # first boost compiles; rest reuse
+        closed_loop(lambda p: engine.generate(params, p))
+        engine.reset_stats()
+        t0 = time.perf_counter()
+        res = closed_loop(lambda p: engine.generate(params, p))
+        wall = time.perf_counter() - t0
+        stats = engine.stats()
+        spec = stats["speculative"]
+        ms_per_round = round(wall * 1e3 / max(1, spec["rounds"] / slots), 2)
+        print(json.dumps({
+            "metric": "spec_engine_boosted",
+            "k": k,
+            "boost": boost,
+            "acceptance": spec["acceptance_rate"],
+            **res,
+            "rounds": spec["rounds"],
+            "ms_per_slot_round": ms_per_round,
+            "speedup_vs_plain_p50": round(base["p50_ms"] / res["p50_ms"], 2),
+        }), flush=True)
+        # drain between sweep points so bind() can swap cleanly
+    engine.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -473,3 +473,39 @@ def test_k_block_sized_for_callers_tile():
     assert _k_block_for(14336, 256) == 7168
     # grouped: k_block pins to the group regardless of tile
     assert _k_block_for(14336, 512, group_size=128) == 128
+
+
+def test_int4_with_kv_quant_and_chunked_prefill():
+    """The long-context serving composition (round-4 gap: no test
+    exercised weight_bits=4 together with kv_quant): packed-int4 weights
+    + int8 KV cache + chunked prefill, through both the solo generator
+    and the engine's chunked admission — token identical."""
+    from unionml_tpu.serving.engine import DecodeEngine
+
+    cfg = int4_cfg(kv_quant=True)
+    fp_cfg = LlamaConfig(**{**cfg.__dict__, "quantized": False,
+                            "weight_bits": 8, "kv_quant": False})
+    params = Llama(fp_cfg).init(
+        jax.random.PRNGKey(2), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    q4 = quantize_params(params, LLAMA_QUANT_PATTERNS, bits=4)
+    module = Llama(cfg)
+    rng = np.random.default_rng(23)
+    long_prompt = rng.integers(1, 512, size=40).tolist()
+    gen_chunked = make_generator(
+        module, max_new_tokens=6, max_len=64, prefill_chunk=16
+    )
+    gen_mono = make_generator(module, max_new_tokens=6, max_len=64)
+    want = np.asarray(gen_mono(q4, jnp.asarray([long_prompt], jnp.int32)))[0]
+    got = np.asarray(gen_chunked(q4, jnp.asarray([long_prompt], jnp.int32)))[0]
+    np.testing.assert_array_equal(got, want)
+
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=6, prompt_buckets=(48,),
+        prefill_chunk=16, chunk_steps=3,
+    )
+    try:
+        eng = engine.generate(q4, [long_prompt])[0]
+    finally:
+        engine.close()
+    assert eng == want.tolist()

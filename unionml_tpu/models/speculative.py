@@ -46,7 +46,37 @@ import jax.numpy as jnp
 from unionml_tpu.models.llama import Llama, init_cache
 from unionml_tpu.models.train import resolve_params
 
-__all__ = ["make_speculative_generator", "make_speculative_predictor"]
+__all__ = [
+    "greedy_acceptance",
+    "make_speculative_generator",
+    "make_speculative_predictor",
+]
+
+
+def greedy_acceptance(proposals: jnp.ndarray, greedy: jnp.ndarray):
+    """The greedy acceptance rule — ONE home (this generator's round body
+    and the DecodeEngine's speculative round both trace it; a desync
+    breaks their shared token-identity-with-plain-greedy contract).
+
+    ``proposals`` [B, k] (draft tokens), ``greedy`` [B, k+1] (the
+    target's argmax at each verify position). Draft token i is accepted
+    iff it equals the target's choice after position i-1 AND every
+    earlier proposal was accepted. Returns ``(accepted [B], correction
+    [B], emit [B, k+1])`` — the count of accepted draft tokens, the
+    target's next token after the accepted prefix (free
+    correction/extension), and the emission buffer holding the accepted
+    prefix with the correction at position ``accepted``.
+    """
+    batch, k = proposals.shape
+    rows = jnp.arange(batch)
+    match = proposals == greedy[:, :k]
+    accepted = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+    correction = jnp.take_along_axis(greedy, accepted[:, None], axis=1)[:, 0]
+    emit = jnp.concatenate(
+        [proposals, jnp.zeros((batch, 1), jnp.int32)], axis=1
+    )
+    emit = emit.at[rows, accepted].set(correction)
+    return accepted, correction, emit
 
 
 def make_speculative_generator(
@@ -167,18 +197,9 @@ def make_speculative_generator(
                 cache_index=fill,
             )
             greedy = jnp.argmax(v_logits, -1).astype(jnp.int32)  # [B, k+1]
-
-            # greedy acceptance: draft i accepted iff it equals the
-            # target's choice after position i-1 AND all earlier accepted
-            match = proposals == greedy[:, :k]                 # [B, k]
-            accepted = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
-            correction = jnp.take_along_axis(
-                greedy, accepted[:, None], axis=1
-            )[:, 0]
-            emit_toks = jnp.concatenate(
-                [proposals, jnp.zeros((batch, 1), jnp.int32)], axis=1
+            accepted, correction, emit_toks = greedy_acceptance(
+                proposals, greedy
             )
-            emit_toks = emit_toks.at[rows, accepted].set(correction)
             emit_len = jnp.where(done, 0, accepted + 1)        # [B]
 
             # write this round's tokens at each row's emitted offset

@@ -221,6 +221,8 @@ class DecodeEngine:
         seed: int = 0,
         submit_timeout: float = 300.0,
         system_prefix: Optional[Sequence[int]] = None,
+        draft_module=None,
+        speculate_k: int = 4,
     ):
         import jax
 
@@ -230,6 +232,44 @@ class DecodeEngine:
             raise ValueError("need at least one slot")
         if not prompt_buckets:
             raise ValueError("need at least one prompt bucket")
+        self.draft = draft_module
+        self.speculate_k = int(speculate_k)
+        if self.draft is not None:
+            # SPECULATIVE engine: per-slot draft proposals + one shared
+            # [slots, k+1] verify forward per round, greedy acceptance
+            # advancing per-slot fills — token-identical to plain greedy
+            # decoding of the target (the make_speculative_generator
+            # acceptance rule, restructured for the resident slot batch)
+            if temperature != 0.0:
+                raise ValueError(
+                    "the speculative engine is greedy-only (sampled "
+                    "speculation needs the rejection-sampling correction; "
+                    "match make_speculative_generator)"
+                )
+            if system_prefix is not None:
+                raise ValueError(
+                    "speculative decoding is not composed with "
+                    "system_prefix yet — drop one of the two"
+                )
+            if self.draft.config.vocab_size != module.config.vocab_size:
+                raise ValueError(
+                    f"target/draft vocabularies differ: "
+                    f"{module.config.vocab_size} vs "
+                    f"{self.draft.config.vocab_size}"
+                )
+            if self.speculate_k < 1:
+                raise ValueError(f"speculate_k must be >= 1, got {speculate_k}")
+            if self.speculate_k + 1 > min(int(b) for b in prompt_buckets):
+                # idle slots write k+1 garbage draft/verify rows from
+                # their parked fill; admission's full-bucket splice must
+                # cover them
+                raise ValueError(
+                    f"speculate_k + 1 = {self.speculate_k + 1} exceeds the "
+                    f"smallest prompt bucket {min(prompt_buckets)}"
+                )
+        # rows a dispatched chunk can advance a slot: 1 per decode step,
+        # or k+1 per speculative round
+        self._round_stride = 1 if self.draft is None else self.speculate_k + 1
         self._jax = jax
         self.module = module
         self.cfg = module.config
@@ -280,16 +320,22 @@ class DecodeEngine:
             self.prefix_len
             + self.buckets[-1]
             + max_new_tokens
-            + (self.pipeline_depth + 1) * chunk_steps
+            + (self.pipeline_depth + 1) * chunk_steps * self._round_stride
+            # a speculative round writes k rows past its counted advance
+            + (self._round_stride - 1)
         )
-        if self.cache_len > self.cfg.max_len:
+        max_lens = [self.cfg.max_len] + (
+            [self.draft.config.max_len] if self.draft is not None else []
+        )
+        if self.cache_len > min(max_lens):
             raise ValueError(
                 f"cache length {self.cache_len} (= prefix {self.prefix_len} "
                 f"+ max bucket {self.buckets[-1]} + max_new_tokens "
                 f"{max_new_tokens} + (pipeline_depth {self.pipeline_depth} "
-                f"+ 1) * chunk_steps {chunk_steps} spare rows) exceeds "
-                f"model max_len {self.cfg.max_len}; lower pipeline_depth/"
-                "chunk_steps or raise max_len"
+                f"+ 1) * chunk_steps {chunk_steps} * round stride "
+                f"{self._round_stride} spare rows) exceeds model max_len "
+                f"{min(max_lens)}; lower pipeline_depth/chunk_steps or "
+                "raise max_len"
             )
         self._sample = make_sampler(
             temperature=temperature, top_k=top_k, top_p=top_p
@@ -322,6 +368,10 @@ class DecodeEngine:
         self._steps = 0
         self._chunks = 0
         self._occupied_slot_steps = 0
+        # speculative observability: live rounds executed + draft tokens
+        # accepted (acceptance rate = accepted / (rounds * k))
+        self._spec_rounds = 0
+        self._spec_accepted = 0
         self._build_programs()
         self._stop = threading.Event()
         self._worker = threading.Thread(
@@ -343,6 +393,10 @@ class DecodeEngine:
         import jax.numpy as jnp
 
         from unionml_tpu.models.llama import init_cache
+
+        if self.draft is not None:
+            self._build_spec_programs()
+            return
 
         cfg, L, B = self.cfg, self.cache_len, self.slots
         P = self.prefix_len
@@ -531,6 +585,213 @@ class DecodeEngine:
 
         self._decode_chunk = jax.jit(decode_chunk, donate_argnums=(1,))
 
+    def _build_spec_programs(self):
+        """Speculative-mode device programs (``draft_module`` set).
+
+        Same attribute names and call signatures as the plain builders so
+        the dispatcher/admission machinery is shared verbatim; ``params``
+        is the bound ``{"target", "draft"}`` mapping, fresh caches are
+        ``(target, draft)`` pairs, and the decode chunk is a scan of
+        ``chunk_steps`` SPECULATIVE ROUNDS: per-slot draft proposals
+        (vector ``cache_index``), ONE shared [slots, k+1] verify forward,
+        greedy acceptance advancing per-slot fills — the
+        ``make_speculative_generator`` round body (same acceptance/
+        emission/eos invariants; a desync there breaks token identity)
+        restructured for the resident slot batch. No system prefix in
+        this mode (refused at construction), so P == 0 throughout.
+        """
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from unionml_tpu.models.llama import init_cache
+
+        cfg, dcfg = self.cfg, self.draft.config
+        L, B, k = self.cache_len, self.slots, self.speculate_k
+        module, draft, sample = self.module, self.draft, self._sample
+        eos_id, pad_id = self.eos_id, self.pad_id
+        R = self.chunk_steps
+
+        def init_state():
+            return {
+                "cache": init_cache(cfg, B, L),
+                "d_cache": init_cache(dcfg, B, L),
+                "kv_mask": jnp.zeros((B, L), bool),
+                "fill": jnp.zeros((B,), jnp.int32),
+                "last_tok": jnp.zeros((B,), jnp.int32),
+                "done": jnp.ones((B,), bool),
+            }
+
+        self._init_state = jax.jit(init_state)
+
+        def finish_prefill(params, state, fresh, slot, toks, start, true_len, key):
+            """Prefill tail for BOTH caches: run the (right-padded)
+            bucket/final-chunk through target and draft, sample the first
+            token from the target's last real position, splice both
+            filled caches into ``slot``."""
+            fresh_t, fresh_d = fresh
+            bucket = fresh_t[0][0].shape[1]
+            c = toks.shape[1]
+            kv_mask = (jnp.arange(bucket) < true_len)[None, :]
+            pos = start + jnp.arange(c)[None, :]
+            logits, filled_t = module.apply(
+                {"params": params["target"]}, toks, positions=pos,
+                cache=fresh_t, cache_index=start, kv_mask=kv_mask,
+                logit_index=jnp.reshape(true_len - 1 - start, (1,)),
+            )
+            # draft prefill logits are never read: DCE'd stub head
+            _, filled_d = draft.apply(
+                {"params": params["draft"]}, toks, positions=pos,
+                cache=fresh_d, cache_index=start, kv_mask=kv_mask,
+                logit_index=jnp.zeros((1,), jnp.int32),
+            )
+            first = sample(logits[:, 0], key)[0]
+            cache = _splice_rows(state["cache"], filled_t, slot, 0)
+            d_cache = _splice_rows(state["d_cache"], filled_d, slot, 0)
+            row_mask = jnp.arange(L) < true_len
+            return {
+                "cache": cache,
+                "d_cache": d_cache,
+                "kv_mask": state["kv_mask"].at[slot].set(row_mask),
+                "fill": state["fill"].at[slot].set(true_len),
+                "last_tok": state["last_tok"].at[slot].set(first),
+                "done": state["done"].at[slot].set(False),
+            }, first
+
+        def prefill(params, state, slot, tokens, true_len, key, prefix_rows):
+            fresh = (
+                init_cache(cfg, 1, tokens.shape[0]),
+                init_cache(dcfg, 1, tokens.shape[0]),
+            )
+            return finish_prefill(
+                params, state, fresh, slot, tokens[None], jnp.int32(0),
+                true_len, key,
+            )
+
+        self._prefill = jax.jit(prefill, donate_argnums=(1,))
+
+        @functools.partial(jax.jit, static_argnames=("bucket",))
+        def init_fresh(prefix_rows, *, bucket):
+            return (init_cache(cfg, 1, bucket), init_cache(dcfg, 1, bucket))
+
+        self._init_fresh = init_fresh
+
+        def prefill_step(params, fresh, toks, start):
+            fresh_t, fresh_d = fresh
+            lf = fresh_t[0][0].shape[1]
+            c = toks.shape[1]
+            kv_mask = (jnp.arange(lf) < start + c)[None, :]
+            pos = start + jnp.arange(c)[None, :]
+            _, fresh_t = module.apply(
+                {"params": params["target"]}, toks, positions=pos,
+                cache=fresh_t, cache_index=start, kv_mask=kv_mask,
+                logit_index=jnp.zeros((1,), jnp.int32),
+            )
+            _, fresh_d = draft.apply(
+                {"params": params["draft"]}, toks, positions=pos,
+                cache=fresh_d, cache_index=start, kv_mask=kv_mask,
+                logit_index=jnp.zeros((1,), jnp.int32),
+            )
+            return fresh_t, fresh_d
+
+        self._prefill_step = jax.jit(prefill_step, donate_argnums=(1,))
+        self._prefill_final = jax.jit(finish_prefill, donate_argnums=(1,))
+
+        def spec_chunk(params, state, active, keys):
+            """``chunk_steps`` speculative rounds in one scan. Returns
+            per-round ``(emit [R, B, k+1], n_emit [R, B], accepted
+            [R, B])`` — the host credits each slot ``n_emit`` tokens per
+            round (eos-truncated device-side, budget-truncated host-side
+            like the plain path)."""
+            arange_l = jnp.arange(L)[None, :]
+            rows = jnp.arange(B)
+
+            def round_body(state, _):
+                live = active & ~state["done"]
+                fill0 = state["fill"]
+
+                # draft proposes k tokens over k+1 steps (the extra step
+                # consumes proposal k so a fully-accepted round leaves no
+                # draft-cache hole — the make_speculative_generator rule)
+                def dstep(c, _):
+                    d_cache, tok, f = c
+                    vis = state["kv_mask"] | (
+                        (arange_l >= fill0[:, None])
+                        & (arange_l <= f[:, None])
+                        & live[:, None]
+                    )
+                    logits, d_cache = draft.apply(
+                        {"params": params["draft"]}, tok[:, None],
+                        cache=d_cache, cache_index=f, kv_mask=vis,
+                    )
+                    nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+                    return (d_cache, nxt, f + 1), nxt
+
+                (d_cache, _, _), props = jax.lax.scan(
+                    dstep, (state["d_cache"], state["last_tok"], fill0),
+                    None, length=k + 1,
+                )
+                props = props.transpose(1, 0)[:, :k]          # [B, k]
+
+                # ONE shared multi-token verify forward for every slot
+                verify_in = jnp.concatenate(
+                    [state["last_tok"][:, None], props], axis=1
+                )
+                vis_v = state["kv_mask"] | (
+                    (arange_l >= fill0[:, None])
+                    & (arange_l <= (fill0 + k)[:, None])
+                    & live[:, None]
+                )
+                v_logits, cache = module.apply(
+                    {"params": params["target"]}, verify_in,
+                    cache=state["cache"], cache_index=fill0, kv_mask=vis_v,
+                )
+                from unionml_tpu.models.speculative import greedy_acceptance
+
+                greedy = jnp.argmax(v_logits, -1).astype(jnp.int32)
+                accepted, correction, emit = greedy_acceptance(props, greedy)
+                n_emit = jnp.where(live, accepted + 1, 0)
+                done = state["done"]
+                if eos_id is not None:
+                    pos_idx = jnp.arange(k + 1)[None, :]
+                    eos_hit = (emit == eos_id) & (pos_idx < n_emit[:, None])
+                    any_eos = eos_hit.any(axis=1)
+                    first_eos = jnp.argmax(eos_hit, axis=1)
+                    n_emit = jnp.where(
+                        any_eos, jnp.minimum(n_emit, first_eos + 1), n_emit
+                    )
+                    done = done | (live & any_eos)
+                # rows consumed = accepted + 1 (eos shrinks EMISSION, not
+                # the cache rows written — done stops later rounds)
+                advance = jnp.where(live, accepted + 1, 0)
+                new_fill = fill0 + advance
+                # freeze before the end: the next round writes k+1 rows
+                done = done | (live & (new_fill + k + 1 >= L))
+                new_kv = state["kv_mask"] | (
+                    (arange_l >= fill0[:, None])
+                    & (arange_l < new_fill[:, None])
+                )
+                new_last = jnp.where(live, correction, state["last_tok"])
+                out = (
+                    jnp.where(live[:, None], emit, pad_id),
+                    n_emit.astype(jnp.int32),
+                    jnp.where(live, accepted, 0).astype(jnp.int32),
+                )
+                return {
+                    "cache": cache,
+                    "d_cache": d_cache,
+                    "kv_mask": new_kv,
+                    "fill": new_fill,
+                    "last_tok": new_last,
+                    "done": done,
+                }, out
+
+            state, outs = jax.lax.scan(round_body, state, None, length=R)
+            return state, outs
+
+        self._decode_chunk = jax.jit(spec_chunk, donate_argnums=(1,))
+
     # ------------------------------------------------------------------ #
     # public API
     # ------------------------------------------------------------------ #
@@ -639,6 +900,19 @@ class DecodeEngine:
         """
         if params is self._params:
             return
+        if self.draft is not None:
+            from collections.abc import Mapping
+
+            if not (
+                isinstance(params, Mapping)
+                and "target" in params
+                and "draft" in params
+            ):
+                raise ValueError(
+                    'a speculative engine binds a mapping {"target": '
+                    'params, "draft": params} (the '
+                    "make_speculative_predictor artifact contract)"
+                )
         with self._lock:
             busy = (
                 any(r is not None for r in self._occupant)
@@ -677,6 +951,7 @@ class DecodeEngine:
             total = self._completed_total
             steps, chunks = self._steps, self._chunks
             occupied = self._occupied_slot_steps
+            spec_rounds, spec_accepted = self._spec_rounds, self._spec_accepted
         out = {
             "engine": "continuous",
             "slots": self.slots,
@@ -686,6 +961,16 @@ class DecodeEngine:
             "decode_steps": steps,
             "slot_occupancy": round(occupied / max(1, steps * self.slots), 3),
         }
+        if self.draft is not None:
+            out["speculative"] = {
+                "k": self.speculate_k,
+                "rounds": spec_rounds,
+                "accepted_draft_tokens": spec_accepted,
+                # fraction of proposed draft tokens the target accepted
+                "acceptance_rate": round(
+                    spec_accepted / max(1, spec_rounds * self.speculate_k), 3
+                ),
+            }
         if done:
             names = ("queue_wait_ms", "prefill_ms", "decode_ms", "ttft_ms")
             for i, name in enumerate(names):
@@ -701,6 +986,8 @@ class DecodeEngine:
             self._steps = 0
             self._chunks = 0
             self._occupied_slot_steps = 0
+            self._spec_rounds = 0
+            self._spec_accepted = 0
 
     def close(self):
         self._stop.set()
@@ -821,6 +1108,9 @@ class DecodeEngine:
                 self._finish_if_done(slot, tok)
             return
         _, mask, gens, toks = entry
+        if self.draft is not None:
+            self._process_spec_chunk(mask, gens, toks)
+            return
         toks = np.asarray(toks)
         with self._lock:
             # slot-major (steps for different slots are independent): each
@@ -840,6 +1130,48 @@ class DecodeEngine:
                         break
                 req.emit(chunk)
                 self._finish_if_done(slot, chunk[-1])
+
+    def _process_spec_chunk(self, mask, gens, outs) -> None:
+        """Account one speculative chunk's readback: per round, each slot
+        contributed ``n_emit`` tokens (variable — acceptance-dependent)
+        from its ``emit`` row; budget truncation happens here exactly
+        like the plain path's per-token ``_req_done`` walk."""
+        emit, n_emit, accepted = (np.asarray(x) for x in outs)
+        with self._lock:
+            for slot in np.flatnonzero(mask):
+                req = self._occupant[slot]
+                if req is None or gens[slot] != self._slot_gen[slot]:
+                    continue
+                chunk: List[int] = []
+                finished = False
+                for r in range(emit.shape[0]):
+                    if n_emit[r, slot] > 0:
+                        # acceptance stats count only rounds whose tokens
+                        # were actually SERVED (inside the gens check and
+                        # before the budget break) — stale-generation and
+                        # post-retirement overshoot rounds would skew the
+                        # /stats acceptance_rate the benches report
+                        self._spec_rounds += 1
+                        self._spec_accepted += int(accepted[r, slot])
+                    for i in range(int(n_emit[r, slot])):
+                        tok = int(emit[r, slot, i])
+                        req.tokens.append(tok)
+                        chunk.append(tok)
+                        if self._req_done(req, tok):
+                            finished = True
+                            break
+                    if finished:
+                        break
+                req.emit(chunk)
+                if chunk:
+                    self._finish_if_done(slot, chunk[-1])
+                elif req.abandoned:
+                    # a fully-idle readback (device marked the slot done
+                    # before any round) still must retire an abandoned
+                    # waiter
+                    self._finish_if_done(
+                        slot, req.tokens[-1] if req.tokens else self.pad_id
+                    )
 
     def _dispatch_chunk(self) -> bool:
         """Dispatch one decode chunk if the pipeline has a credit and any
@@ -861,7 +1193,8 @@ class DecodeEngine:
             self._state, toks = self._decode_chunk(
                 self._params, self._state, jnp.asarray(mask), keys
             )
-            _start_host_copy(toks)
+            for leaf in toks if isinstance(toks, tuple) else (toks,):
+                _start_host_copy(leaf)
         except BaseException:
             # the credit is only released by the harvester for entries that
             # were actually enqueued — give it back or the pipeline wedges
@@ -870,6 +1203,13 @@ class DecodeEngine:
         with self._lock:
             for slot in np.flatnonzero(mask):
                 if self._occupant[slot] is not None:
+                    # the GUARANTEED emission per chunk (1 token/round in
+                    # speculative mode — acceptance only adds more): an
+                    # upper-bound here stops dispatching before enough
+                    # tokens actually land at partial acceptance (hang,
+                    # caught by test_spec_engine_matches_plain_greedy);
+                    # over-dispatch at high acceptance is absorbed by the
+                    # done mask + spare rows like any overshoot
                     self._occupant[slot]._expected += self.chunk_steps
             gens = tuple(self._slot_gen)
             self._chunks += 1
