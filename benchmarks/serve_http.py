@@ -105,6 +105,7 @@ def main() -> None:
     if preset == "tiny":
         args.requests = min(args.requests, 3)
     spec_predict = None
+    spec_modules = None
     if preset in ("serve_spec", "tiny_spec"):
         if args.checkpoint:
             # silently serving random weights while reporting them as
@@ -113,9 +114,10 @@ def main() -> None:
                 "--checkpoint is not supported with the speculative "
                 "presets (they build a synthetic target/draft pair)"
             )
-        # speculative decoding at the HTTP boundary: 8B target + 1.5B
-        # draft behind make_speculative_predictor, served through the
-        # row-list micro-batcher (the engine has no speculative path)
+        # speculative decoding at the HTTP boundary: target + draft pair
+        # behind make_speculative_predictor (batcher mode) or the
+        # speculative DecodeEngine (engine mode — per-slot draft rounds
+        # with one shared verify, round-5)
         from unionml_tpu.models import make_speculative_predictor
 
         if preset == "tiny_spec":
@@ -146,19 +148,27 @@ def main() -> None:
                 "draft": random_quantized_params(d_module),
             }
         qcfg = t_cfg
-        spec_predict = make_speculative_predictor(
-            t_module, d_module, max_new_tokens=args.new_tokens,
-            bucket_lens=(args.prompt_len,), speculate_k=args.spec_k,
-        )
-        if args.mode != "batcher":
-            print(json.dumps({
-                "metric": "serving_mode_auto", "mode": "batcher",
-                "rule": "speculative predictor serves via the micro-batcher",
-            }))
-            args.mode = "batcher"
+        if args.mode == "engine":
+            # the speculative ENGINE: constructed below in the unified
+            # engine block, where --pipeline-depth/--prefill-chunk/
+            # --chunk-steps are resolved (round-5)
+            spec_modules = (t_module, d_module)
+        else:
+            spec_predict = make_speculative_predictor(
+                t_module, d_module, max_new_tokens=args.new_tokens,
+                bucket_lens=(args.prompt_len,), speculate_k=args.spec_k,
+            )
+            if args.mode != "batcher":
+                print(json.dumps({
+                    "metric": "serving_mode_auto", "mode": "batcher",
+                    "rule": "speculative predictor defaults to the "
+                            "micro-batcher; pass --mode engine for the "
+                            "speculative engine",
+                }))
+                args.mode = "batcher"
 
-    if spec_predict is not None:
-        cfg = None      # the spec predictor holds its own module pair;
+    if spec_predict is not None or spec_modules is not None:
+        cfg = None      # the spec path holds its own module pair;
         qmodule = None  # the per-preset serving config never applies
     elif (cfg := serving_config(preset)) and args.checkpoint:
         if getattr(cfg, "weight_bits", 8) == 4:
@@ -241,12 +251,27 @@ def main() -> None:
                 512 if args.prompt_len >= 4096 and args.prompt_len % 512 == 0
                 else 0
             )
-        engine = DecodeEngine(
-            qmodule, slots=args.clients, max_new_tokens=args.new_tokens,
-            prompt_buckets=(args.prompt_len,), chunk_steps=args.chunk_steps,
-            pipeline_depth=depth,
-            prefill_chunk=prefill_chunk or None,
-        )
+        if spec_modules is not None:
+            # the speculative engine: same flag wiring as the plain
+            # engine (chunked admission composes with speculation);
+            # chunk_steps counts ROUNDS here, so scale the decode-steps
+            # flag down by the tokens a round can emit
+            t_mod, d_mod = spec_modules
+            engine = DecodeEngine(
+                t_mod, draft_module=d_mod, speculate_k=args.spec_k,
+                slots=args.clients, max_new_tokens=args.new_tokens,
+                prompt_buckets=(args.prompt_len,),
+                chunk_steps=max(1, round(args.chunk_steps / (args.spec_k + 1))),
+                pipeline_depth=depth,
+                prefill_chunk=prefill_chunk or None,
+            )
+        else:
+            engine = DecodeEngine(
+                qmodule, slots=args.clients, max_new_tokens=args.new_tokens,
+                prompt_buckets=(args.prompt_len,), chunk_steps=args.chunk_steps,
+                pipeline_depth=depth,
+                prefill_chunk=prefill_chunk or None,
+            )
 
         @model.predictor
         def predictor(params: dict, prompts: list) -> list:

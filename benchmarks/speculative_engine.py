@@ -69,8 +69,11 @@ def main() -> None:
     else:
         from benchmarks.serve_latency import random_quantized_params
 
+        # UNIONML_TPU_SPEC_TARGET=serve_8b_w4 runs the packed-int4
+        # target (the round-4 north-star artifact) under speculation
+        t_preset = os.environ.get("UNIONML_TPU_SPEC_TARGET", "serve_8b")
         t_cfg = LlamaConfig(
-            **{**serving_config("serve_8b").__dict__, "quantized": True}
+            **{**serving_config(t_preset).__dict__, "quantized": True}
         )
         # ~0.3B draft (the round-4 curve's identified lever)
         d_cfg = LlamaConfig(

@@ -614,11 +614,16 @@ def test_local_backend_applies_resources_env(fixture_model, monkeypatch):
 
     # device workflow (chips=1): redeploy with explicit device resources —
     # the launcher must apply the thread caps but NOT pin the platform
-    # (whatever JAX_PLATFORMS the ambient env carries passes through)
+    # (whatever JAX_PLATFORMS the ambient env carries passes through).
+    # monkeypatch-scoped: the sklearn_app module is SHARED across tests,
+    # so unrestored mutations leak into later fixtures (caught by the
+    # tpuvm resources test failing only in full-suite order)
     from unionml_tpu.defaults import DEFAULT_DEVICE_RESOURCES
 
-    model._train_task_kwargs["resources"] = DEFAULT_DEVICE_RESOURCES
-    model._train_task = None  # force stage regeneration with new resources
+    monkeypatch.setitem(
+        model._train_task_kwargs, "resources", DEFAULT_DEVICE_RESOURCES
+    )
+    monkeypatch.setattr(model, "_train_task", None)  # regenerate stage
     backend.deploy(model, app_version="rv2")
     captured.clear()
     record = backend.execute(
