@@ -251,6 +251,11 @@ def main() -> None:
                 512 if args.prompt_len >= 4096 and args.prompt_len % 512 == 0
                 else 0
             )
+        common = dict(
+            slots=args.clients, max_new_tokens=args.new_tokens,
+            prompt_buckets=(args.prompt_len,), pipeline_depth=depth,
+            prefill_chunk=prefill_chunk or None,
+        )
         if spec_modules is not None:
             # the speculative engine: same flag wiring as the plain
             # engine (chunked admission composes with speculation);
@@ -259,18 +264,12 @@ def main() -> None:
             t_mod, d_mod = spec_modules
             engine = DecodeEngine(
                 t_mod, draft_module=d_mod, speculate_k=args.spec_k,
-                slots=args.clients, max_new_tokens=args.new_tokens,
-                prompt_buckets=(args.prompt_len,),
                 chunk_steps=max(1, round(args.chunk_steps / (args.spec_k + 1))),
-                pipeline_depth=depth,
-                prefill_chunk=prefill_chunk or None,
+                **common,
             )
         else:
             engine = DecodeEngine(
-                qmodule, slots=args.clients, max_new_tokens=args.new_tokens,
-                prompt_buckets=(args.prompt_len,), chunk_steps=args.chunk_steps,
-                pipeline_depth=depth,
-                prefill_chunk=prefill_chunk or None,
+                qmodule, chunk_steps=args.chunk_steps, **common,
             )
 
         @model.predictor

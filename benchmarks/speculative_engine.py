@@ -56,6 +56,7 @@ def main() -> None:
     tiny = os.environ.get("UNIONML_TPU_BENCH_PRESET") == "tiny" or (
         jax.default_backend() == "cpu"
     )
+    t_preset = "tiny"
     if tiny:
         t_cfg = LlamaConfig.tiny(vocab_size=512)
         d_cfg = LlamaConfig.tiny(
@@ -70,8 +71,16 @@ def main() -> None:
         from benchmarks.serve_latency import random_quantized_params
 
         # UNIONML_TPU_SPEC_TARGET=serve_8b_w4 runs the packed-int4
-        # target (the round-4 north-star artifact) under speculation
+        # target (the round-4 north-star artifact) under speculation.
+        # Validated: serving_config falls back to 1.5B for unknown
+        # names, which would silently poison the record with a
+        # mislabeled target
         t_preset = os.environ.get("UNIONML_TPU_SPEC_TARGET", "serve_8b")
+        if t_preset not in ("serve_8b", "serve_8b_w4", "serve_1p5b"):
+            raise SystemExit(
+                f"unknown UNIONML_TPU_SPEC_TARGET {t_preset!r} (use "
+                "serve_8b, serve_8b_w4, or serve_1p5b)"
+            )
         t_cfg = LlamaConfig(
             **{**serving_config(t_preset).__dict__, "quantized": True}
         )
@@ -135,7 +144,9 @@ def main() -> None:
     closed_loop(lambda p: plain.generate(t_params, p))
     base = closed_loop(lambda p: plain.generate(t_params, p))
     plain.close()
-    print(json.dumps({"metric": "spec_engine_plain_baseline", **base}), flush=True)
+    print(json.dumps({
+        "metric": "spec_engine_plain_baseline", "target": t_preset, **base,
+    }), flush=True)
 
     # ---- speculative engine over the boosted target ----
     boosted = make_boosted_target(t_cfg)
@@ -160,6 +171,7 @@ def main() -> None:
         ms_per_round = round(wall * 1e3 / max(1, spec["rounds"] / slots), 2)
         print(json.dumps({
             "metric": "spec_engine_boosted",
+            "target": t_preset,
             "k": k,
             "boost": boost,
             "acceptance": spec["acceptance_rate"],

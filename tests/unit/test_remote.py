@@ -235,13 +235,17 @@ def test_tpuvm_per_host_failure_propagates(tpuvm_model, monkeypatch):
     with pytest.raises(RuntimeError, match=r"host 1 \(hostB\): rc=3"):
         backend.execute(model, workflow="train", app_version="v1",
                         inputs={}, wait=True)
-    # the record was marked FAILED for later inspectors
+    # the record was marked FAILED for later inspectors — and the host
+    # died WITHOUT reporting (simulated crash rc=3), so the failure is
+    # classified as a preemption: eligible for execute(max_restarts=)
     from unionml_tpu.remote import ExecutionRecord
 
     execs = list((Path(str(tmp_path / "backend")) / "executions" /
                   "fixture-project").iterdir())
     assert len(execs) == 1
-    assert ExecutionRecord.load(execs[0]).status == "FAILED"
+    rec = ExecutionRecord.load(execs[0])
+    assert rec.status == "FAILED"
+    assert rec.failure_kind == "preempted"
 
 
 def test_tpuvm_single_host_end_to_end_without_shared_fs(tpuvm_model, monkeypatch):

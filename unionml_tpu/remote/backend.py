@@ -883,7 +883,43 @@ class TPUVMBackend(BaseBackend):
                 log_path = Path(execution.exec_dir) / f"runner.host{i}.log"
                 tail = log_path.read_text()[-1000:] if log_path.exists() else "<no log>"
                 detail.append(f"host {i} ({host}): {why}\n{tail}")
+            if not self.shared_fs:
+                # best-effort record fetch from EVERY failing host (an
+                # app crash may be reported by a non-coordinator host —
+                # fetching only host 0 would misclassify it), falling
+                # back to host 0 for the survivor-kill case
+                for i in sorted({i for i, _, _ in failures} | {0}):
+                    try:
+                        self._scp_from(
+                            self.hosts[i],
+                            f"{launched['targets'][i]}/_exec/"
+                            f"{execution.execution_id}/record.json",
+                            execution.exec_dir,
+                        )
+                    except Exception:  # pragma: no cover - transport
+                        continue
+                    try:
+                        if ExecutionRecord.load(execution.exec_dir).status == "FAILED":
+                            break
+                    except (OSError, json.JSONDecodeError, TypeError):
+                        continue
+            # classify for the max_restarts loop: a runner that wrote its
+            # own FAILED status crashed deterministically (never worth
+            # relaunching); a host PROCESS that died without reporting
+            # (slice preemption, eviction, OOM-kill) is retryable. A pure
+            # wall-clock timeout is NEITHER — the remote runners may
+            # still be alive, and relaunching over them would race two
+            # coordinators on the same ports/exec dir.
+            try:
+                reported = (
+                    ExecutionRecord.load(execution.exec_dir).status == "FAILED"
+                )
+            except (OSError, json.JSONDecodeError, TypeError):
+                reported = False
+            host_died = any(why.startswith("rc=") for _, _, why in failures)
             execution.status = "FAILED"
+            if host_died and not reported:
+                execution.failure_kind = "preempted"
             execution.save()
             raise RuntimeError(
                 f"execution {execution.execution_id} FAILED on "
