@@ -201,6 +201,20 @@ class DecodeEngine:
             plenty and the extra depth is harmless).
         temperature/top_k/top_p/eos_id/pad_id: sampling config, matching
             :func:`~unionml_tpu.models.generate.make_generator`.
+        draft_module: a smaller same-vocabulary decoder enabling
+            SPECULATIVE decoding: each decode chunk becomes
+            ``chunk_steps`` rounds of per-slot draft proposals + ONE
+            shared ``[slots, k+1]`` verify forward (amortizing the
+            target's weight stream across every resident slot), with
+            greedy acceptance advancing per-slot fills —
+            token-identical to plain greedy decoding of the target for
+            any draft. ``bind``/``generate`` then take the
+            ``{"target": ..., "draft": ...}`` params mapping. Greedy
+            only; not composed with ``system_prefix``. Measured
+            (BASELINE.md round 5): crossover ~25% observed acceptance,
+            1.69× at full, 8B target + 0.3B draft.
+        speculate_k: draft tokens proposed per round (k+1 emitted max;
+            a round costs k+1 draft steps + one (k+1)-token verify).
     """
 
     def __init__(
