@@ -1,0 +1,276 @@
+"""TPUVM choreography over the REAL transport: two localhost "hosts".
+
+The round-4 verdict's remaining transport gap: `TPUVMBackend`'s SSH/scp
+choreography had only ever run against monkeypatched transport methods
+(tests/unit/test_remote.py), which share the test filesystem and skip the
+literal argv paths. Here nothing on the backend is patched:
+
+- the backend shells out to `ssh`/`scp` binaries found on PATH — shim
+  executables that map each hostname to its own PRIVATE directory root
+  (every absolute path under the control base is rewritten to
+  ``{fauxroot}/{host}{path}``), then execute the command string under a
+  real shell. Two hosts therefore have genuinely disjoint filesystems on
+  one machine — the property the faked transport cannot model (it needs
+  a same-path no-op special case precisely because it shares the FS);
+- the two SSH-launched runner processes bring up ONE real
+  ``jax.distributed`` world (Gloo over loopback, coordinator = host 0),
+  proven by a cross-process ``process_allgather`` inside the trainer;
+- with ``shared_fs: false``, inputs are scp-staged to each host's
+  private root, host 0's outputs are scp-fetched back, and the predict
+  workflow exercises ``_stage_model_registry``'s exec-dir rewrite
+  against hosts that really cannot see the deployer's registry.
+
+Reference analog: tests/integration/test_flyte_remote.py:33-57 (prove
+the control plane against a real local stand-in, not mocks).
+"""
+
+import json
+import os
+import socket
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+SSH_SHIM = textwrap.dedent(
+    """\
+    #!/usr/bin/env python3
+    # ssh shim: `ssh [-o opt]... user@host command` -> run the command
+    # locally with every control-base path rewritten into the host's
+    # private root. Exit code passes through (failure aggregation).
+    import json, os, subprocess, sys
+
+    args = sys.argv[1:]
+    i = 0
+    while i < len(args):
+        if args[i] == "-o":
+            i += 2
+        elif args[i].startswith("-"):
+            i += 1
+        else:
+            break
+    dest, command = args[i], " ".join(args[i + 1:])
+    host = dest.split("@", 1)[1]
+    base = os.environ["UNIONML_TPU_FAUXHOST_BASE"]
+    hostroot = os.path.join(os.environ["UNIONML_TPU_FAUXHOST_ROOT"], host)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the "host" sets its own device count
+    env.update(json.loads(os.environ.get("UNIONML_TPU_FAUXHOST_ENV", "{}")))
+    sys.exit(subprocess.call(
+        ["bash", "-c", command.replace(base, hostroot + base)], env=env))
+    """
+)
+
+SCP_SHIM = textwrap.dedent(
+    """\
+    #!/usr/bin/env python3
+    # scp shim: rewrite the remote side's path into the host's private
+    # root, then cp -r. `src/.` copies contents, like scp.
+    import os, subprocess, sys
+
+    paths = []
+    args = sys.argv[1:]
+    i = 0
+    while i < len(args):
+        if args[i] == "-o":
+            i += 2
+        elif args[i].startswith("-"):
+            i += 1
+        else:
+            paths.append(args[i])
+            i += 1
+    base = os.environ["UNIONML_TPU_FAUXHOST_BASE"]
+
+    def map_path(p):
+        if "@" in p and ":" in p.split("@", 1)[1]:
+            host, path = p.split("@", 1)[1].split(":", 1)
+            hostroot = os.path.join(
+                os.environ["UNIONML_TPU_FAUXHOST_ROOT"], host)
+            return path.replace(base, hostroot + base)
+        return p
+
+    src, dst = map_path(paths[0]), map_path(paths[1])
+    sys.exit(subprocess.call(["cp", "-r", src, dst]))
+    """
+)
+
+# The deployed app. The trainer runs once per host under the coordinator
+# env TPUVMBackend sets; the allgather proves the two SSH-launched
+# processes joined one distributed runtime (not two isolated ones).
+MH_APP = textwrap.dedent(
+    '''\
+    """Two-host fixture app (deployed over the shim transport)."""
+
+    import numpy as np
+    import pandas as pd
+
+    from unionml_tpu import Dataset, Model
+    from unionml_tpu.defaults import Resources
+
+    dataset = Dataset(name="mh_dataset", test_size=0.25, shuffle=True,
+                      targets=["y"])
+
+
+    def make_model(scale: float = 1.0) -> dict:
+        return {"scale": scale}
+
+
+    model = Model(name="mh_model", init=make_model, dataset=dataset)
+
+
+    @dataset.reader
+    def reader(n: int = 64) -> pd.DataFrame:
+        rng = np.random.default_rng(11)
+        x1 = rng.normal(size=n)
+        x2 = rng.normal(size=n)
+        y = x1 * 2.0 - x2
+        return pd.DataFrame({"x1": x1, "x2": x2, "y": y})
+
+
+    @model.trainer(resources=Resources(cpu="4", chips=0))
+    def trainer(m: dict, features: pd.DataFrame, target: pd.DataFrame) -> dict:
+        import jax
+        from jax.experimental import multihost_utils
+
+        peers = multihost_utils.process_allgather(
+            np.asarray([jax.process_index()], dtype=np.int32))
+        w, *_ = np.linalg.lstsq(features.to_numpy(),
+                                target.to_numpy().ravel(), rcond=None)
+        m["w"] = [float(v) for v in w]
+        m["world"] = int(jax.process_count())
+        m["peers"] = sorted(int(p) for p in np.asarray(peers).ravel())
+        return m
+
+
+    @model.predictor
+    def predictor(m: dict, features: pd.DataFrame) -> list:
+        w = np.asarray(m["w"])
+        return [float(v) for v in features.to_numpy() @ w]
+
+
+    @model.evaluator
+    def evaluator(m: dict, features: pd.DataFrame, target: pd.DataFrame) -> float:
+        # surfaces the distributed world size through the metrics path
+        return float(m["world"])
+    '''
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def shim_world(tmp_path, monkeypatch):
+    """PATH shims + private host roots + the deployable app module."""
+    base = tmp_path / "ctl"  # control side: backend root + vm workdir
+    base.mkdir()
+    fauxroot = tmp_path / "hosts"
+    fauxroot.mkdir()
+    shims = tmp_path / "bin"
+    shims.mkdir()
+    for name, body in (("ssh", SSH_SHIM), ("scp", SCP_SHIM)):
+        p = shims / name
+        p.write_text(body)
+        os.chmod(p, 0o755)
+    app_dir = tmp_path / "appsrc"
+    app_dir.mkdir()
+    (app_dir / "mh_app.py").write_text(MH_APP)
+
+    monkeypatch.setenv("PATH", f"{shims}{os.pathsep}{os.environ['PATH']}")
+    monkeypatch.setenv("UNIONML_TPU_FAUXHOST_BASE", str(base))
+    monkeypatch.setenv("UNIONML_TPU_FAUXHOST_ROOT", str(fauxroot))
+    # each "host" runs one single-device CPU jax process; the framework
+    # must be importable there (a real VM gets it from provisioning)
+    monkeypatch.setenv(
+        "UNIONML_TPU_FAUXHOST_ENV",
+        json.dumps({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "PYTHONPATH": str(REPO_ROOT),
+        }),
+    )
+    monkeypatch.setenv("UNIONML_TPU_HOME", str(base / "backend"))
+    sys.path.insert(0, str(app_dir))
+    sys.modules.pop("mh_app", None)
+    try:
+        import mh_app
+
+        yield mh_app.model, base, fauxroot
+    finally:
+        sys.path.remove(str(app_dir))
+        sys.modules.pop("mh_app", None)
+
+
+def test_two_private_hosts_real_transport(shim_world):
+    from unionml_tpu.remote import TPUVMBackend
+
+    model, base, fauxroot = shim_world
+    hosts = ["127.0.0.1", "localhost"]  # distinct identities, one machine
+    backend = TPUVMBackend(
+        hosts=hosts,
+        project="mh-project",
+        root=str(base / "backend"),
+        workdir=str(base / "vm_work"),
+        shared_fs=False,
+        provision=False,
+        coordinator_port=_free_port(),
+    )
+    model.remote(project="mh-project")
+    model._backend = backend
+
+    model.remote_deploy(app_version="v1")
+    artifact = model.remote_train(app_version="v1", n=64)
+
+    # the two SSH-launched runners formed ONE jax.distributed world
+    assert artifact.model_object["world"] == 2
+    assert artifact.model_object["peers"] == [0, 1]
+    assert artifact.metrics["test"] == 2.0
+    # the fit itself ran (y = 2*x1 - x2)
+    w = artifact.model_object["w"]
+    assert abs(w[0] - 2.0) < 1e-6 and abs(w[1] + 1.0) < 1e-6
+
+    # filesystem privacy: each host got its own pushed tree under its
+    # own root; the runner wrote its record in the host-private exec dir
+    for host in hosts:
+        pushed = Path(f"{fauxroot}/{host}{base}/vm_work/v1")
+        assert (pushed / "mh_app.py").exists(), host
+        exec_dirs = list((pushed / "_exec").iterdir())
+        assert len(exec_dirs) == 1, host
+        assert (exec_dirs[0] / "record.json").exists(), host
+    # ...and host 0's outputs were scp-fetched back to the control side
+    rec_dir = Path(f"{fauxroot}/{hosts[0]}{base}/vm_work/v1/_exec")
+    exec_id = next(rec_dir.iterdir()).name
+    local_exec = base / "backend" / "executions" / "mh-project" / exec_id
+    assert (local_exec / "outputs.pkl").exists()
+    # host 1 never wrote outputs (runner: only process 0 dumps)
+    host1_exec = Path(f"{fauxroot}/{hosts[1]}{base}/vm_work/v1/_exec") / exec_id
+    assert not (host1_exec / "outputs.pkl").exists()
+
+    # per-host runner logs landed on the control side
+    for i in range(2):
+        assert (local_exec / f"runner.host{i}.log").exists()
+
+    # predict: hosts cannot see the deployer's registry, so the backend
+    # must stage the train execution (with host-side exec_dir rewritten)
+    # before the runner can resolve model_version="latest"
+    preds = model.remote_predict(
+        app_version="v1",
+        features=[{"x1": 1.0, "x2": 0.0}, {"x1": 0.0, "x2": 1.0}],
+    )
+    assert len(preds) == 2
+    assert abs(preds[0] - 2.0) < 1e-6 and abs(preds[1] + 1.0) < 1e-6
+    # the backend really staged the train execution into each host's
+    # private registry (the exec-dir rewrite itself is asserted in
+    # tests/unit/test_remote.py — here control and host path STRINGS
+    # coincide by design, so only the push is observable)
+    for host in hosts:
+        staged = Path(
+            f"{fauxroot}/{host}{base}/backend/executions/mh-project/{exec_id}"
+        )
+        assert (staged / "record.json").exists(), host
+        assert (staged / "outputs.pkl").exists(), host

@@ -537,16 +537,17 @@ def test_decode_interleaves_with_chunked_admission(tiny_llama):
         interleaved = False
         for _attempt in range(3):
             events.clear()
+            # pre-draw both prompts: np.random.Generator is not
+            # thread-safe, and drawing from the bg thread would race the
+            # main thread's draw under CPU contention
+            bg_prompt = rng.integers(1, 97, 8).tolist()
+            main_prompt = rng.integers(1, 97, 64).tolist()
             bg = threading.Thread(
-                target=lambda: engine.generate(
-                    params, [rng.integers(1, 97, 8).tolist()]
-                )
+                target=lambda: engine.generate(params, [bg_prompt])
             )
             bg.start()
             time.sleep(0.05)  # let the background request admit + decode
-            out = engine.generate(
-                params, [rng.integers(1, 97, 64).tolist()], max_new_tokens=4
-            )
+            out = engine.generate(params, [main_prompt], max_new_tokens=4)
             bg.join(timeout=60)
             # a hung background generate must fail LOUDLY here — retrying
             # over a still-occupied slot would corrupt events/slot state
@@ -562,6 +563,14 @@ def test_decode_interleaves_with_chunked_admission(tiny_llama):
                 if "decode" in snapshot[first:last]:
                     interleaved = True
                     break
+                # decode events AFTER the admission window mean the bg
+                # request was live through it yet never interleaved —
+                # the head-of-line-blocking regression this test exists
+                # to catch. Fail now: retrying could mask an engine that
+                # only intermittently stalls decode behind admission.
+                assert "decode" not in snapshot[first:], snapshot
+                # otherwise the bg request finished before admission
+                # began (OS scheduler stall): uninformative — retry
         assert interleaved, snapshot
     finally:
         engine.close()

@@ -44,6 +44,48 @@ def test_hyperparameter_type_synthesis(dataset):
     assert is_dataclass(model3.hyperparameter_type)
 
 
+def test_artifact_hyperparameters_are_plain_picklable_data(dataset):
+    """An annotated-init app's default hyperparameters must cross the
+    artifact boundary as a plain dict: the synthesized dataclass has no
+    importable home, so instances would break the remote runner's output
+    pickle (found by the two-host transport test; reference analog:
+    flytekit ships dataclasses as JSON, model.py:137-161)."""
+    import pickle
+
+    def init_fn(scale: float = 2.0) -> dict:
+        return {"scale": scale}
+
+    model = Model(name="hp_pickle_model", init=init_fn, dataset=dataset)
+
+    @model.trainer
+    def trainer(m: dict, features, target) -> dict:
+        return m
+
+    model.train()  # no hyperparameters passed: the default-synthesis path
+    hp = model.artifact.hyperparameters
+    assert hp == {"scale": 2.0}
+    assert not is_dataclass(hp)
+    pickle.loads(pickle.dumps(hp))
+
+    # an init that mutates its hyperparameters dict must not corrupt
+    # the recorded artifact (the artifact is a pre-init snapshot)
+    def mutating_init(scale: float = 2.0) -> dict:
+        ...
+
+    model2 = Model(name="hp_mut_model", init=mutating_init, dataset=dataset)
+
+    @model2.init
+    def do_init(hyperparameters: dict) -> dict:
+        return {"scale": hyperparameters.pop("scale")}
+
+    @model2.trainer
+    def trainer2(m: dict, features, target) -> dict:
+        return m
+
+    model2.train(hyperparameters={"scale": 3.0})
+    assert model2.artifact.hyperparameters == {"scale": 3.0}
+
+
 def test_task_interfaces(model):
     train_task = model.train_task()
     assert isinstance(train_task, Stage)

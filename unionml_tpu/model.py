@@ -23,6 +23,7 @@ remote lifecycle (model.py:625-917).
 
 from __future__ import annotations
 
+import copy
 import inspect
 import os
 from dataclasses import asdict, field, is_dataclass, make_dataclass
@@ -537,7 +538,9 @@ class Model(TrackedInstance):
         return_annotation = NamedTuple(
             "ModelArtifact",
             model_object=trainer_ret,
-            hyperparameters=self.hyperparameter_type,
+            # plain data on the way OUT (the synthesized dataclass is the
+            # INPUT type only): see the normalization note at the return
+            hyperparameters=Optional[dict],  # type: ignore[valid-type]
             metrics=Dict[str, eval_ret],  # type: ignore[valid-type]
         )
 
@@ -547,6 +550,14 @@ class Model(TrackedInstance):
             trainer_kwargs = {p: kwargs[p] for p in self.trainer_params if p in kwargs}
 
             hp_dict = asdict(hyperparameters) if is_dataclass(hyperparameters) else hyperparameters
+            # insulate BEFORE init runs: an init that mutates its
+            # hyperparameters dict (even nested values) must corrupt
+            # neither the recorded artifact nor the caller's own dict
+            if isinstance(hp_dict, dict):
+                hp_out = copy.deepcopy(hp_dict)
+                hp_dict = copy.deepcopy(hp_dict)
+            else:
+                hp_out = hp_dict
 
             def dc_kwargs(key):
                 v = kwargs.get(key)
@@ -571,7 +582,14 @@ class Model(TrackedInstance):
                 if self._evaluator is not None
                 else {}
             )
-            return return_annotation(model_object, hyperparameters, metrics)
+            # hyperparameters cross the artifact boundary as plain data:
+            # the synthesized dataclass (hyperparameter_type) has no
+            # importable home, so its instances cannot be pickled by the
+            # remote runner's output dump — the reference has the same
+            # normalization implicitly (flytekit ships dataclasses as
+            # JSON and regenerates the type via the task resolver;
+            # reference: model.py:137-161, task_resolver.py:16-31).
+            return return_annotation(model_object, hp_out, metrics)
 
         self._train_task = stage_from_fn(
             train_task,
