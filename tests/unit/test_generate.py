@@ -48,6 +48,66 @@ def test_scan_decode_matches_full_recompute(tiny_llama):
     np.testing.assert_array_equal(got, want)
 
 
+def test_flash_prefill_matches_cached_prefill(tiny_llama):
+    """``prefill_impl="flash"`` (monolithic long-prompt prefill through
+    the Pallas kernel — no [B,H,S,max_len] score buffer) must generate
+    the cached path's tokens on a ragged LEFT-PADDED batch. Exact here
+    (fp32 interpret on CPU); on TPU the kernel's bf16 p@v cast makes it
+    tolerance-equivalent, like the training flash path (measured 1.43-
+    1.62x prefill speedup at 4k — BASELINE.md round 5)."""
+    module, params = tiny_llama
+    cfg_f = dataclasses.replace(module.config, prefill_impl="flash")
+    fmod = Llama(cfg_f)
+    rng = np.random.default_rng(3)
+    toks = jnp.asarray(rng.integers(1, 97, size=(3, 24)), jnp.int32)
+    mask = jnp.asarray(
+        [[True] * 24, [False] * 5 + [True] * 19, [False] * 20 + [True] * 4]
+    )
+    toks = jnp.where(mask, toks, 0)
+
+    gen_c = make_generator(module, max_new_tokens=6, max_len=64)
+    gen_f = make_generator(fmod, max_new_tokens=6, max_len=64)
+    out_c = np.asarray(gen_c(params, toks, prompt_mask=mask))
+    out_f = np.asarray(gen_f(params, toks, prompt_mask=mask))
+    np.testing.assert_array_equal(out_c, out_f)
+
+    # a CHUNKED prefill under the flash config must not take the flash
+    # path (the tail no longer covers the whole history) — still exact
+    gen_fc = make_generator(fmod, max_new_tokens=6, max_len=64, prefill_chunk=8)
+    np.testing.assert_array_equal(
+        np.asarray(gen_fc(params, toks, prompt_mask=mask)), out_c
+    )
+
+    # composes with the int8 KV cache: flash prefill reads the EXACT
+    # fresh k/v (decode still reads the quantized cache), so tokens may
+    # differ from the cached path — deterministic and well-formed
+    cfg_q = dataclasses.replace(cfg_f, kv_quant=True)
+    gen_q = make_generator(Llama(cfg_q), max_new_tokens=6, max_len=64)
+    out_q = np.asarray(gen_q(params, toks, prompt_mask=mask))
+    np.testing.assert_array_equal(
+        out_q, np.asarray(gen_q(params, toks, prompt_mask=mask))
+    )
+    assert out_q.shape == out_c.shape and (out_q < 97).all()
+
+    # the prefix-cache build is the other monolithic full prefill: its
+    # flash-built cache must match the cached-impl build (layer i's
+    # attention output feeds layer i+1's k/v, so this checks the whole
+    # stack, not just the write path)
+    from unionml_tpu.models.generate import make_prefix_cache
+
+    prefix = rng.integers(1, 97, size=12).tolist()
+    pc_c = make_prefix_cache(module, params, prefix_tokens=prefix, max_len=64)
+    pc_f = make_prefix_cache(fmod, params, prefix_tokens=prefix, max_len=64)
+    for lc, lf in zip(pc_c.cache, pc_f.cache):
+        for bc, bf in zip(lc, lf):
+            # a few bf16 ulps: the two attention algorithms round
+            # differently into the bf16 residual stream from layer 1 on
+            np.testing.assert_allclose(
+                np.asarray(bc, np.float32), np.asarray(bf, np.float32),
+                atol=6e-2,
+            )
+
+
 def test_left_padded_prompts_match_unpadded(tiny_llama):
     module, params = tiny_llama
     rng = np.random.default_rng(1)

@@ -55,6 +55,36 @@ def test_flash_gqa_and_ragged():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_flash_kv_valid_start_matches_masked_reference():
+    """Per-row left-pad masking (generation prefill): kv positions below
+    kv_valid_start are invisible; fully-padded query rows return zeros."""
+    q, k, v = make_qkv(batch=3, seq=96, q_heads=4, kv_heads=2, dim=32)
+    pads = jnp.asarray([0, 17, 90], jnp.int32)
+
+    from unionml_tpu.ops.attention import _repeat_kv
+
+    kr, vr = _repeat_kv(k, 4), _repeat_kv(v, 4)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kr) * (32 ** -0.5)
+    qpos = jnp.arange(96)[None, None, :, None]
+    kpos = jnp.arange(96)[None, None, None, :]
+    mask = (kpos <= qpos) & (kpos >= pads[:, None, None, None])
+    p = jax.nn.softmax(jnp.where(mask, s, -1e30), axis=-1)
+    rowvalid = (jnp.arange(96)[None, :] >= pads[:, None])[:, :, None, None]
+    ref = jnp.einsum("bhqk,bkhd->bqhd", p, vr) * rowvalid
+
+    out = flash_attention(
+        q, k, v, causal=True, kv_valid_start=pads, block_q=32, block_kv=32
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # pad = 0 everywhere must equal the plain causal kernel exactly
+    out0 = flash_attention(
+        q, k, v, causal=True, kv_valid_start=jnp.zeros(3, jnp.int32),
+        block_q=32, block_kv=32,
+    )
+    plain = flash_attention(q, k, v, causal=True, block_q=32, block_kv=32)
+    np.testing.assert_array_equal(np.asarray(out0), np.asarray(plain))
+
+
 def test_flash_gradients_match_reference():
     q, k, v = make_qkv(seq=64, dim=16)
 

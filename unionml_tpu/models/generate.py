@@ -216,12 +216,24 @@ def make_generator(
                 (lead.transpose(1, 0, 2), lead_pos.transpose(1, 0, 2), starts),
             )
         tail_len = prompt_len - tail_start
+        # static promise for cfg.prefill_impl == "flash": the tail call IS
+        # the whole prefill exactly when nothing precedes it (no shared
+        # prefix, no lead chunks) — both are Python ints at trace time.
+        # The kwarg is only passed when the config opts in, so module
+        # families without the parameter are untouched.
+        full_kwargs = (
+            {"full_prefill": True}
+            if getattr(cfg, "prefill_impl", "cached") == "flash"
+            and prefix_len + tail_start == 0
+            else {}
+        )
         logits, cache = module.apply(
             {"params": params}, tokens[:, tail_start:],
             positions=positions[:, tail_start:],
             cache=cache, cache_index=jnp.int32(prefix_len + tail_start),
             kv_mask=kv_mask,
             logit_index=jnp.full((batch,), tail_len - 1, jnp.int32),
+            **full_kwargs,
         )
         key, sub = jax.random.split(key)
         first = sample(logits[:, -1], sub)
@@ -356,11 +368,21 @@ def make_prefix_cache(
                 chunk_body, cache,
                 (lead.transpose(1, 0, 2), lead_pos.transpose(1, 0, 2), starts),
             )
+        # same static full-prefill promise as generate()'s tail: when the
+        # tail covers the whole (unpadded) prefix, cfg.prefill_impl ==
+        # "flash" may run it through the flash kernel
+        full_kwargs = (
+            {"full_prefill": True}
+            if getattr(cfg, "prefill_impl", "cached") == "flash"
+            and tail_start == 0
+            else {}
+        )
         _, cache = module.apply(
             {"params": params}, toks[:, tail_start:],
             positions=positions[:, tail_start:],
             cache=cache, cache_index=jnp.int32(tail_start),
             logit_index=jnp.zeros((1,), jnp.int32),
+            **full_kwargs,
         )
         return cache
 

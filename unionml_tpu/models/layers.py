@@ -279,6 +279,13 @@ class Attention(nn.Module):
     rope_scaling: Optional[Tuple[float, float, float, int]] = None
     causal: bool = False
     attn_impl: str = "xla"
+    # attention impl for FULL prefills (multi-token call on an empty
+    # cache): "cached" = the masked cached_attention path (materializes
+    # [B, H, S, max_len] fp32 scores — ~8 GB at 8B x 8k); "flash" = the
+    # Pallas flash kernel over the FRESH post-RoPE k/v with a per-row
+    # left-pad mask (no score buffer, the long-prefill memory/speed
+    # lever). Only consulted when the caller passes full_prefill=True.
+    prefill_impl: str = "cached"
     sequence_axis: Optional[str] = None
     quantized: bool = False  # weight-only quantized projections (serving)
     weight_bits: int = 8     # 8 = int8; 4 = packed-int4 (decode bandwidth)
@@ -302,8 +309,17 @@ class Attention(nn.Module):
         cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
         cache_index: Optional[jnp.ndarray] = None,
         kv_mask: Optional[jnp.ndarray] = None,
+        full_prefill: bool = False,
     ):
         """Returns ``out`` or ``(out, new_cache)`` when a cache is given.
+
+        ``full_prefill``: STATIC caller promise that this multi-token
+        cached call covers the entire visible history — the cache is
+        empty, ``cache_index == 0``, and there is no shared prefix — so
+        attention may run over the fresh k/v alone (``prefill_impl``
+        decides how). The promise cannot be checked here (cache_index is
+        traced); passing it on a chunked or prefix prefill silently drops
+        the earlier context.
 
         ``kv``: optional (batch, kv_seq, features) source for CROSS
         attention — k/v project from it instead of ``x`` (q still from
@@ -420,38 +436,58 @@ class Attention(nn.Module):
                 ck, cv = cache
                 ck, cv = upd(ck, k), upd(cv, v)
                 new_cache = (ck, cv)
-            # attend over the filled prefix only: kv slot j is visible to
-            # query i iff j <= cache_index + i (covers decode seq=1 and
-            # cached prefill seq>1; unwritten slots are masked out)
-            kv_pos = jnp.arange(ck.shape[1])[None, :]
-            if index.ndim == 1:
-                q_pos = index[:, None, None] + jnp.arange(seq)[None, :, None]
-                visible = kv_pos[None] <= q_pos             # (batch, seq, max_len)
-                if kv_mask is not None:
-                    visible = visible & kv_mask[:, None, :]
-                bias = jnp.where(visible, 0.0, -1e30)[:, None]
-            else:
-                q_pos = index + jnp.arange(seq)[:, None]
-                visible = kv_pos <= q_pos                   # (seq, max_len)
-                if kv_mask is not None:
-                    # (batch, 1, seq, max_len): padded slots stay invisible
-                    visible = visible[None] & kv_mask[:, None, :]
+            out = None
+            if full_prefill and seq > 1 and self.prefill_impl == "flash":
+                # full-history prefill: attention over the FRESH post-RoPE
+                # k/v through the Pallas flash kernel — no [B,H,S,max_len]
+                # score buffer (the 8k x 8B OOM), better MXU tiling than
+                # max_len-wide masked chunks. Left padding masks via the
+                # kernel's per-row kv_valid_start (contiguous by the
+                # generator's construction). With an int8 KV cache the
+                # decode path reads quantized k/v while this reads exact —
+                # slightly MORE accurate than the cached prefill.
+                from unionml_tpu.ops.flash_attention import flash_attention
+
+                pads = (
+                    jnp.zeros((batch,), jnp.int32)
+                    if kv_mask is None
+                    else seq
+                    - jnp.sum(kv_mask[:, :seq].astype(jnp.int32), axis=-1)
+                )
+                out = flash_attention(q, k, v, causal=True, kv_valid_start=pads)
+            if out is None:
+                # attend over the filled prefix only: kv slot j is visible
+                # to query i iff j <= cache_index + i (covers decode seq=1
+                # and cached prefill seq>1; unwritten slots are masked out)
+                kv_pos = jnp.arange(ck.shape[1])[None, :]
+                if index.ndim == 1:
+                    q_pos = index[:, None, None] + jnp.arange(seq)[None, :, None]
+                    visible = kv_pos[None] <= q_pos         # (batch, seq, max_len)
+                    if kv_mask is not None:
+                        visible = visible & kv_mask[:, None, :]
                     bias = jnp.where(visible, 0.0, -1e30)[:, None]
                 else:
-                    bias = jnp.where(visible, 0.0, -1e30)[None, None]
-            if len(cache) == 4:
-                from unionml_tpu.ops.attention import quantized_cache_attention
+                    q_pos = index + jnp.arange(seq)[:, None]
+                    visible = kv_pos <= q_pos               # (seq, max_len)
+                    if kv_mask is not None:
+                        # (batch, 1, seq, max_len): padded slots stay invisible
+                        visible = visible[None] & kv_mask[:, None, :]
+                        bias = jnp.where(visible, 0.0, -1e30)[:, None]
+                    else:
+                        bias = jnp.where(visible, 0.0, -1e30)[None, None]
+                if len(cache) == 4:
+                    from unionml_tpu.ops.attention import quantized_cache_attention
 
-                out = quantized_cache_attention(q, ck, cv, ks, vs, bias=bias)
-            else:
-                # grouped GQA path: reads the cache at kv-head width (no
-                # repeat — measured 2x decode at 1.5B) and block-scans
-                # past the VMEM limit at long context
-                from unionml_tpu.ops.attention import cached_attention
+                    out = quantized_cache_attention(q, ck, cv, ks, vs, bias=bias)
+                else:
+                    # grouped GQA path: reads the cache at kv-head width (no
+                    # repeat — measured 2x decode at 1.5B) and block-scans
+                    # past the VMEM limit at long context
+                    from unionml_tpu.ops.attention import cached_attention
 
-                out = cached_attention(
-                    q, ck.astype(self.dtype), cv.astype(self.dtype), bias=bias
-                )
+                    out = cached_attention(
+                        q, ck.astype(self.dtype), cv.astype(self.dtype), bias=bias
+                    )
         else:
             out = _run_attention(
                 q, k, v,

@@ -48,6 +48,12 @@ class LlamaConfig:
     norm_eps: float = 1e-5  # HF `rms_norm_eps` (1e-6 for Llama-2-era)
     max_len: int = 8192
     attn_impl: str = "xla"
+    # attention impl for FULL prefills (empty cache, no prefix, no lead
+    # chunks): "flash" runs the Pallas flash kernel over the fresh k/v —
+    # no [B, H, S, max_len] score buffer, the long-prompt monolithic-
+    # prefill memory/speed lever (see Attention.prefill_impl). "cached"
+    # keeps the masked cached-attention path everywhere.
+    prefill_impl: str = "cached"
     # "fused" = Pallas RMSNorm kernel pair (ops/fused_norm.py)
     norm_impl: str = "xla"
     sequence_axis: Optional[str] = None
@@ -123,7 +129,8 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, *, positions=None, cache=None, cache_index=None, kv_mask=None):
+    def __call__(self, x, *, positions=None, cache=None, cache_index=None,
+                 kv_mask=None, full_prefill=False):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         attn = Attention(
@@ -135,6 +142,7 @@ class LlamaBlock(nn.Module):
             rope_scaling=cfg.rope_scaling,
             causal=True,
             attn_impl=cfg.attn_impl,
+            prefill_impl=cfg.prefill_impl,
             sequence_axis=cfg.sequence_axis,
             quantized=cfg.quantized,
             weight_bits=cfg.weight_bits,
@@ -149,7 +157,7 @@ class LlamaBlock(nn.Module):
         if cache is not None:
             a, new_cache = attn(
                 h, positions=positions, cache=cache, cache_index=cache_index,
-                kv_mask=kv_mask,
+                kv_mask=kv_mask, full_prefill=full_prefill,
             )
         else:
             if kv_mask is not None:
@@ -197,11 +205,16 @@ class Llama(nn.Module):
         cache_index: Optional[jnp.ndarray] = None,
         kv_mask: Optional[jnp.ndarray] = None,
         logit_index: Optional[jnp.ndarray] = None,
+        full_prefill: bool = False,
     ):
         """logits [B,S,V]; with ``cache`` returns (logits, new_cache).
 
         ``kv_mask``: bool (batch, max_len) — False cache slots are never
         attended to (left-padded prompts in generation).
+        ``full_prefill``: static caller promise that this cached call
+        covers the entire visible history (empty cache, index 0, no
+        prefix) — lets ``cfg.prefill_impl == "flash"`` run attention over
+        the fresh k/v alone (see Attention.full_prefill).
         ``logit_index``: optional int [B] — compute the LM head for only
         that position per row (returned logits are [B, 1, V]). Generation
         needs one next-token distribution, but the full-sequence head on
@@ -230,7 +243,7 @@ class Llama(nn.Module):
             layer_cache = cache[i] if cache is not None else None
             x, c = block_cls(cfg, name=f"block_{i}")(
                 x, positions=positions, cache=layer_cache, cache_index=cache_index,
-                kv_mask=kv_mask,
+                kv_mask=kv_mask, full_prefill=full_prefill,
             )
             new_cache.append(c)
         if logit_index is not None:

@@ -38,24 +38,35 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _tile_masks(q_start, kv_start, block_q, block_kv, q_len, kv_len, causal):
+def _tile_masks(q_start, kv_start, block_q, block_kv, q_len, kv_len, causal,
+                kv_start_valid=None):
     """Validity (+ causal) mask for one [BQ, BKV] score tile.
 
     Causal alignment is bottom-right (the KV-cache decode convention,
     matching ``mha_reference``): with q_len < kv_len the queries are the
     LAST q_len positions, so query i sits at global position
     ``i + (kv_len - q_len)``.
+
+    ``kv_start_valid``: optional traced scalar — kv positions BELOW it are
+    masked out (left-padded prompt slots in generation prefill).
     """
     q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
     kv_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
     mask = jnp.logical_and(q_pos < q_len, kv_pos < kv_len)
     if causal:
         mask = jnp.logical_and(mask, q_pos + (kv_len - q_len) >= kv_pos)
+    if kv_start_valid is not None:
+        mask = jnp.logical_and(mask, kv_pos >= kv_start_valid)
     return mask
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                scale, causal, block_q, block_kv, num_kv_blocks, q_len, kv_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_kv,
+                num_kv_blocks, q_len, kv_len, padded=False):
+    if padded:
+        pad_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        pad_ref = None
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -74,6 +85,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         jnp.logical_not(causal),
         kv_start <= q_start + block_q - 1 + (kv_len - q_len),
     )
+    # pad lives in SMEM as the whole [BH] vector (a (1,1) VMEM block would
+    # break Mosaic's (8,128) minimum-tile rule); index it by the bh row
+    pad = pad_ref[pl.program_id(0)] if padded else None
+    if padded:
+        # skip kv blocks that lie entirely inside this row's left padding
+        run = jnp.logical_and(run, kv_start + block_kv - 1 >= pad)
 
     @pl.when(run)
     def _compute():
@@ -87,7 +104,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale                                  # [BQ, BKV] fp32
 
-        mask = _tile_masks(q_start, kv_start, block_q, block_kv, q_len, kv_len, causal)
+        mask = _tile_masks(q_start, kv_start, block_q, block_kv, q_len, kv_len,
+                           causal, kv_start_valid=pad)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:]                          # [BQ, 1]
@@ -114,8 +132,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         )
 
 
-def _flash_fwd_bhsd(q, k, v, *, causal, scale, block_q, block_kv, interpret):
-    """q,k,v: [BH, S, D] (kv heads already repeated) → (out, lse[BH,S,1])."""
+def _flash_fwd_bhsd(q, k, v, *, causal, scale, block_q, block_kv, interpret,
+                    kv_valid_start=None):
+    """q,k,v: [BH, S, D] (kv heads already repeated) → (out, lse[BH,S,1]).
+
+    ``kv_valid_start``: optional [BH] int32 — per-row first valid kv
+    position (left-padding mask for generation prefill).
+    """
     from jax.experimental.pallas import tpu as pltpu
 
     bh, q_len, head_dim = q.shape
@@ -125,6 +148,7 @@ def _flash_fwd_bhsd(q, k, v, *, causal, scale, block_q, block_kv, interpret):
     num_q_blocks = pl.cdiv(q_len, block_q)
     num_kv_blocks = pl.cdiv(kv_len, block_kv)
 
+    padded = kv_valid_start is not None
     kernel = functools.partial(
         _fwd_kernel,
         scale=scale,
@@ -134,16 +158,22 @@ def _flash_fwd_bhsd(q, k, v, *, causal, scale, block_q, block_kv, interpret):
         num_kv_blocks=num_kv_blocks,
         q_len=q_len,
         kv_len=kv_len,
+        padded=padded,
     )
     grid = (bh, num_q_blocks, num_kv_blocks)
+    in_specs = [
+        pl.BlockSpec((1, block_q, head_dim), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, block_kv, head_dim), lambda b, qi, ki: (b, ki, 0)),
+        pl.BlockSpec((1, block_kv, head_dim), lambda b, qi, ki: (b, ki, 0)),
+    ]
+    inputs = [q, k, v]
+    if padded:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        inputs.append(jnp.asarray(kv_valid_start, jnp.int32))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_kv, head_dim), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_kv, head_dim), lambda b, qi, ki: (b, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, head_dim), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
@@ -158,7 +188,7 @@ def _flash_fwd_bhsd(q, k, v, *, causal, scale, block_q, block_kv, interpret):
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return out, lse
 
 
@@ -396,6 +426,7 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = 512,
     block_kv: int = 512,
+    kv_valid_start: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Flash attention over [B,S,H,D] tensors (GQA-aware, differentiable).
 
@@ -405,7 +436,26 @@ def flash_attention(
     faster than XLA attention forward at that length). Blocks are clamped
     to the sequence length, so short sequences degenerate to a single
     tile per (batch, head) — the best flash configuration there too.
+
+    ``kv_valid_start``: optional [B] int32 — per-row first visible kv
+    position; kv positions below it are masked out (left-padded prompts
+    in generation prefill). FORWARD-ONLY: this path has no backward
+    (generation never differentiates); differentiating it raises.
+    Fully-masked query rows (q inside the padding) return zeros.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    return _flash(q, k, v, causal, scale, block_q, block_kv)
+    if kv_valid_start is None:
+        return _flash(q, k, v, causal, scale, block_q, block_kv)
+    from unionml_tpu.ops.attention import _repeat_kv
+
+    b, _, h, _ = q.shape
+    k_r = _repeat_kv(k, h)
+    v_r = _repeat_kv(v, h)
+    pad_bh = jnp.repeat(jnp.asarray(kv_valid_start, jnp.int32), h)
+    out, _ = _flash_fwd_bhsd(
+        _to_bhsd(q), _to_bhsd(k_r), _to_bhsd(v_r),
+        causal=causal, scale=scale, block_q=block_q, block_kv=block_kv,
+        interpret=_interpret(), kv_valid_start=pad_bh,
+    )
+    return _from_bhsd(out, b, h)
