@@ -49,6 +49,28 @@ def test_engine_matches_solo_generation(tiny_llama):
         engine.close()
 
 
+def test_engine_flash_prefill_matches_solo(tiny_llama):
+    """``prefill_impl="flash"``: the engine's no-prefix monolithic
+    admissions run through the flash kernel (right-padded buckets —
+    causal masking alone hides the trailing garbage) and must still
+    produce each prompt's solo-generator tokens."""
+    module, params = tiny_llama
+    import dataclasses
+
+    fmod = Llama(dataclasses.replace(module.config, prefill_impl="flash"))
+    engine = DecodeEngine(
+        fmod, slots=4, max_new_tokens=8, prompt_buckets=(8, 16), chunk_steps=4
+    )
+    try:
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(1, 97, size=n).tolist() for n in (5, 8, 11, 16)]
+        outs = engine.generate(params, prompts)
+        for prompt, out in zip(prompts, outs):
+            assert out == _solo(fmod, params, prompt, 8)
+    finally:
+        engine.close()
+
+
 def test_mid_decode_join_is_token_identical(tiny_llama):
     """A request submitted while another is mid-decode joins at a chunk
     boundary and must not perturb either sequence."""

@@ -448,11 +448,18 @@ class Attention(nn.Module):
                 # slightly MORE accurate than the cached prefill.
                 from unionml_tpu.ops.flash_attention import flash_attention
 
+                # per-row LEADING-invalid count (argmax finds the first
+                # True). Left-padded prompts (generate) get their pad
+                # count; right-padded buckets (the engine's admissions)
+                # get 0 — causal masking alone already hides trailing
+                # garbage from every real query, and the garbage rows'
+                # outputs/cache slots are discarded/masked downstream.
                 pads = (
                     jnp.zeros((batch,), jnp.int32)
                     if kv_mask is None
-                    else seq
-                    - jnp.sum(kv_mask[:, :seq].astype(jnp.int32), axis=-1)
+                    else jnp.argmax(
+                        kv_mask[:, :seq].astype(jnp.int32), axis=-1
+                    ).astype(jnp.int32)
                 )
                 out = flash_attention(q, k, v, causal=True, kv_valid_start=pads)
             if out is None:

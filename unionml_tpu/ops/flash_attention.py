@@ -61,9 +61,17 @@ def _tile_masks(q_start, kv_start, block_q, block_kv, q_len, kv_len, causal,
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_kv,
-                num_kv_blocks, q_len, kv_len, padded=False):
+                num_kv_blocks, q_len, kv_len, padded=False, pad_div=1,
+                with_lse=True):
     if padded:
-        pad_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+        if with_lse:
+            pad_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+        else:
+            # forward-only padded path: no backward ever reads the lse,
+            # so it is neither declared nor written (pure HBM savings in
+            # the memory-bound long-prefill regime)
+            pad_ref, o_ref, acc_ref, m_ref, l_ref = rest
+            lse_ref = None
     else:
         pad_ref = None
         o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
@@ -85,9 +93,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_kv,
         jnp.logical_not(causal),
         kv_start <= q_start + block_q - 1 + (kv_len - q_len),
     )
-    # pad lives in SMEM as the whole [BH] vector (a (1,1) VMEM block would
-    # break Mosaic's (8,128) minimum-tile rule); index it by the bh row
-    pad = pad_ref[pl.program_id(0)] if padded else None
+    # pad lives in SMEM as a whole per-BATCH vector (a (1,1) VMEM block
+    # would break Mosaic's (8,128) minimum-tile rule); the grid row is
+    # batch*heads, so divide the head factor back out
+    pad = pad_ref[pl.program_id(0) // pad_div] if padded else None
     if padded:
         # skip kv blocks that lie entirely inside this row's left padding
         run = jnp.logical_and(run, kv_start + block_kv - 1 >= pad)
@@ -125,20 +134,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_kv,
     @pl.when(ki == num_kv_blocks - 1)
     def _finalize():
         o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
-        # padded rows (l == 0) get lse = 0 so the backward's
-        # exp(NEG_INF - lse) stays 0 instead of overflowing
-        lse_ref[0] = jnp.where(
-            l_ref[:] > 0.0, m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30)), 0.0
-        )
+        if lse_ref is not None:
+            # padded rows (l == 0) get lse = 0 so the backward's
+            # exp(NEG_INF - lse) stays 0 instead of overflowing
+            lse_ref[0] = jnp.where(
+                l_ref[:] > 0.0,
+                m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30)),
+                0.0,
+            )
 
 
-def _flash_fwd_bhsd(q, k, v, *, causal, scale, block_q, block_kv, interpret,
-                    kv_valid_start=None):
-    """q,k,v: [BH, S, D] (kv heads already repeated) → (out, lse[BH,S,1]).
-
-    ``kv_valid_start``: optional [BH] int32 — per-row first valid kv
-    position (left-padding mask for generation prefill).
-    """
+def _flash_fwd_bhsd(q, k, v, *, causal, scale, block_q, block_kv, interpret):
+    """q,k,v: [BH, S, D] (kv heads already repeated) → (out, lse[BH,S,1])."""
     from jax.experimental.pallas import tpu as pltpu
 
     bh, q_len, head_dim = q.shape
@@ -148,7 +155,6 @@ def _flash_fwd_bhsd(q, k, v, *, causal, scale, block_q, block_kv, interpret,
     num_q_blocks = pl.cdiv(q_len, block_q)
     num_kv_blocks = pl.cdiv(kv_len, block_kv)
 
-    padded = kv_valid_start is not None
     kernel = functools.partial(
         _fwd_kernel,
         scale=scale,
@@ -158,22 +164,16 @@ def _flash_fwd_bhsd(q, k, v, *, causal, scale, block_q, block_kv, interpret,
         num_kv_blocks=num_kv_blocks,
         q_len=q_len,
         kv_len=kv_len,
-        padded=padded,
     )
     grid = (bh, num_q_blocks, num_kv_blocks)
-    in_specs = [
-        pl.BlockSpec((1, block_q, head_dim), lambda b, qi, ki: (b, qi, 0)),
-        pl.BlockSpec((1, block_kv, head_dim), lambda b, qi, ki: (b, ki, 0)),
-        pl.BlockSpec((1, block_kv, head_dim), lambda b, qi, ki: (b, ki, 0)),
-    ]
-    inputs = [q, k, v]
-    if padded:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
-        inputs.append(jnp.asarray(kv_valid_start, jnp.int32))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=in_specs,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_kv, head_dim), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_kv, head_dim), lambda b, qi, ki: (b, ki, 0)),
+        ],
         out_specs=[
             pl.BlockSpec((1, block_q, head_dim), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
@@ -188,8 +188,79 @@ def _flash_fwd_bhsd(q, k, v, *, causal, scale, block_q, block_kv, interpret,
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(*inputs)
+    )(q, k, v)
     return out, lse
+
+
+def _flash_fwd_padded(q, k, v, pad_b, *, causal, scale, block_q, block_kv,
+                      interpret):
+    """Forward-only padded flash over UNREPEATED GQA heads.
+
+    q: [B, S, H, D]; k/v: [B, S, KVH, D] — the kv operands stay at
+    kv-head width (the grid's kv index maps fold the q-head group back
+    to its kv head), so no [B, S, H, D] repeated copies are ever
+    materialized — this path exists for long-prefill memory, where a
+    num_heads/num_kv_heads repeat would multiply fresh-k/v HBM by 4 at
+    Llama geometry. ``pad_b``: [B] int32 per-BATCH first-visible kv
+    position (SMEM; the kernel divides the head factor out of the grid
+    row).
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, q_len, h, head_dim = q.shape
+    kvh = k.shape[2]
+    group = h // kvh
+    kv_len = k.shape[1]
+    qb = _to_bhsd(q)                      # [B*H, S, D]
+    kb = _to_bhsd(k)                      # [B*KVH, S, D]
+    vb = _to_bhsd(v)
+    block_q = min(block_q, q_len)
+    block_kv = min(block_kv, kv_len)
+    num_q_blocks = pl.cdiv(q_len, block_q)
+    num_kv_blocks = pl.cdiv(kv_len, block_kv)
+
+    def kv_row(bh):
+        return (bh // h) * kvh + (bh % h) // group
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_kv=block_kv,
+        num_kv_blocks=num_kv_blocks,
+        q_len=q_len,
+        kv_len=kv_len,
+        padded=True,
+        pad_div=h,
+        with_lse=False,
+    )
+    grid = (b * h, num_q_blocks, num_kv_blocks)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec(
+                (1, block_kv, head_dim), lambda bh, qi, ki: (kv_row(bh), ki, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_kv, head_dim), lambda bh, qi, ki: (kv_row(bh), ki, 0)
+            ),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, head_dim), lambda bh, qi, ki: (bh, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, q_len, head_dim), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qb, kb, vb, jnp.asarray(pad_b, jnp.int32))
+    return _from_bhsd(out, b, h)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *,
@@ -447,15 +518,8 @@ def flash_attention(
         scale = q.shape[-1] ** -0.5
     if kv_valid_start is None:
         return _flash(q, k, v, causal, scale, block_q, block_kv)
-    from unionml_tpu.ops.attention import _repeat_kv
-
-    b, _, h, _ = q.shape
-    k_r = _repeat_kv(k, h)
-    v_r = _repeat_kv(v, h)
-    pad_bh = jnp.repeat(jnp.asarray(kv_valid_start, jnp.int32), h)
-    out, _ = _flash_fwd_bhsd(
-        _to_bhsd(q), _to_bhsd(k_r), _to_bhsd(v_r),
+    return _flash_fwd_padded(
+        q, k, v, kv_valid_start,
         causal=causal, scale=scale, block_q=block_q, block_kv=block_kv,
-        interpret=_interpret(), kv_valid_start=pad_bh,
+        interpret=_interpret(),
     )
-    return _from_bhsd(out, b, h)

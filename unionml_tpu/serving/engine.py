@@ -470,7 +470,8 @@ class DecodeEngine:
                 fresh = _splice_rows(fresh, prefix_rows, 0, 0)
             return fresh
 
-        def finish_prefill(params, state, fresh, slot, toks, start, true_len, key):
+        def finish_prefill(params, state, fresh, slot, toks, start, true_len,
+                           key, **apply_kwargs):
             """The SINGLE home for the prefill tail (monolithic and
             chunked admissions both trace it — a desynced invariant here
             would corrupt one path silently): run ``toks`` (the whole
@@ -495,6 +496,7 @@ class DecodeEngine:
                 # head on the last REAL position only — the full-bucket
                 # head would materialize [1, bucket, vocab] fp32
                 logit_index=jnp.reshape(true_len - 1 - start, (1,)),
+                **apply_kwargs,
             )
             first = sample(logits[:, 0], key)[0]
             # suffix rows only ([P, P + bucket)): the slot's prefix rows
@@ -516,13 +518,24 @@ class DecodeEngine:
                 "done": state["done"].at[slot].set(False),
             }, first
 
+        # a monolithic admission with no shared prefix covers the whole
+        # visible history, so cfg.prefill_impl == "flash" may run it
+        # through the flash kernel (right-padded buckets need no pad
+        # mask: causal alone hides the trailing garbage). Chunked
+        # admissions and prefix engines keep the cached path.
+        _full_kwargs = (
+            {"full_prefill": True}
+            if P == 0 and cfg.prefill_impl == "flash"
+            else {}
+        )
+
         def prefill(params, state, slot, tokens, true_len, key, prefix_rows):
             """Monolithic admission: fresh build + full-bucket finish in
             ONE program (short buckets; one dispatch per admission)."""
             fresh = build_fresh(prefix_rows, tokens.shape[0])
             return finish_prefill(
                 params, state, fresh, slot, tokens[None], jnp.int32(0),
-                true_len, key,
+                true_len, key, **_full_kwargs,
             )
 
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
@@ -639,7 +652,8 @@ class DecodeEngine:
 
         self._init_state = jax.jit(init_state)
 
-        def finish_prefill(params, state, fresh, slot, toks, start, true_len, key):
+        def finish_prefill(params, state, fresh, slot, toks, start, true_len,
+                           key, *, target_kwargs=None, draft_kwargs=None):
             """Prefill tail for BOTH caches: run the (right-padded)
             bucket/final-chunk through target and draft, sample the first
             token from the target's last real position, splice both
@@ -653,12 +667,14 @@ class DecodeEngine:
                 {"params": params["target"]}, toks, positions=pos,
                 cache=fresh_t, cache_index=start, kv_mask=kv_mask,
                 logit_index=jnp.reshape(true_len - 1 - start, (1,)),
+                **(target_kwargs or {}),
             )
             # draft prefill logits are never read: DCE'd stub head
             _, filled_d = draft.apply(
                 {"params": params["draft"]}, toks, positions=pos,
                 cache=fresh_d, cache_index=start, kv_mask=kv_mask,
                 logit_index=jnp.zeros((1,), jnp.int32),
+                **(draft_kwargs or {}),
             )
             first = sample(logits[:, 0], key)[0]
             cache = _splice_rows(state["cache"], filled_t, slot, 0)
@@ -673,6 +689,12 @@ class DecodeEngine:
                 "done": state["done"].at[slot].set(False),
             }, first
 
+        # the spec engine has no prefix mode, so every monolithic
+        # admission is a full prefill — each model honors its OWN
+        # prefill_impl (target and draft configs may differ)
+        _t_full = {"full_prefill": True} if cfg.prefill_impl == "flash" else {}
+        _d_full = {"full_prefill": True} if dcfg.prefill_impl == "flash" else {}
+
         def prefill(params, state, slot, tokens, true_len, key, prefix_rows):
             fresh = (
                 init_cache(cfg, 1, tokens.shape[0]),
@@ -680,7 +702,7 @@ class DecodeEngine:
             )
             return finish_prefill(
                 params, state, fresh, slot, tokens[None], jnp.int32(0),
-                true_len, key,
+                true_len, key, target_kwargs=_t_full, draft_kwargs=_d_full,
             )
 
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
