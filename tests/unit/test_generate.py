@@ -336,6 +336,24 @@ def test_generation_under_tensor_parallel_sharding(tiny_llama):
     np.testing.assert_array_equal(got, ref)
 
 
+def test_flash_prefill_under_tensor_parallel_sharding(tiny_llama):
+    """prefill_impl="flash" composes with TP-sharded serving params:
+    GSPMD handles the Pallas prefill call without breaking compilation,
+    and tokens match the unsharded flash run."""
+    from unionml_tpu.models import LLAMA_PARTITION_RULES
+    from unionml_tpu.parallel import ShardingConfig, shard_pytree
+
+    module, params = tiny_llama
+    fmod = Llama(dataclasses.replace(module.config, prefill_impl="flash"))
+    prompt = jnp.asarray([[7, 3, 9, 2, 11, 5]], jnp.int32)
+    gen = make_generator(fmod, max_new_tokens=4, max_len=32)
+    ref = np.asarray(gen(params, prompt))
+
+    cfg = ShardingConfig(data=-1, tensor=2, rules=LLAMA_PARTITION_RULES)
+    got = np.asarray(gen(shard_pytree(params, cfg), prompt))
+    np.testing.assert_array_equal(got, ref)
+
+
 def test_remat_gradients_match_non_remat(tiny_llama):
     """remat recomputes, never changes math: grads must be identical."""
     module, params = tiny_llama

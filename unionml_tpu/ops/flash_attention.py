@@ -61,17 +61,14 @@ def _tile_masks(q_start, kv_start, block_q, block_kv, q_len, kv_len, causal,
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_kv,
-                num_kv_blocks, q_len, kv_len, padded=False, pad_div=1,
-                with_lse=True):
+                num_kv_blocks, q_len, kv_len, padded=False, pad_div=1):
     if padded:
-        if with_lse:
-            pad_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
-        else:
-            # forward-only padded path: no backward ever reads the lse,
-            # so it is neither declared nor written (pure HBM savings in
-            # the memory-bound long-prefill regime)
-            pad_ref, o_ref, acc_ref, m_ref, l_ref = rest
-            lse_ref = None
+        # the padded path is forward-only (generation prefill): no
+        # backward ever reads the lse, so it is neither declared nor
+        # written (pure HBM savings in the memory-bound long-prefill
+        # regime)
+        pad_ref, o_ref, acc_ref, m_ref, l_ref = rest
+        lse_ref = None
     else:
         pad_ref = None
         o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
@@ -233,7 +230,6 @@ def _flash_fwd_padded(q, k, v, pad_b, *, causal, scale, block_q, block_kv,
         kv_len=kv_len,
         padded=True,
         pad_div=h,
-        with_lse=False,
     )
     grid = (b * h, num_q_blocks, num_kv_blocks)
     out = pl.pallas_call(
