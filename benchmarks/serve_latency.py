@@ -133,6 +133,21 @@ def main() -> None:
     parser.add_argument("--trials", type=int, default=20)
     parser.add_argument("--prompt-len", type=int, default=64)
     parser.add_argument("--new-tokens", type=int, default=32)
+    parser.add_argument(
+        "--prefill-impl", choices=("cached", "flash"), default="cached",
+        help="flash = Pallas monolithic prefill (the long-prompt lever; "
+        "BASELINE.md round 5: 1.68x at 1.5B x 4k)",
+    )
+    parser.add_argument(
+        "--prefill-chunk", type=int, default=None,
+        help="chunked cached prefill (bounds the [B,H,chunk,max_len] "
+        "score buffer; the pre-flash long-prompt path and the flash A/B "
+        "baseline)",
+    )
+    parser.add_argument(
+        "--kv-quant", action="store_true",
+        help="int8 KV cache (composes with either prefill impl)",
+    )
     args = parser.parse_args()
 
     import jax
@@ -157,7 +172,23 @@ def main() -> None:
     )
     if preset == "tiny":
         args.trials = min(args.trials, 3)
+    if args.prefill_impl == "flash" and args.prefill_chunk:
+        # chunking makes the tail call partial, so generate() never takes
+        # the flash path — measuring this silently would record a chunked
+        # number as a flash datapoint
+        parser.error("--prefill-impl flash is mutually exclusive with "
+                     "--prefill-chunk (a chunked prefill is never a full "
+                     "prefill; see docs/serving.md)")
     cfg = serving_config(preset)
+    overrides = {}
+    if args.prefill_impl != "cached":
+        overrides["prefill_impl"] = args.prefill_impl
+    if args.kv_quant:
+        overrides["kv_quant"] = True
+    if overrides:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, **overrides)
     rng = np.random.default_rng(0)
 
     if preset.startswith("serve_8b"):
@@ -199,6 +230,7 @@ def main() -> None:
         generate = make_generator(
             run_module, max_new_tokens=args.new_tokens,
             max_len=args.prompt_len + args.new_tokens,
+            prefill_chunk=args.prefill_chunk,
         )
         for batch in args.batches:
             prompt = jnp.asarray(
@@ -224,6 +256,9 @@ def main() -> None:
                 "batch": batch,
                 "prompt_len": args.prompt_len,
                 "new_tokens": args.new_tokens,
+                "prefill_impl": args.prefill_impl,
+                "prefill_chunk": args.prefill_chunk,
+                "kv_quant": bool(cfg.kv_quant),
                 "value": round(p50, 1),
                 "p95_ms": round(p95, 1),
                 "tokens_per_sec": round(toks, 1),
