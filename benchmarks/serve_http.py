@@ -67,6 +67,15 @@ def main() -> None:
         "generator's long-context rule; 0 disables)",
     )
     parser.add_argument(
+        "--prefill-impl", choices=("cached", "flash"), default="cached",
+        help="flash = Pallas monolithic prefill for FULL prefills "
+        "(BASELINE.md round 5). Unlike serve_latency, this COMPOSES with "
+        "--prefill-chunk here: bucketed serving runs flash on monolithic "
+        "admissions while chunk-ruled long buckets stay chunked-cached. "
+        "Ignored by the speculative presets (their module pair is built "
+        "separately).",
+    )
+    parser.add_argument(
         "--checkpoint", default=None,
         help="HF safetensors checkpoint directory — serve REAL weights, "
         "streamed to int8 on load (models/convert.py); geometry comes "
@@ -188,9 +197,16 @@ def main() -> None:
             max_len=cfg.max_len, kv_quant=cfg.kv_quant,
             attn_impl=cfg.attn_impl,
         )
+        if args.prefill_impl != "cached":
+            import dataclasses
+
+            qcfg = dataclasses.replace(qcfg, prefill_impl=args.prefill_impl)
         qmodule = Llama(qcfg)
     else:
-        qcfg = LlamaConfig(**{**cfg.__dict__, "quantized": True})
+        qcfg = LlamaConfig(**{
+            **cfg.__dict__, "quantized": True,
+            "prefill_impl": args.prefill_impl,
+        })
         qmodule = Llama(qcfg)
         if preset.startswith("serve_8b"):
             # synthetic quantized weights: an 8B master tree can't be
@@ -364,6 +380,7 @@ def main() -> None:
     s = percentile_summary(lat)
     print(json.dumps({
         "metric": f"{preset}_http_p50_ms", "mode": args.mode, "clients": 1,
+        "prefill_impl": args.prefill_impl, "prefill_chunk": args.prefill_chunk,
         "value": s["p50"], "p95_ms": s["p95"], "unit": "ms",
     }))
     reset_stats()
@@ -438,6 +455,7 @@ def main() -> None:
     print(json.dumps({
         "metric": f"{preset}_http_p50_ms", "mode": args.mode,
         "clients": args.clients,
+        "prefill_impl": args.prefill_impl, "prefill_chunk": args.prefill_chunk,
         "value": s["p50"], "p95_ms": s["p95"],
         "requests_per_sec": round(n / wall, 2),
         "tokens_per_sec": round(n * args.new_tokens / wall, 1),

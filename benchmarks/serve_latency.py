@@ -435,9 +435,20 @@ def prefix_cache_legs() -> None:
 
 
 if __name__ == "__main__":
-    if os.environ.get("UNIONML_TPU_BENCH_KV"):
-        kv_cache_legs()
-    elif os.environ.get("UNIONML_TPU_BENCH_PREFIX"):
-        prefix_cache_legs()
+    if os.environ.get("UNIONML_TPU_BENCH_KV") or os.environ.get(
+        "UNIONML_TPU_BENCH_PREFIX"
+    ):
+        if len(sys.argv) > 1:
+            # these legs never parse argv — accepting flags here would
+            # record hardcoded-config numbers under the flags' labels
+            raise SystemExit(
+                "UNIONML_TPU_BENCH_KV/UNIONML_TPU_BENCH_PREFIX legs take "
+                f"no CLI flags (got {sys.argv[1:]}); their configs are "
+                "hardcoded in kv_cache_legs/prefix_cache_legs"
+            )
+        if os.environ.get("UNIONML_TPU_BENCH_KV"):
+            kv_cache_legs()
+        else:
+            prefix_cache_legs()
     else:
         main()
