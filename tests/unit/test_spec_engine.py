@@ -53,6 +53,29 @@ def test_spec_engine_matches_plain_greedy(pair):
         engine.close()
 
 
+def test_spec_engine_flash_prefill_matches_plain_greedy(pair):
+    """prefill_impl="flash" on the TARGET (the spec engine's monolithic
+    admissions are full prefills): tokens must still equal plain greedy
+    decoding of the flash-config target. The draft keeps the cached
+    prefill — the two models honor their own configs independently."""
+    import dataclasses
+
+    target, draft, params = pair
+    ftarget = Llama(dataclasses.replace(target.config, prefill_impl="flash"))
+    engine = DecodeEngine(
+        ftarget, draft_module=draft, speculate_k=3, slots=3,
+        max_new_tokens=10, prompt_buckets=(8, 16), chunk_steps=2,
+    )
+    try:
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(1, 97, size=n).tolist() for n in (5, 8, 13)]
+        outs = engine.generate(params, prompts)
+        for prompt, out in zip(prompts, outs):
+            assert out == _solo(ftarget, params["target"], prompt, 10)
+    finally:
+        engine.close()
+
+
 def test_spec_engine_self_speculation_full_acceptance(pair):
     """Draft == target: every proposal is accepted (the acceptance-rule
     sanity check — a bookkeeping bug shows up as rate < 1)."""
