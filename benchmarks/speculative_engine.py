@@ -81,18 +81,57 @@ def main() -> None:
                 f"unknown UNIONML_TPU_SPEC_TARGET {t_preset!r} (use "
                 "serve_8b, serve_8b_w4, or serve_1p5b)"
             )
-        t_cfg = LlamaConfig(
-            **{**serving_config(t_preset).__dict__, "quantized": True}
+        # env knobs (read together — they size each other):
+        # PROMPT_LEN >= 1024 turns on the measured long-context levers;
+        # NEW_TOKENS: long OUTPUTS are where decode (the part
+        # speculation accelerates) dominates the request;
+        # SLOTS: fewer slots shrink the resident caches (the HBM lever
+        # for 8B x long context on one chip);
+        # PREFILL_CHUNK: chunked admission (the 8B-at-4k path — the
+        # combined target+draft flash-monolithic admission program
+        # exceeds the compiler at 8B)
+        prompt_len = int(os.environ.get("UNIONML_TPU_SPEC_PROMPT_LEN", "64"))
+        new_tokens = int(os.environ.get("UNIONML_TPU_SPEC_NEW_TOKENS", "32"))
+        slots = int(os.environ.get("UNIONML_TPU_SPEC_SLOTS", "8"))
+        prefill_chunk = (
+            int(os.environ.get("UNIONML_TPU_SPEC_PREFILL_CHUNK", "0")) or None
         )
-        # ~0.3B draft (the round-4 curve's identified lever)
-        d_cfg = LlamaConfig(
-            vocab_size=128_256, hidden_dim=1024, num_layers=10,
-            num_heads=16, num_kv_heads=8, mlp_dim=2816, max_len=2048,
-            quantized=True,
+        # the engine only chunks buckets LARGER than the chunk — mirror
+        # its admission rule, or a too-big chunk value would both admit
+        # monolithically AND disable flash (measuring the worst of both)
+        chunked = prefill_chunk is not None and prompt_len > prefill_chunk
+        long_ctx = prompt_len >= 1024
+        base_cfg = serving_config(t_preset)
+        # cache must cover bucket + generation + the engine's in-flight
+        # slack rows ((pipeline_depth + 1) * chunk_steps * round stride)
+        need_len = prompt_len + new_tokens + 128
+        lc = (
+            {
+                "kv_quant": True,
+                # flash only fires on MONOLITHIC admissions — under
+                # chunked admission leave it off so the JSON rows don't
+                # claim an impl that never engaged
+                **({} if chunked else {"prefill_impl": "flash"}),
+                "max_len": max(base_cfg.max_len, need_len),
+            }
+            if long_ctx
+            else {}
         )
+        t_cfg = LlamaConfig(**{**base_cfg.__dict__, "quantized": True, **lc})
+        # ~0.3B draft (the round-4 curve's identified lever); its cache
+        # must cover the same context as the target's
+        d_cfg = LlamaConfig(**{
+            **dict(
+                vocab_size=128_256, hidden_dim=1024, num_layers=10,
+                num_heads=16, num_kv_heads=8, mlp_dim=2816,
+                quantized=True,
+            ),
+            **lc,
+            "max_len": max(2048, need_len),
+        })
         t_params = random_quantized_params(Llama(t_cfg))
         d_params = random_quantized_params(Llama(d_cfg))
-        slots, prompt_len, new_tokens, reqs = 8, 64, 32, 2
+        reqs = 2
         # boost sweep: 0 (chance), mid points, and "accept everything";
         # override with UNIONML_TPU_SPEC_BOOSTS=2.0,3.5 to refine
         env = os.environ.get("UNIONML_TPU_SPEC_BOOSTS")
@@ -101,6 +140,8 @@ def main() -> None:
             if env else (0.0, 5.0, 8.0, 12.0, 1e9)
         )
 
+    if tiny:
+        prefill_chunk = None
     k = 4
     chunk_rounds = 2          # speculative rounds per dispatched chunk
     rng = np.random.default_rng(0)
@@ -139,13 +180,16 @@ def main() -> None:
     plain = DecodeEngine(
         target, slots=slots, max_new_tokens=new_tokens,
         prompt_buckets=(prompt_len,), chunk_steps=8, pipeline_depth=2,
+        prefill_chunk=prefill_chunk,
     )
     plain.warmup(t_params)
     closed_loop(lambda p: plain.generate(t_params, p))
     base = closed_loop(lambda p: plain.generate(t_params, p))
     plain.close()
     print(json.dumps({
-        "metric": "spec_engine_plain_baseline", "target": t_preset, **base,
+        "metric": "spec_engine_plain_baseline", "target": t_preset,
+        "prompt_len": prompt_len, "kv_quant": bool(t_cfg.kv_quant),
+        "prefill_impl": t_cfg.prefill_impl, **base,
     }), flush=True)
 
     # ---- speculative engine over the boosted target ----
@@ -154,6 +198,7 @@ def main() -> None:
         boosted, draft_module=draft, speculate_k=k, slots=slots,
         max_new_tokens=new_tokens, prompt_buckets=(prompt_len,),
         chunk_steps=chunk_rounds, pipeline_depth=2,
+        prefill_chunk=prefill_chunk,
     )
     for boost in boosts:
         params = {
@@ -172,6 +217,7 @@ def main() -> None:
         print(json.dumps({
             "metric": "spec_engine_boosted",
             "target": t_preset,
+            "prompt_len": prompt_len,
             "k": k,
             "boost": boost,
             "acceptance": spec["acceptance_rate"],
