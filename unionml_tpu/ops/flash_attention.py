@@ -492,17 +492,21 @@ def flash_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     block_q: int = 512,
-    block_kv: int = 512,
+    block_kv: Optional[int] = None,
     kv_valid_start: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Flash attention over [B,S,H,D] tensors (GQA-aware, differentiable).
 
-    Default 512×512 blocks: TPU grids pay a fixed per-program cost, so
-    fewer/bigger blocks win as long as the working set fits VMEM (measured
-    on v5e: 512-blocks are ~2x faster than 128-blocks at S=4096 and ~7x
-    faster than XLA attention forward at that length). Blocks are clamped
-    to the sequence length, so short sequences degenerate to a single
-    tile per (batch, head) — the best flash configuration there too.
+    Block defaults are path-dependent (``block_kv=None`` picks them):
+    the differentiable path uses 512×512 — TPU grids pay a fixed
+    per-program cost, so fewer/bigger blocks win as long as the working
+    set fits VMEM (measured on v5e: 512-blocks are ~2x faster than
+    128-blocks at S=4096 and ~7x faster than XLA attention forward at
+    that length); the forward-only padded path (``kv_valid_start``)
+    widens kv blocks to ``min(2048·128/head_dim, kv_len)`` — measured
+    6.5% end-to-end at 4k prompts. Blocks are clamped to the sequence
+    length, so short sequences degenerate to a single tile per
+    (batch, head) — the best flash configuration there too.
 
     ``kv_valid_start``: optional [B] int32 — per-row first visible kv
     position; kv positions below it are masked out (left-padded prompts
@@ -513,7 +517,18 @@ def flash_attention(
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if kv_valid_start is None:
-        return _flash(q, k, v, causal, scale, block_q, block_kv)
+        # training/differentiable path: 512x512 is the measured optimum
+        # (docstring above)
+        return _flash(q, k, v, causal, scale, block_q, block_kv or 512)
+    if block_kv is None:
+        # forward-only padded path (generation prefill): wider kv blocks
+        # amortize the per-program grid cost — measured end-to-end 6.5%
+        # at 1.5B x 4k prompts (block_kv 512 -> 2048, 883 -> 829 ms).
+        # 2048 was the largest that compiled at head_dim 128; scale the
+        # cap down for larger head dims so the kv VMEM tile footprint
+        # (block_kv x head_dim) stays at the measured-safe budget
+        cap = min(2048, max(512, 2048 * 128 // q.shape[-1]))
+        block_kv = min(cap, k.shape[1])
     return _flash_fwd_padded(
         q, k, v, kv_valid_start,
         causal=causal, scale=scale, block_q=block_q, block_kv=block_kv,
