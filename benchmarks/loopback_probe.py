@@ -79,12 +79,12 @@ def main() -> None:
             t.start()
         for t in threads:
             t.join()
-        lat.sort()
-        return {
-            "p50_ms": round(lat[len(lat) // 2] * 1e3, 1),
-            "p95_ms": round(lat[int(len(lat) * 0.95) - 1] * 1e3, 1),
-            "n": len(lat),
-        }
+        from unionml_tpu.serving._stats import percentile_summary
+
+        # shared nearest-rank formula (int(0.95*n) indexed the MAXIMUM
+        # for small windows — the bias _stats.percentile_summary fixes)
+        s = percentile_summary([v * 1e3 for v in lat])
+        return {"p50_ms": s["p50"], "p95_ms": s["p95"], "n": s["n"]}
 
     # --- engine ---
     engine = DecodeEngine(

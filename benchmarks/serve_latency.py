@@ -246,9 +246,10 @@ def main() -> None:
                 out = generate(run_params, prompt)
                 _ = np.asarray(out)  # host readback = end of request
                 lat.append((time.perf_counter() - t0) * 1e3)
-            lat.sort()
-            p50 = lat[len(lat) // 2]
-            p95 = lat[max(0, math.ceil(0.95 * len(lat)) - 1)]  # nearest-rank
+            from unionml_tpu.serving._stats import percentile_summary
+
+            s = percentile_summary(lat)  # shared nearest-rank formula
+            p50, p95 = s["p50"], s["p95"]
             toks = batch * args.new_tokens / (p50 / 1e3)
             print(json.dumps({
                 "metric": f"{preset}_generate_p50_ms",

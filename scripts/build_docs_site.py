@@ -37,6 +37,7 @@ NAV = [
     ("Model", "model.md"),
     ("Parallelism", "parallelism.md"),
     ("Serving", "serving.md"),
+    ("Observability", "observability.md"),
     ("Checkpoints", "checkpoints.md"),
     ("Remote deployment", "remote.md"),
     ("Reliability", "reliability.md"),

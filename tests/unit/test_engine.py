@@ -225,18 +225,19 @@ def test_bind_refuses_hot_swap_while_busy(tiny_llama):
 
 
 def test_stats_archive_is_lightweight(tiny_llama):
-    """The stats archive holds float tuples, not request payloads."""
+    """The stats source holds bounded float windows in the telemetry
+    registry, not request payloads."""
     module, params = tiny_llama
     engine = DecodeEngine(
         module, slots=2, max_new_tokens=4, prompt_buckets=(8,), chunk_steps=2
     )
     try:
         engine.generate(params, [[1, 2, 3], [4, 5, 6]])
-        with engine._lock:
-            # (queue_wait, prefill, decode, ttft) float tuples only
-            assert all(
-                isinstance(rec, tuple) and len(rec) == 4 for rec in engine._completed
-            )
+        for h in (engine._h_queue, engine._h_prefill, engine._h_decode,
+                  engine._h_ttft):
+            # floats only (no prompt/token payloads), hard-capped window
+            assert all(isinstance(v, float) for v in h._window)
+            assert len(h._window) <= h.WINDOW_CAP
         s = engine.stats()
         assert s["completed_requests"] == 2
         assert s["queue_wait_ms"]["p95"] >= s["queue_wait_ms"]["p50"] >= 0

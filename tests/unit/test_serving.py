@@ -232,6 +232,54 @@ def test_fastapi_stats_route_parity(trained_model):
         assert s.status_code == 200 and s.json()["engine"] == "direct"
 
 
+def test_metrics_endpoint_and_request_id_stdlib(server):
+    """GET /metrics serves Prometheus text exposition and every response
+    carries a generated X-Request-ID (stdlib transport)."""
+    url, _ = server
+    r = httpx.post(f"{url}/predict", json={"features": [{"x": 1.0, "x2": 2.0}]})
+    rid = r.headers.get("x-request-id")
+    assert rid and len(rid) == 16 and int(rid, 16) >= 0  # hex id
+    m = httpx.get(f"{url}/metrics")
+    assert m.status_code == 200
+    assert m.headers["content-type"].startswith("text/plain")
+    assert m.headers.get("x-request-id") != rid  # fresh id per response
+    # the HTTP layer's own series cover the predict we just made
+    assert "unionml_http_requests_total" in m.text
+    assert 'transport="stdlib"' in m.text and 'path="/predict"' in m.text
+    assert "unionml_http_request_ms_bucket" in m.text
+
+
+def test_metrics_cover_engine_series_after_traffic():
+    """After engine-backed traffic, one scrape covers HTTP-layer AND
+    engine series (the unified-registry contract)."""
+    app, engine = _lm_serving_app()
+    host, port = app.serve(port=0, blocking=False)
+    base = f"http://{host}:{port}"
+    try:
+        r = httpx.post(
+            f"{base}/predict", json={"features": [[1, 2, 3]]}, timeout=120
+        )
+        assert r.status_code == 200 and r.headers.get("x-request-id")
+        text = httpx.get(f"{base}/metrics", timeout=30).text
+        for name in (
+            "unionml_engine_requests_total",
+            "unionml_engine_queue_wait_ms_bucket",
+            "unionml_engine_slots_in_use",
+            "unionml_http_requests_total",
+        ):
+            assert name in text, name
+        # the engine's labeled series reports this request
+        row = next(
+            line for line in text.splitlines()
+            if line.startswith("unionml_engine_requests_total{")
+            and f'engine="{engine.instance}"' in line
+        )
+        assert row.rsplit(" ", 1)[1] == "1"
+    finally:
+        app.shutdown()
+        engine.close()
+
+
 # ---------------------------------------------------------------------------
 # SSE token streaming (POST /predict/stream)
 

@@ -18,11 +18,56 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import time
 from typing import Any, Callable, Iterable, Optional
 
 import numpy as np
 
+from unionml_tpu import telemetry
 from unionml_tpu._logging import logger
+
+
+def publish_hbm_gauges(registry: Optional[Any] = None) -> int:
+    """Publish each local device's ``memory_stats()['bytes_in_use']`` as
+    the ``unionml_trainer_hbm_bytes_in_use{device=...}`` gauge; returns
+    the number of devices that reported. Safe everywhere: backends
+    without memory stats (CPU, some plugins) simply publish nothing.
+    """
+    import jax
+
+    reg = registry if registry is not None else telemetry.get_registry()
+    gauge = reg.gauge(
+        "unionml_trainer_hbm_bytes_in_use",
+        "Device memory in use per jax.Device.memory_stats().",
+        ("device",),
+    )
+    published = 0
+    for device in jax.local_devices():
+        try:
+            stats = device.memory_stats()
+        except Exception:
+            stats = None
+        if stats and "bytes_in_use" in stats:
+            gauge.labels(device=str(device.id)).set(float(stats["bytes_in_use"]))
+            published += 1
+    return published
+
+
+def _publish_loss(metrics: Any, gauge: Any) -> None:
+    """Set ``gauge`` from the first scalar metric leaf whose path names
+    'loss' (readback — call only at a window boundary that already
+    syncs)."""
+    import jax
+
+    try:
+        flat, _ = jax.tree_util.tree_flatten_with_path(metrics)
+        for path, leaf in flat:
+            name = jax.tree_util.keystr(path).lower()
+            if "loss" in name and np.ndim(leaf) == 0:
+                gauge.set(float(np.asarray(leaf)))
+                return
+    except Exception:  # metrics trees are user-shaped: never fail a step
+        pass
 
 
 @functools.lru_cache(maxsize=128)
@@ -141,6 +186,7 @@ def run_step_trainer(
     donate_state: bool = True,
     accumulate_steps: int = 1,
     profile_dir: Optional[str] = None,
+    registry: Optional[Any] = None,
 ) -> Any:
     """Synthesized trainer loop around a jittable per-batch step.
 
@@ -168,6 +214,17 @@ def run_step_trainer(
     host prefetch, made streaming"). Each yielded item is fed to the step
     as-is (build ``(x, y)`` tuples in the stream); batch shapes must be
     constant or XLA recompiles per shape. ``targets`` must be None.
+
+    **Telemetry**: the loop publishes into the shared
+    :mod:`unionml_tpu.telemetry` registry (``registry=`` overrides):
+    ``unionml_trainer_step_ms`` (per-step host dispatch wall time;
+    window boundaries force a readback so windowed numbers stay honest),
+    ``unionml_trainer_loss`` (last scalar 'loss' metric at a window
+    boundary), ``unionml_trainer_samples_per_sec`` (windowed StepTimer
+    rate), steps/examples counters, and per-device
+    ``unionml_trainer_hbm_bytes_in_use`` gauges from
+    ``jax.Device.memory_stats()`` — the same registry the serving
+    layers scrape through ``GET /metrics``.
     """
     import jax
 
@@ -271,20 +328,51 @@ def run_step_trainer(
 
     from unionml_tpu.diagnostics import StepTimer, trace
 
+    reg = registry if registry is not None else telemetry.get_registry()
+    h_step = reg.histogram(
+        "unionml_trainer_step_ms",
+        "Per-step host wall time (dispatch; window boundaries force a "
+        "data-dependent readback so windowed rates measure compute).",
+    )
+    g_loss = reg.gauge(
+        "unionml_trainer_loss",
+        "Last scalar 'loss' metric read back at a window boundary.",
+    )
+    g_rate = reg.gauge(
+        "unionml_trainer_samples_per_sec",
+        "Windowed training throughput (latest StepTimer window).",
+    )
+    c_steps = reg.counter(
+        "unionml_trainer_steps_total", "Train steps dispatched.",
+    )
+    c_examples = reg.counter(
+        "unionml_trainer_examples_total", "Training examples consumed.",
+    )
+
     timer = StepTimer()
     steps = 0
     metrics = None
     ctx = trace(profile_dir) if profile_dir else contextlib.nullcontext()
     with ctx:
         for batch in prefetch_to_device(host_batches(), sharding=sharding):
+            t_step = time.perf_counter()
             state, metrics = step(state, batch)
-            if timer.closes_window():
+            window_closed = timer.closes_window()
+            if window_closed:
                 # force a readback data-dependent on this step so the
                 # window measures compute, not async dispatch (step() only
                 # enqueues work; see BASELINE.md on tunnel timing)
                 leaves = jax.tree_util.tree_leaves(metrics)
                 if leaves:
                     np.asarray(leaves[0])
+            # the sync above is part of step time; the publishes below
+            # are host-side bookkeeping and must not inflate the sample
+            h_step.observe((time.perf_counter() - t_step) * 1e3)
+            if window_closed:
+                # the window already synced: piggyback the loss/HBM
+                # publishes on it instead of adding readbacks per step
+                _publish_loss(metrics, g_loss)
+                publish_hbm_gauges(reg)
             # actual leading dim (streamed batches may differ from batch_size);
             # with accumulation the example count spans the two leading axes
             rows = next(
@@ -298,11 +386,19 @@ def run_step_trainer(
                 batch_size,
             )
             timer.tick(rows)
+            c_steps.inc()
+            c_examples.inc(rows)
+            if timer.rates:
+                g_rate.set(timer.rates[-1])
             steps += 1
     if steps:
         jax.block_until_ready(state)
         last = jax.tree_util.tree_map(lambda x: np.asarray(x).item() if np.ndim(x) == 0 else x, metrics)
+        _publish_loss(metrics, g_loss)
+        publish_hbm_gauges(reg)
         rate = timer.summary().get("samples_per_sec_median")
+        if rate:
+            g_rate.set(rate)
         suffix = f", ~{rate:.0f} samples/sec" if rate else ""
         logger.info(f"step trainer: {steps} steps, final metrics: {last}{suffix}")
     return state

@@ -12,9 +12,14 @@ predictor call). The TPU-native redesign has two layers:
   in-flight generation (the 8-client p95 fix).
 - transport: :mod:`unionml_tpu.serving.http` is a dependency-free stdlib
   HTTP server with the same surface (``GET /``, ``POST /predict``,
-  ``GET /health``, ``GET /stats``); :mod:`unionml_tpu.serving.fastapi`
-  mounts the identical routes on a FastAPI app when that stack is
-  installed.
+  ``GET /health``, ``GET /stats``, Prometheus ``GET /metrics``);
+  :mod:`unionml_tpu.serving.fastapi` mounts the identical routes on a
+  FastAPI app when that stack is installed.
+
+Both engines, both transports, and the step trainer publish through the
+:mod:`unionml_tpu.telemetry` registry — one ``GET /metrics`` scrape
+covers every layer, and engine requests record Perfetto-exportable
+trace spans (docs/observability.md).
 """
 
 from unionml_tpu.serving.batcher import MicroBatcher

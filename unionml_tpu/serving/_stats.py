@@ -7,17 +7,21 @@ from typing import Sequence
 
 
 def percentile_summary(values: Sequence[float]) -> dict:
-    """p50/p95/mean of a non-empty sample.
+    """p50/p95/p99/mean/n of a non-empty sample.
 
-    p95 uses ``ceil(0.95 * n) - 1`` (the same formula as
-    ``benchmarks/serve_http.py``): for small windows ``int(0.95 * n)``
-    indexes the sample MAXIMUM — one cold-compile outlier would be
-    reported as the p95 and misdirect tail-latency attribution.
+    Percentiles use nearest-rank ``ceil(q * n) - 1`` (the formula the
+    benchmarks share through this helper): for small windows
+    ``int(q * n)`` indexes the sample MAXIMUM — one cold-compile outlier
+    would be reported as the p95 and misdirect tail-latency attribution.
+    ``n`` is the sample count, so a consumer can tell a p99 computed
+    over 3 requests from one computed over 10k.
     """
     vals = sorted(values)
     n = len(vals)
     return {
         "p50": round(vals[n // 2], 1),
         "p95": round(vals[max(0, math.ceil(0.95 * n) - 1)], 1),
+        "p99": round(vals[max(0, math.ceil(0.99 * n) - 1)], 1),
         "mean": round(sum(vals) / n, 1),
+        "n": n,
     }

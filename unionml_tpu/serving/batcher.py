@@ -22,13 +22,18 @@ import queue
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, List, Optional, Sequence
 
 import numpy as np
 
+from unionml_tpu import telemetry
 from unionml_tpu._logging import logger
 
 DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+# batch-size histogram bounds: the row counts are small powers of two
+# (bucketed shapes), so the ms buckets would waste resolution
+BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
 
 
 def _leading_dim(features: Any, row_lists: bool) -> int:
@@ -87,6 +92,10 @@ class _Pending:
     submitted: float = 0.0
     queue_wait_ms: float = 0.0
     device_ms: float = 0.0
+    # waiter gave up (submit timeout): skip at drain time instead of
+    # burning a device call on a result nobody will read (mirrors the
+    # engine's req.abandoned convention)
+    abandoned: bool = False
 
 
 class MicroBatcher:
@@ -100,11 +109,16 @@ class MicroBatcher:
         max_wait_ms: float = 5.0,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         row_lists: bool = False,
+        registry: Optional[telemetry.MetricsRegistry] = None,
     ):
         """``row_lists=True``: features/results are plain Python lists of
         per-example rows (possibly ragged — LLM token-id prompts), so the
         batcher coalesces by list concat instead of array concat. Use for
-        predictors with the make_lm_predictor contract."""
+        predictors with the make_lm_predictor contract.
+
+        ``registry``: explicit telemetry sink; defaults to the
+        process-global registry so ``GET /metrics`` covers this batcher
+        (series isolated per instance by the ``batcher`` label)."""
         self._predict_fn = predict_fn
         self.row_lists = row_lists
         self.max_batch_size = max_batch_size
@@ -112,15 +126,51 @@ class MicroBatcher:
         self.buckets = tuple(sorted(set(buckets) | {max_batch_size}))
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
         self._stop = threading.Event()
-        self._stats_lock = threading.Lock()
-        # (queue_wait_ms, device_ms) floats only — archiving _Pending
-        # objects would pin every request's features/result payloads
-        self._done: List[Tuple[float, float]] = []
-        self._done_total = 0
-        self._batches = 0
-        self._batched_rows = 0
+        self._registry = registry if registry is not None else telemetry.get_registry()
+        self.instance = telemetry.instance_label("batcher")
+        self._build_instruments()
         self._worker = threading.Thread(target=self._run, daemon=True, name="unionml-tpu-batcher")
         self._worker.start()
+
+    def _build_instruments(self):
+        R, lbl = self._registry, {"batcher": self.instance}
+
+        def counter(name, help):
+            return R.counter(name, help, ("batcher",)).labels(**lbl)
+
+        self._m_requests = counter(
+            "unionml_batcher_requests_total",
+            "Requests completed through a batched device call.",
+        )
+        self._m_errors = counter(
+            "unionml_batcher_errors_total",
+            "Requests failed by a predictor/batcher error.",
+        )
+        self._m_abandoned = counter(
+            "unionml_batcher_abandoned_total",
+            "Requests whose submit() timed out before the batch ran "
+            "(skipped at drain time, no device call burned).",
+        )
+        self._m_batches = counter(
+            "unionml_batcher_batches_total", "Batched device calls.",
+        )
+        self._m_rows = counter(
+            "unionml_batcher_batched_rows_total",
+            "Rows coalesced into batched device calls.",
+        )
+        self._h_batch = R.histogram(
+            "unionml_batcher_batch_rows",
+            "Rows per batched device call (pre-padding).",
+            ("batcher",), buckets=BATCH_SIZE_BUCKETS,
+        ).labels(**lbl)
+        self._h_queue = R.histogram(
+            "unionml_batcher_queue_wait_ms",
+            "Submit-to-batch-start wait per request.", ("batcher",),
+        ).labels(**lbl)
+        self._h_device = R.histogram(
+            "unionml_batcher_device_ms",
+            "Shared batched device-call time per request.", ("batcher",),
+        ).labels(**lbl)
 
     def _bucket(self, n: int) -> int:
         for b in self.buckets:
@@ -129,45 +179,56 @@ class MicroBatcher:
         return self.buckets[-1]
 
     def submit(self, features: Any, timeout: Optional[float] = 60.0) -> Any:
-        """Block until the batched prediction for ``features`` is ready."""
+        """Block until the batched prediction for ``features`` is ready.
+
+        A timed-out submit marks its entry **abandoned**: the worker
+        skips it at drain time (``batcher_abandoned_total``) instead of
+        burning a device call on a result nobody will read."""
         pending = _Pending(
             features=features, rows=_leading_dim(features, self.row_lists),
             submitted=time.perf_counter(),
         )
         self._queue.put(pending)
         if not pending.event.wait(timeout):
+            pending.abandoned = True
             raise TimeoutError("micro-batcher did not produce a result in time")
         if pending.error is not None:
             raise pending.error
         return pending.result
 
     def stats(self) -> dict:
-        """Serving observability: queue-wait vs device-time split."""
-        from unionml_tpu.serving._stats import percentile_summary
+        """Serving observability: queue-wait vs device-time split.
 
-        with self._stats_lock:
-            done = list(self._done)
-            total = self._done_total
-            batches, rows = self._batches, self._batched_rows
+        A thin view over this instance's telemetry-registry series (the
+        same numbers ``GET /metrics`` exposes), keeping the historical
+        key shape."""
+        batches = int(self._m_batches.value)
         out = {
             "engine": "micro-batch",
-            "completed_requests": total,
+            "completed_requests": int(self._m_requests.value),
             "batches": batches,
-            "mean_batch_rows": round(rows / max(1, batches), 2),
+            "mean_batch_rows": round(
+                int(self._m_rows.value) / max(1, batches), 2
+            ),
         }
-        if done:
-            for i, name in enumerate(("queue_wait_ms", "device_ms")):
-                out[name] = percentile_summary([rec[i] for rec in done])
+        for name, h in (
+            ("queue_wait_ms", self._h_queue), ("device_ms", self._h_device)
+        ):
+            summary = h.summary()
+            if summary:
+                out[name] = summary
         return out
 
     def reset_stats(self) -> None:
-        """Zero the observability aggregates (benchmarks call this between
-        scenarios so each phase's /stats describes only that phase)."""
-        with self._stats_lock:
-            self._done.clear()
-            self._done_total = 0
-            self._batches = 0
-            self._batched_rows = 0
+        """Zero this instance's observability series (benchmarks call
+        this between scenarios so each phase's /stats describes only
+        that phase); scrapers see the resets as counter restarts."""
+        for m in (
+            self._m_requests, self._m_errors, self._m_abandoned,
+            self._m_batches, self._m_rows, self._h_batch, self._h_queue,
+            self._h_device,
+        ):
+            m.reset()
 
     def close(self):
         self._stop.set()
@@ -185,10 +246,14 @@ class MicroBatcher:
     # ------------------------------------------------------------------ #
 
     def _drain(self) -> List[_Pending]:
-        try:
-            first = self._queue.get(timeout=0.1)
-        except queue.Empty:
-            return []
+        while True:  # skip abandoned entries without starting a batch
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                return []
+            if not first.abandoned:
+                break
+            self._m_abandoned.inc()
         batch = [first]
         rows = first.rows
         deadline = threading.Event()
@@ -199,6 +264,9 @@ class MicroBatcher:
                 try:
                     nxt = self._queue.get(timeout=self.max_wait_s / 4)
                 except queue.Empty:
+                    continue
+                if nxt.abandoned:
+                    self._m_abandoned.inc()
                     continue
                 if rows + nxt.rows > self.max_batch_size:
                     self._queue.put(nxt)  # over cap: leave for the next batch
@@ -212,6 +280,10 @@ class MicroBatcher:
     def _run(self):
         while not self._stop.is_set():
             batch = self._drain()
+            # belt: a submit may time out between drain and dispatch
+            still_live = [p for p in batch if not p.abandoned]
+            self._m_abandoned.inc(len(batch) - len(still_live))
+            batch = still_live
             if not batch:
                 continue
             try:
@@ -245,17 +317,16 @@ class MicroBatcher:
                     p.result = _slice_rows(result, offset, offset + p.rows, rl)
                     p.device_ms = device_ms  # the shared batched call
                     offset += p.rows
-                with self._stats_lock:
-                    self._batches += 1
-                    self._batched_rows += total
-                    self._done.extend(
-                        (p.queue_wait_ms, p.device_ms) for p in batch
-                    )
-                    self._done_total += len(batch)
-                    if len(self._done) > 10_000:
-                        del self._done[:5_000]
+                self._m_batches.inc()
+                self._m_rows.inc(total)
+                self._h_batch.observe(total)
+                for p in batch:
+                    self._h_queue.observe(p.queue_wait_ms)
+                    self._h_device.observe(p.device_ms)
+                self._m_requests.inc(len(batch))
             except BaseException as exc:  # surface errors to every waiter
                 logger.info(f"micro-batcher error: {exc!r}")
+                self._m_errors.inc(len(batch))
                 for p in batch:
                     p.error = exc
             finally:

@@ -69,6 +69,7 @@ from typing import Any, List, Optional, Sequence
 
 import numpy as np
 
+from unionml_tpu import telemetry
 from unionml_tpu._logging import logger
 
 __all__ = ["DecodeEngine"]
@@ -145,9 +146,11 @@ class _Request:
     decode_ms: float = 0.0
     ttft_ms: float = 0.0
     abandoned: bool = False             # waiter gave up (timeout): retire asap
+    rid: str = ""                       # telemetry trace-span request id
     _prefill_end: float = 0.0
     _dispatch_t: float = 0.0
     _expected: int = 0                  # tokens covered by dispatched work
+    _chunk_i: int = 0                   # harvested decode chunks (trace names)
 
     def emit(self, chunk: List[int]) -> None:
         if self.stream is not None and chunk:
@@ -215,6 +218,12 @@ class DecodeEngine:
             1.69× at full, 8B target + 0.3B draft.
         speculate_k: draft tokens proposed per round (k+1 emitted max;
             a round costs k+1 draft steps + one (k+1)-token verify).
+        registry/tracer: explicit telemetry sinks
+            (:mod:`unionml_tpu.telemetry`). Default to the process-global
+            registry and trace recorder, so a ``ServingApp``'s
+            ``GET /metrics`` covers this engine automatically and every
+            request's ``queue → prefill → decode-chunk[i] → harvest``
+            spans land in the exportable trace.
     """
 
     def __init__(
@@ -237,6 +246,8 @@ class DecodeEngine:
         system_prefix: Optional[Sequence[int]] = None,
         draft_module=None,
         speculate_k: int = 4,
+        registry: Optional[telemetry.MetricsRegistry] = None,
+        tracer: Optional[telemetry.TraceRecorder] = None,
     ):
         import jax
 
@@ -374,18 +385,17 @@ class DecodeEngine:
         # semaphore caps chunk entries at pipeline_depth
         self._inflight: "queue.Queue" = queue.Queue()
         self._chunk_credits = threading.Semaphore(self.pipeline_depth)
-        # observability aggregates: (queue_wait_ms, prefill_ms, decode_ms)
-        # float tuples only — archiving whole _Request objects would pin
-        # every prompt/token payload for up to 10k requests
-        self._completed: List[tuple] = []
-        self._completed_total = 0
-        self._steps = 0
-        self._chunks = 0
-        self._occupied_slot_steps = 0
-        # speculative observability: live rounds executed + draft tokens
-        # accepted (acceptance rate = accepted / (rounds * k))
-        self._spec_rounds = 0
-        self._spec_accepted = 0
+        # observability: every tally lives in the shared telemetry
+        # registry (one scrape surface across engine/batcher/HTTP/
+        # trainer); stats() is a thin view over these instruments. The
+        # instance label keeps concurrent engines' series separate.
+        self._registry = registry if registry is not None else telemetry.get_registry()
+        self._tracer = tracer if tracer is not None else telemetry.get_tracer()
+        self.instance = telemetry.instance_label("engine")
+        self._build_instruments()
+        # harvest-span anchor: set at the top of each _process_entry
+        # (harvester thread only), read by _finish_if_done under the lock
+        self._harvest_t0 = 0.0
         self._build_programs()
         self._stop = threading.Event()
         self._worker = threading.Thread(
@@ -397,6 +407,91 @@ class DecodeEngine:
         )
         self._worker.start()
         self._harvester.start()
+
+    def _build_instruments(self):
+        """Register this instance's metric series (get-or-create: the
+        family schemas are shared, the ``engine`` label isolates us)."""
+        R, lbl = self._registry, {"engine": self.instance}
+
+        def counter(name, help):
+            return R.counter(name, help, ("engine",)).labels(**lbl)
+
+        def hist(name, help):
+            return R.histogram(name, help, ("engine",)).labels(**lbl)
+
+        self._m_requests = counter(
+            "unionml_engine_requests_total",
+            "Requests completed and delivered to their waiter.",
+        )
+        self._m_errors = counter(
+            "unionml_engine_errors_total",
+            "Requests failed by an engine/admission error.",
+        )
+        self._m_abandoned = counter(
+            "unionml_engine_abandoned_total",
+            "Requests whose waiter gave up before completion.",
+        )
+        self._m_timeouts = counter(
+            "unionml_engine_timeouts_total",
+            "generate()/generate_stream() waits that hit submit_timeout.",
+        )
+        self._m_steps = counter(
+            "unionml_engine_decode_steps_total",
+            "Decode steps dispatched (all slots advance together).",
+        )
+        self._m_chunks = counter(
+            "unionml_engine_chunks_total", "Decode chunks dispatched.",
+        )
+        self._m_occupied = counter(
+            "unionml_engine_occupied_slot_steps_total",
+            "Slot-steps dispatched with a live occupant (occupancy "
+            "numerator; denominator is decode_steps * slots).",
+        )
+        self._m_slots_busy = R.gauge(
+            "unionml_engine_slots_in_use",
+            "Slots currently holding a live request.", ("engine",),
+        ).labels(**lbl)
+        R.gauge(
+            "unionml_engine_slots", "Resident decode slots.", ("engine",)
+        ).labels(**lbl).set(self.slots)
+        self._h_queue = hist(
+            "unionml_engine_queue_wait_ms",
+            "Submit-to-admission wait per completed request.",
+        )
+        self._h_prefill = hist(
+            "unionml_engine_prefill_ms",
+            "Prefill dispatch-to-first-token-harvest per completed request.",
+        )
+        self._h_decode = hist(
+            "unionml_engine_decode_ms",
+            "First-token-to-retirement decode time per completed request.",
+        )
+        self._h_ttft = hist(
+            "unionml_engine_ttft_ms",
+            "Submit-to-first-harvested-token per completed request.",
+        )
+        self._h_dispatch = hist(
+            "unionml_engine_chunk_dispatch_ms",
+            "Host time to enqueue one decode chunk (sampler keys + jit "
+            "call; the dispatcher's per-chunk cost).",
+        )
+        self._h_harvest = hist(
+            "unionml_engine_chunk_harvest_ms",
+            "Blocking readback + accounting per harvested decode chunk "
+            "(includes in-flight pipeline lag).",
+        )
+        self._m_spec_rounds = counter(
+            "unionml_engine_spec_rounds_total",
+            "Speculative rounds whose tokens were served.",
+        )
+        self._m_spec_accepted = counter(
+            "unionml_engine_spec_accepted_tokens_total",
+            "Draft tokens accepted by the target verify forward.",
+        )
+
+    def _slots_in_use_locked(self) -> int:
+        """Occupied-slot count; call with the lock held."""
+        return sum(1 for r in self._occupant if r is not None)
 
     # ------------------------------------------------------------------ #
     # device programs (compiled once per shape)
@@ -859,6 +954,7 @@ class DecodeEngine:
                 raise ValueError("empty prompt")
             row = row[-self.buckets[-1]:]  # left-truncate to largest bucket
             req = _Request(prompt=row, max_new_tokens=n)
+            req.rid = self._tracer.new_request("generate")
             self._queue.put(req)
             reqs.append(req)
         out = []
@@ -867,6 +963,7 @@ class DecodeEngine:
                 # abandon the whole call: queued siblings are dropped at
                 # admission and in-slot ones retired at the next harvest,
                 # so orphans stop burning device time and slots
+                self._m_timeouts.inc()
                 for r in reqs:
                     r.abandoned = True
                 raise TimeoutError("decode engine did not finish in time")
@@ -904,12 +1001,14 @@ class DecodeEngine:
             raise ValueError("empty prompt")
         row = row[-self.buckets[-1]:]
         req = _Request(prompt=row, max_new_tokens=n, stream=queue.Queue())
+        req.rid = self._tracer.new_request("stream")
         self._queue.put(req)
         try:
             while True:
                 try:
                     chunk = req.stream.get(timeout=self.submit_timeout)
                 except queue.Empty:
+                    self._m_timeouts.inc()
                     raise TimeoutError(
                         "decode engine produced no chunk in time"
                     ) from None
@@ -979,25 +1078,26 @@ class DecodeEngine:
         return len(self.buckets) + 1
 
     def stats(self) -> dict:
-        """Serving observability: request timing splits + slot occupancy."""
-        from unionml_tpu.serving._stats import percentile_summary
+        """Serving observability: request timing splits + slot occupancy.
 
-        with self._lock:
-            done = list(self._completed)
-            total = self._completed_total
-            steps, chunks = self._steps, self._chunks
-            occupied = self._occupied_slot_steps
-            spec_rounds, spec_accepted = self._spec_rounds, self._spec_accepted
+        A thin view over this instance's telemetry-registry series (the
+        same numbers ``GET /metrics`` exposes) keeping the historical
+        key shape; percentiles come from the histograms' exact sample
+        windows, not bucket interpolation."""
+        steps = int(self._m_steps.value)
+        occupied = int(self._m_occupied.value)
         out = {
             "engine": "continuous",
             "slots": self.slots,
             "chunk_steps": self.chunk_steps,
             "pipeline_depth": self.pipeline_depth,
-            "completed_requests": total,
+            "completed_requests": int(self._m_requests.value),
             "decode_steps": steps,
             "slot_occupancy": round(occupied / max(1, steps * self.slots), 3),
         }
         if self.draft is not None:
+            spec_rounds = int(self._m_spec_rounds.value)
+            spec_accepted = int(self._m_spec_accepted.value)
             out["speculative"] = {
                 "k": self.speculate_k,
                 "rounds": spec_rounds,
@@ -1007,23 +1107,29 @@ class DecodeEngine:
                     spec_accepted / max(1, spec_rounds * self.speculate_k), 3
                 ),
             }
-        if done:
-            names = ("queue_wait_ms", "prefill_ms", "decode_ms", "ttft_ms")
-            for i, name in enumerate(names):
-                out[name] = percentile_summary([rec[i] for rec in done])
+        for name, h in (
+            ("queue_wait_ms", self._h_queue),
+            ("prefill_ms", self._h_prefill),
+            ("decode_ms", self._h_decode),
+            ("ttft_ms", self._h_ttft),
+        ):
+            summary = h.summary()
+            if summary:
+                out[name] = summary
         return out
 
     def reset_stats(self) -> None:
-        """Zero the observability aggregates (benchmarks call this between
-        scenarios so each phase's /stats describes only that phase)."""
-        with self._lock:
-            self._completed.clear()
-            self._completed_total = 0
-            self._steps = 0
-            self._chunks = 0
-            self._occupied_slot_steps = 0
-            self._spec_rounds = 0
-            self._spec_accepted = 0
+        """Zero this instance's observability series (benchmarks call
+        this between scenarios so each phase's /stats describes only
+        that phase); scrapers see the resets as counter restarts."""
+        for m in (
+            self._m_requests, self._m_errors, self._m_abandoned,
+            self._m_timeouts, self._m_steps, self._m_chunks,
+            self._m_occupied, self._m_spec_rounds, self._m_spec_accepted,
+            self._h_queue, self._h_prefill, self._h_decode, self._h_ttft,
+            self._h_dispatch, self._h_harvest,
+        ):
+            m.reset()
 
     def close(self):
         self._stop.set()
@@ -1039,14 +1145,17 @@ class DecodeEngine:
             except queue.Empty:
                 break
             req.error = RuntimeError("decode engine closed")
+            self._tracer.finish_request(req.rid)
             req.event.set()
             req.finish_stream()
         for req in self._occupant:
             if req is not None:
                 req.error = RuntimeError("decode engine closed")
+                self._tracer.finish_request(req.rid)
                 req.event.set()
                 req.finish_stream()
         self._occupant = [None] * self.slots
+        self._m_slots_busy.set(0)
 
     # ------------------------------------------------------------------ #
     # engine loop
@@ -1071,6 +1180,7 @@ class DecodeEngine:
         t0 = time.perf_counter()
         req.queue_wait_ms = (t0 - req.submitted) * 1e3
         req._dispatch_t = t0
+        self._tracer.record_span(req.rid, "queue", req.submitted, t0)
         bucket = self._bucket_for(len(req.prompt))
         padded = np.full(bucket, self.pad_id, np.int32)
         padded[: len(req.prompt)] = req.prompt
@@ -1093,6 +1203,7 @@ class DecodeEngine:
             self._occupant[slot] = req
             self._slot_gen[slot] += 1
             req._expected = 1
+            self._m_slots_busy.set(self._slots_in_use_locked())
         self._inflight.put(("prefill", slot, req, first))
 
     def _req_done(self, req: _Request, tok: int) -> bool:
@@ -1112,16 +1223,20 @@ class DecodeEngine:
             return True
         done = self._req_done(req, tok)
         if done:
-            req.decode_ms = (time.perf_counter() - req._prefill_end) * 1e3
+            now = time.perf_counter()
+            req.decode_ms = (now - req._prefill_end) * 1e3
             if not req.abandoned:
-                self._completed.append(
-                    (req.queue_wait_ms, req.prefill_ms, req.decode_ms,
-                     req.ttft_ms)
-                )
-                self._completed_total += 1
-                if len(self._completed) > 10_000:
-                    del self._completed[:5_000]
+                self._h_queue.observe(req.queue_wait_ms)
+                self._h_prefill.observe(req.prefill_ms)
+                self._h_decode.observe(req.decode_ms)
+                self._h_ttft.observe(req.ttft_ms)
+                self._m_requests.inc()
+            else:
+                self._m_abandoned.inc()
             self._occupant[slot] = None
+            self._m_slots_busy.set(self._slots_in_use_locked())
+            self._tracer.record_span(req.rid, "harvest", self._harvest_t0, now)
+            self._tracer.finish_request(req.rid)
             req.event.set()
             req.finish_stream()
         return done
@@ -1131,6 +1246,7 @@ class DecodeEngine:
         ``np.asarray`` happened outside the lock; entries arrive in
         dispatch order, so a slot's prefill token always lands before its
         decode tokens and before any reuse of the slot."""
+        self._harvest_t0 = time.perf_counter()
         if entry[0] == "prefill":
             _, slot, req, first = entry
             tok = int(np.asarray(first))
@@ -1139,15 +1255,20 @@ class DecodeEngine:
                 req.prefill_ms = (now - req._dispatch_t) * 1e3
                 req.ttft_ms = (now - req.submitted) * 1e3
                 req._prefill_end = now
+                self._tracer.record_span(
+                    req.rid, "prefill", req._dispatch_t, now
+                )
                 req.tokens.append(tok)
                 req.emit([tok])
                 self._finish_if_done(slot, tok)
             return
-        _, mask, gens, toks = entry
+        _, mask, gens, toks, dispatched = entry
         if self.draft is not None:
-            self._process_spec_chunk(mask, gens, toks)
+            self._process_spec_chunk(mask, gens, toks, dispatched)
             return
         toks = np.asarray(toks)
+        now = time.perf_counter()  # readback complete: the chunk landed
+        self._h_harvest.observe((now - self._harvest_t0) * 1e3)
         with self._lock:
             # slot-major (steps for different slots are independent): each
             # request's harvested tokens form ONE streamed chunk, emitted
@@ -1164,15 +1285,22 @@ class DecodeEngine:
                     chunk.append(tok)
                     if self._req_done(req, tok):
                         break
+                self._tracer.record_span(
+                    req.rid, f"decode-chunk[{req._chunk_i}]", dispatched, now,
+                    tokens=len(chunk),
+                )
+                req._chunk_i += 1
                 req.emit(chunk)
                 self._finish_if_done(slot, chunk[-1])
 
-    def _process_spec_chunk(self, mask, gens, outs) -> None:
+    def _process_spec_chunk(self, mask, gens, outs, dispatched) -> None:
         """Account one speculative chunk's readback: per round, each slot
         contributed ``n_emit`` tokens (variable — acceptance-dependent)
         from its ``emit`` row; budget truncation happens here exactly
         like the plain path's per-token ``_req_done`` walk."""
         emit, n_emit, accepted = (np.asarray(x) for x in outs)
+        now = time.perf_counter()  # after np.asarray: readback complete
+        self._h_harvest.observe((now - self._harvest_t0) * 1e3)
         with self._lock:
             for slot in np.flatnonzero(mask):
                 req = self._occupant[slot]
@@ -1187,8 +1315,8 @@ class DecodeEngine:
                         # before the budget break) — stale-generation and
                         # post-retirement overshoot rounds would skew the
                         # /stats acceptance_rate the benches report
-                        self._spec_rounds += 1
-                        self._spec_accepted += int(accepted[r, slot])
+                        self._m_spec_rounds.inc()
+                        self._m_spec_accepted.inc(int(accepted[r, slot]))
                     for i in range(int(n_emit[r, slot])):
                         tok = int(emit[r, slot, i])
                         req.tokens.append(tok)
@@ -1198,6 +1326,11 @@ class DecodeEngine:
                             break
                     if finished:
                         break
+                self._tracer.record_span(
+                    req.rid, f"decode-chunk[{req._chunk_i}]", dispatched, now,
+                    tokens=len(chunk),
+                )
+                req._chunk_i += 1
                 req.emit(chunk)
                 if chunk:
                     self._finish_if_done(slot, chunk[-1])
@@ -1224,6 +1357,7 @@ class DecodeEngine:
             return False
         if not self._chunk_credits.acquire(blocking=False):
             return False  # pipeline_depth chunks already awaiting harvest
+        t_dispatch = time.perf_counter()
         try:
             keys = jnp.stack(self._next_key(self.chunk_steps))
             self._state, toks = self._decode_chunk(
@@ -1231,6 +1365,7 @@ class DecodeEngine:
             )
             for leaf in toks if isinstance(toks, tuple) else (toks,):
                 _start_host_copy(leaf)
+            self._h_dispatch.observe((time.perf_counter() - t_dispatch) * 1e3)
         except BaseException:
             # the credit is only released by the harvester for entries that
             # were actually enqueued — give it back or the pipeline wedges
@@ -1248,10 +1383,10 @@ class DecodeEngine:
                     # done mask + spare rows like any overshoot
                     self._occupant[slot]._expected += self.chunk_steps
             gens = tuple(self._slot_gen)
-            self._chunks += 1
-            self._steps += self.chunk_steps
-            self._occupied_slot_steps += int(mask.sum()) * self.chunk_steps
-        self._inflight.put(("chunk", mask, gens, toks))
+            self._m_chunks.inc()
+            self._m_steps.inc(self.chunk_steps)
+            self._m_occupied.inc(int(mask.sum()) * self.chunk_steps)
+        self._inflight.put(("chunk", mask, gens, toks, t_dispatch))
         return True
 
     def _pop_request(self) -> Optional[_Request]:
@@ -1278,6 +1413,8 @@ class DecodeEngine:
                 return
             req.error = exc
             self._admitting -= 1
+        (self._m_abandoned if req.abandoned else self._m_errors).inc()
+        self._tracer.finish_request(req.rid)
         req.event.set()
         req.finish_stream()
 
@@ -1366,6 +1503,7 @@ class DecodeEngine:
                 self._slot_gen[adm.slot] += 1
                 req._expected = 1
                 self._admitting -= 1
+                self._m_slots_busy.set(self._slots_in_use_locked())
             self._inflight.put(("prefill", adm.slot, req, first))
         except BaseException as exc:
             with self._lock:
@@ -1431,8 +1569,11 @@ class DecodeEngine:
             for slot, req in enumerate(self._occupant):
                 if req is not None:
                     req.error = exc
+                    self._m_errors.inc()
+                    self._tracer.finish_request(req.rid)
                     req.event.set()
                     req.finish_stream()
                     self._occupant[slot] = None
+            self._m_slots_busy.set(0)
         self._state = None
         self._prefix_rows = None

@@ -9,8 +9,10 @@ or pass ``app=None`` to ``Model.serve``.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Optional
 
+from unionml_tpu import telemetry
 from unionml_tpu.serving.http import ServingApp
 
 
@@ -91,6 +93,40 @@ def serving_app(
     @app.get("/stats")
     async def stats():  # no reference counterpart: latency attribution
         return core.stats()
+
+    @app.get("/metrics")
+    async def metrics():  # Prometheus scrape (same body as the stdlib app)
+        from fastapi.responses import Response
+
+        return Response(
+            core.metrics_text(),
+            media_type=telemetry.EXPOSITION_CONTENT_TYPE,
+        )
+
+    # one middleware gives every route the X-Request-ID header and the
+    # per-endpoint request/error/latency series, through the SAME
+    # ServingApp.observe_request the stdlib transport uses
+    @app.middleware("http")
+    async def telemetry_middleware(request, call_next):
+        rid = telemetry.new_request_id()
+        t0 = time.perf_counter()
+        try:
+            response = await call_next(request)
+        except BaseException:
+            # an unhandled endpoint error becomes a 500 OUTSIDE this
+            # middleware — record it or error traffic is invisible in
+            # /metrics (the stdlib transport records it via try/finally)
+            core.observe_request(
+                "fastapi", request.url.path, 500,
+                (time.perf_counter() - t0) * 1e3,
+            )
+            raise
+        response.headers["X-Request-ID"] = rid
+        core.observe_request(
+            "fastapi", request.url.path, response.status_code,
+            (time.perf_counter() - t0) * 1e3,
+        )
+        return response
 
     app.state.unionml_tpu = core
     return app

@@ -19,7 +19,14 @@ Same endpoint surface as the reference's FastAPI app
   prefill / decode (or device) time splits — plus a ``ttft_ms``
   percentile from the engine — from the active batcher or decode engine
   (no reference counterpart — needed to attribute tail latency between
-  transport queueing and device time).
+  transport queueing and device time),
+- ``GET /metrics`` — Prometheus text exposition of the shared
+  :mod:`unionml_tpu.telemetry` registry (engine, batcher, HTTP-layer,
+  and trainer series in one scrape surface).
+
+Every response carries an ``X-Request-ID`` header (a generated
+telemetry request id) and lands in the per-endpoint
+``unionml_http_requests_total`` / ``unionml_http_request_ms`` series.
 
 Startup model loading mirrors fastapi.py:22-34: ``UNIONML_MODEL_PATH``
 env first, then the remote registry when ``remote=True``.
@@ -31,12 +38,18 @@ import itertools
 import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
 import numpy as np
 
+from unionml_tpu import telemetry
 from unionml_tpu._logging import logger
+
+# bound HTTP label cardinality: unknown paths share one series instead
+# of letting a scanner mint a metric per probed URL
+KNOWN_ROUTES = ("/", "/predict", "/predict/stream", "/health", "/stats", "/metrics")
 
 LANDING_HTML = """<html><head><title>unionml-tpu</title></head>
 <body><h1>unionml-tpu serving: {name}</h1>
@@ -77,6 +90,7 @@ class ServingApp:
         stats: Optional[Any] = None,
         stream: Optional[Any] = None,
         extra_stats: Optional[dict] = None,
+        registry: Optional[telemetry.MetricsRegistry] = None,
         **batcher_kwargs,
     ):
         """``warmup``: optional callable invoked with the loaded model
@@ -99,7 +113,12 @@ class ServingApp:
         ``extra_stats``: optional static dict merged into every
         ``GET /stats`` response (deployment metadata — e.g. the
         serving-mode auto-selection decision from
-        :func:`unionml_tpu.serving.auto.choose_serving_mode`)."""
+        :func:`unionml_tpu.serving.auto.choose_serving_mode`).
+
+        ``registry``: explicit :class:`~unionml_tpu.telemetry
+        .MetricsRegistry` served at ``GET /metrics``; defaults to the
+        process-global registry, so an engine or trainer built anywhere
+        in the process shows up in this app's scrape."""
         self.model = model
         self.remote = remote
         self.app_version = app_version
@@ -113,6 +132,22 @@ class ServingApp:
         self._batcher = None
         self._batcher_kwargs = batcher_kwargs
         self._server: Optional[ThreadingHTTPServer] = None
+        self.registry = registry if registry is not None else telemetry.get_registry()
+        self._m_http_requests = self.registry.counter(
+            "unionml_http_requests_total",
+            "HTTP requests served, by transport/path/status.",
+            ("transport", "path", "status"),
+        )
+        self._m_http_errors = self.registry.counter(
+            "unionml_http_errors_total",
+            "HTTP responses with status >= 400, by transport/path.",
+            ("transport", "path"),
+        )
+        self._h_http_ms = self.registry.histogram(
+            "unionml_http_request_ms",
+            "Request wall time at the transport boundary.",
+            ("transport", "path"),
+        )
 
     # -- lifecycle --------------------------------------------------------
 
@@ -142,7 +177,10 @@ class ServingApp:
 
                 predictor = jit_predictor(predictor)
             self._batcher = MicroBatcher(
-                lambda feats: predictor(model_object, feats), **self._batcher_kwargs
+                lambda feats: predictor(model_object, feats),
+                # the app's scrape must cover its own batcher even when
+                # the app was built with an isolated registry
+                **{"registry": self.registry, **self._batcher_kwargs},
             )
         if self.warmup is not None:
             n = self.warmup(self.model.artifact.model_object)
@@ -170,6 +208,23 @@ class ServingApp:
         custom-stats serving — reset the custom source directly)."""
         if self._batcher is not None:
             self._batcher.reset_stats()
+
+    def metrics_text(self) -> str:
+        """The ``GET /metrics`` body: Prometheus text exposition of the
+        app's registry (shared by both transports so they cannot drift).
+        Serve with ``telemetry.EXPOSITION_CONTENT_TYPE``."""
+        return self.registry.exposition()
+
+    def observe_request(
+        self, transport: str, path: str, status: int, duration_ms: float
+    ) -> None:
+        """Record one transport-boundary request in the shared registry
+        (both transports call this so the series are comparable)."""
+        route = path if path in KNOWN_ROUTES else "<other>"
+        self._m_http_requests.labels(transport, route, str(status)).inc()
+        if status >= 400:
+            self._m_http_errors.labels(transport, route).inc()
+        self._h_http_ms.labels(transport, route).observe(duration_ms)
 
     def predict(self, payload: dict) -> Any:
         if self.model.artifact is None:
@@ -249,6 +304,10 @@ class ServingApp:
         app = self
 
         class Handler(BaseHTTPRequestHandler):
+            # per-request telemetry, set by the do_* wrappers
+            _rid = ""
+            _status = 0
+
             def log_message(self, fmt, *args):
                 logger.info(f"http: {fmt % args}")
 
@@ -256,19 +315,46 @@ class ServingApp:
                 data = (
                     body.encode() if isinstance(body, str) else json.dumps(body).encode()
                 )
+                self._status = code
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
+                self.send_header("X-Request-ID", self._rid)
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _observed(self, handler):
+                """Wrap one request: mint the X-Request-ID, time the
+                dispatch, land the per-endpoint series."""
+                self._rid = telemetry.new_request_id()
+                self._status = 0
+                t0 = time.perf_counter()
+                try:
+                    handler()
+                finally:
+                    app.observe_request(
+                        "stdlib", self.path, self._status or 500,
+                        (time.perf_counter() - t0) * 1e3,
+                    )
+
             def do_GET(self):
+                self._observed(self._get)
+
+            def do_POST(self):
+                self._observed(self._post)
+
+            def _get(self):
                 if self.path == "/":
                     self._send(200, app.root(), content_type="text/html")
                 elif self.path == "/health":
                     self._send(200, app.health())
                 elif self.path == "/stats":
                     self._send(200, app.stats())
+                elif self.path == "/metrics":
+                    self._send(
+                        200, app.metrics_text(),
+                        content_type=telemetry.EXPOSITION_CONTENT_TYPE,
+                    )
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 
@@ -279,10 +365,12 @@ class ServingApp:
                 the 200 is committed, a mid-stream failure can only
                 surface as a dropped connection — the SSE contract —
                 never as a second response spliced into the body."""
+                self._status = 200
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
+                self.send_header("X-Request-ID", self._rid)
                 self.end_headers()
                 try:
                     for frame in frames:
@@ -296,7 +384,7 @@ class ServingApp:
                 finally:
                     self.close_connection = True
 
-            def do_POST(self):
+            def _post(self):
                 if self.path not in ("/predict", "/predict/stream"):
                     self._send(404, {"error": f"no route {self.path}"})
                     return

@@ -1,0 +1,650 @@
+"""Unified telemetry: metrics registry, Prometheus exposition, trace spans.
+
+The reference has **no observability subsystem** (SURVEY.md §5.1 "Tracing
+/ profiling — ABSENT", §5.5 "No Prometheus/OTel"), and this rebuild had
+four mutually-incompatible private accounting schemes: the decode
+engine's ``_completed`` tuples, the micro-batcher's ``_done`` list,
+:class:`~unionml_tpu.diagnostics.StepTimer`, and free-form
+``logger.info`` strings. This module is the single spine that replaces
+them:
+
+- :class:`MetricsRegistry` — a dependency-free, thread-safe registry of
+  **Counter / Gauge / Histogram** families with label sets. Histograms
+  use fixed log-spaced ms buckets (:data:`DEFAULT_MS_BUCKETS`) so
+  percentile math is mergeable across threads and scrapers, plus a
+  bounded raw-sample window so the existing ``stats()`` percentile
+  summaries stay exact rather than bucket-approximated.
+- ``registry.exposition()`` — Prometheus text exposition format 0.0.4,
+  served at ``GET /metrics`` by both HTTP transports
+  (:mod:`unionml_tpu.serving.http` and :mod:`unionml_tpu.serving.fastapi`).
+- :class:`TraceRecorder` — per-request trace spans on the monotonic
+  clock (``queue → prefill → decode-chunk[i] → harvest`` in the decode
+  engine), keyed by a generated request id, exportable as Chrome
+  trace-event JSON (loads in Perfetto / ``chrome://tracing``) and as
+  structured JSON lines.
+
+Process-global defaults (:func:`get_registry`, :func:`get_tracer`) make
+independently-constructed components (an engine built outside the
+``ServingApp``, a trainer loop in the same process) land in the one
+scrape surface; pass explicit instances for isolation. Everything here
+is stdlib-only and safe to import before jax.
+"""
+
+from __future__ import annotations
+
+import bisect
+import itertools
+import json
+import math
+import re
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "DEFAULT_MS_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "TraceRecorder",
+    "get_registry",
+    "get_tracer",
+    "instance_label",
+    "new_request_id",
+]
+
+# log-spaced ms buckets (1 / 2.5 / 5 per decade, 100 µs .. 1 min): wide
+# enough for a fused decode step (~2 ms) and a cold XLA compile (~20 s)
+# in the same family, few enough that per-observation cost is one bisect
+DEFAULT_MS_BUCKETS: Tuple[float, ...] = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+    250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0,
+)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+_instance_counters: Dict[str, "itertools.count"] = {}
+_instance_lock = threading.Lock()
+
+
+def new_request_id() -> str:
+    """A 16-hex-char request id (the ``X-Request-ID`` / trace key)."""
+    return uuid.uuid4().hex[:16]
+
+
+def instance_label(prefix: str) -> str:
+    """Process-unique label value for one component instance
+    (``engine-0``, ``batcher-3``, ...): keeps every instance's series
+    separate in the shared registry without unbounded cardinality."""
+    with _instance_lock:
+        counter = _instance_counters.setdefault(prefix, itertools.count())
+        return f"{prefix}-{next(counter)}"
+
+
+def _escape_label_value(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(value: float) -> str:
+    if value != value:  # NaN
+        return "NaN"
+    if value in (float("inf"), float("-inf")):
+        return "+Inf" if value > 0 else "-Inf"
+    if float(value).is_integer() and abs(value) < 1e15:
+        return str(int(value))
+    return repr(float(value))
+
+
+def _label_pairs(labelnames: Sequence[str], values: Sequence[str]) -> str:
+    if not labelnames:
+        return ""
+    inner = ",".join(
+        f'{n}="{_escape_label_value(v)}"' for n, v in zip(labelnames, values)
+    )
+    return "{" + inner + "}"
+
+
+class _Child:
+    """One labeled series of a family; shares the family lock."""
+
+    def __init__(self, family: "_Family", values: Tuple[str, ...]):
+        self._family = family
+        self._lock = family._lock
+        self._values = values
+
+
+class Counter(_Child):
+    """Monotonic counter. ``reset()`` exists for windowed ``stats()``
+    views (benchmarks zero the window between scenarios); Prometheus
+    scrapers tolerate resets as counter restarts."""
+
+    def __init__(self, family: "_Family", values: Tuple[str, ...]):
+        super().__init__(family, values)
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counters only go up (inc({amount}))")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+
+class Gauge(_Child):
+    """Settable value; ``set_function`` registers a callable sampled at
+    read time (for values owned elsewhere, e.g. queue depth)."""
+
+    def __init__(self, family: "_Family", values: Tuple[str, ...]):
+        super().__init__(family, values)
+        self._value = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._fn = None
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        with self._lock:
+            self._fn = fn
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            fn = self._fn
+            if fn is None:
+                return self._value
+        try:  # sampled outside the lock: user callables may be slow
+            return float(fn())
+        except Exception:
+            return 0.0
+
+    def reset(self) -> None:
+        with self._lock:
+            self._fn = None
+            self._value = 0.0
+
+
+class Histogram(_Child):
+    """Bucketed distribution + a bounded raw-sample window.
+
+    The buckets feed the mergeable Prometheus exposition; the window
+    (capped like the accounting lists it replaces: 10k samples, trimmed
+    to the newest 5k) feeds :meth:`summary`'s exact percentiles so
+    ``stats()`` output keeps its historical meaning.
+    """
+
+    WINDOW_CAP = 10_000
+
+    def __init__(self, family: "_Family", values: Tuple[str, ...]):
+        super().__init__(family, values)
+        self._bounds = family._buckets
+        self._counts = [0] * (len(self._bounds) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._window: List[float] = []
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        idx = bisect.bisect_left(self._bounds, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+            self._window.append(value)
+            if len(self._window) > self.WINDOW_CAP:
+                del self._window[: self.WINDOW_CAP // 2]
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def buckets(self) -> List[Tuple[float, int]]:
+        """Cumulative ``(upper_bound, count)`` pairs, ``+Inf`` last."""
+        with self._lock:
+            counts = list(self._counts)
+        out, running = [], 0
+        for bound, n in zip(self._bounds + (float("inf"),), counts):
+            running += n
+            out.append((bound, running))
+        return out
+
+    def summary(self) -> dict:
+        """Exact ``percentile_summary`` of the retained window (the
+        ``stats()`` view); ``{}`` when nothing was observed."""
+        with self._lock:
+            window = list(self._window)
+        if not window:
+            return {}
+        from unionml_tpu.serving._stats import percentile_summary
+
+        return percentile_summary(window)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self._bounds) + 1)
+            self._sum = 0.0
+            self._count = 0
+            self._window.clear()
+
+
+class _Family:
+    """A named metric with a fixed label schema and per-labelset children."""
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labelnames: Tuple[str, ...],
+        kind: str,
+        child_cls: type,
+        buckets: Optional[Tuple[float, ...]] = None,
+    ):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        for label in labelnames:
+            if not _LABEL_RE.match(label) or label.startswith("__"):
+                raise ValueError(f"invalid label name {label!r}")
+        self.name = name
+        self.help = help
+        self.labelnames = labelnames
+        self.kind = kind
+        self._child_cls = child_cls
+        self._buckets = buckets
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], Any] = {}
+        self._default: Optional[Any] = None
+        if not labelnames:
+            self._default = self.labels()
+
+    def labels(self, *values: str, **kwargs: str):
+        if kwargs:
+            if values:
+                raise ValueError("pass label values positionally OR by name")
+            try:
+                values = tuple(str(kwargs[n]) for n in self.labelnames)
+            except KeyError as exc:
+                raise ValueError(
+                    f"{self.name} needs labels {self.labelnames}, got "
+                    f"{sorted(kwargs)}"
+                ) from exc
+            if len(kwargs) != len(self.labelnames):
+                raise ValueError(
+                    f"{self.name} needs labels {self.labelnames}, got "
+                    f"{sorted(kwargs)}"
+                )
+        else:
+            values = tuple(str(v) for v in values)
+            if len(values) != len(self.labelnames):
+                raise ValueError(
+                    f"{self.name} takes {len(self.labelnames)} label "
+                    f"value(s) {self.labelnames}, got {len(values)}"
+                )
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._child_cls(self, values)
+                self._children[values] = child
+        return child
+
+    # unlabeled families proxy straight to their single child, so
+    # `registry.counter("x", "...").inc()` needs no `.labels()` hop
+    def __getattr__(self, attr: str):
+        if attr.startswith("_"):  # dunder/private lookups must not recurse
+            raise AttributeError(attr)
+        default = self.__dict__.get("_default")
+        if default is not None:
+            return getattr(default, attr)
+        raise AttributeError(
+            f"{self.name} has labels {self.labelnames} — call .labels(...) "
+            f"before .{attr}"
+        )
+
+    def children(self) -> List[Tuple[Tuple[str, ...], Any]]:
+        with self._lock:
+            return list(self._children.items())
+
+    def reset(self) -> None:
+        for _, child in self.children():
+            child.reset()
+
+    def render(self) -> Iterator[str]:
+        yield f"# HELP {self.name} {_escape_help(self.help)}"
+        yield f"# TYPE {self.name} {self.kind}"
+        for values, child in sorted(self.children()):
+            labels = _label_pairs(self.labelnames, values)
+            if self.kind == "histogram":
+                for bound, cum in child.buckets():
+                    le = "+Inf" if math.isinf(bound) else _fmt(bound)
+                    pairs = _label_pairs(
+                        self.labelnames + ("le",), values + (le,)
+                    )
+                    yield f"{self.name}_bucket{pairs} {cum}"
+                yield f"{self.name}_sum{labels} {_fmt(child.sum)}"
+                yield f"{self.name}_count{labels} {child.count}"
+            else:
+                yield f"{self.name}{labels} {_fmt(child.value)}"
+
+
+class MetricsRegistry:
+    """Thread-safe get-or-create registry of metric families.
+
+    Re-requesting a family with the same name returns the existing one
+    (components built at different times share series); a name re-used
+    with a different type or label schema raises — silent merging would
+    corrupt the exposition.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    def _get_or_create(
+        self,
+        name: str,
+        help: str,
+        labelnames: Sequence[str],
+        kind: str,
+        child_cls: type,
+        buckets: Optional[Tuple[float, ...]] = None,
+    ) -> _Family:
+        labelnames = tuple(labelnames)
+        with self._lock:
+            family = self._families.get(name)
+            if family is not None:
+                if family.kind != kind or family.labelnames != labelnames:
+                    raise ValueError(
+                        f"metric {name} already registered as {family.kind}"
+                        f"{family.labelnames}, requested {kind}{labelnames}"
+                    )
+                return family
+            family = _Family(name, help, labelnames, kind, child_cls, buckets)
+            self._families[name] = family
+            return family
+
+    def counter(
+        self, name: str, help: str = "", labelnames: Sequence[str] = ()
+    ) -> _Family:
+        return self._get_or_create(name, help, labelnames, "counter", Counter)
+
+    def gauge(
+        self, name: str, help: str = "", labelnames: Sequence[str] = ()
+    ) -> _Family:
+        return self._get_or_create(name, help, labelnames, "gauge", Gauge)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        labelnames: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_MS_BUCKETS,
+    ) -> _Family:
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        family = self._get_or_create(
+            name, help, labelnames, "histogram", Histogram, bounds
+        )
+        if family._buckets != bounds:
+            raise ValueError(
+                f"metric {name} already registered with buckets "
+                f"{family._buckets}, requested {bounds}"
+            )
+        return family
+
+    def collect(self) -> List[_Family]:
+        with self._lock:
+            return list(self._families.values())
+
+    def exposition(self) -> str:
+        """Prometheus text exposition format 0.0.4 (the ``GET /metrics``
+        body; serve with content type :data:`EXPOSITION_CONTENT_TYPE`)."""
+        lines: List[str] = []
+        for family in sorted(self.collect(), key=lambda f: f.name):
+            lines.extend(family.render())
+        return "\n".join(lines) + "\n" if lines else ""
+
+    def snapshot(self) -> dict:
+        """``{name: {labelset_repr: value_or_histogram_dict}}`` — the
+        debug/test view (scrapers should use :meth:`exposition`)."""
+        out: dict = {}
+        for family in self.collect():
+            series = {}
+            for values, child in family.children():
+                key = ",".join(
+                    f"{n}={v}" for n, v in zip(family.labelnames, values)
+                )
+                if family.kind == "histogram":
+                    series[key] = {
+                        "count": child.count,
+                        "sum": child.sum,
+                        "buckets": child.buckets(),
+                    }
+                else:
+                    series[key] = child.value
+            out[family.name] = series
+        return out
+
+    def reset(self) -> None:
+        for family in self.collect():
+            family.reset()
+
+
+EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+# --------------------------------------------------------------------- #
+# trace spans
+# --------------------------------------------------------------------- #
+
+
+class TraceRecorder:
+    """Per-request trace spans on the monotonic clock.
+
+    ``new_request()`` issues a generated request id; spans attach to it
+    via :meth:`record_span` (explicit start/end, for producer/consumer
+    pipelines where one thread dispatches and another harvests) or the
+    :meth:`span` context manager. ``finish_request`` moves the request
+    to a bounded completed ring (newest ``max_requests`` kept).
+
+    Exports:
+
+    - :meth:`export_chrome` — Chrome trace-event JSON (``ph: "X"``
+      complete events, µs timestamps), loads in Perfetto and
+      ``chrome://tracing``; one virtual thread row per request.
+    - :meth:`export_jsonl` — one JSON object per span per line, for
+      log shippers.
+    """
+
+    MAX_SPANS_PER_REQUEST = 4096
+
+    def __init__(self, max_requests: int = 1024):
+        self.max_requests = max_requests
+        self._lock = threading.Lock()
+        self._live: Dict[str, List[dict]] = {}
+        self._meta: Dict[str, dict] = {}
+        self._done: List[Tuple[str, dict, List[dict]]] = []
+        self._tids: Dict[str, int] = {}
+        self._next_tid = itertools.count(1)
+
+    def new_request(self, kind: str = "request", **meta: Any) -> str:
+        rid = new_request_id()
+        with self._lock:
+            self._live[rid] = []
+            self._meta[rid] = {"kind": kind, **meta}
+            self._tids[rid] = next(self._next_tid)
+        return rid
+
+    def record_span(
+        self,
+        rid: str,
+        name: str,
+        start_s: float,
+        end_s: float,
+        **args: Any,
+    ) -> None:
+        """Attach one completed span (``time.perf_counter()`` seconds).
+        Unknown/finished rids are ignored — a late harvest for an
+        already-exported request must not KeyError the engine."""
+        span = {
+            "name": name,
+            "start_s": float(start_s),
+            "end_s": float(end_s),
+        }
+        if args:
+            span["args"] = args
+        with self._lock:
+            spans = self._live.get(rid)
+            if spans is None or len(spans) >= self.MAX_SPANS_PER_REQUEST:
+                return
+            spans.append(span)
+
+    def span(self, rid: str, name: str, **args: Any):
+        """Context manager measuring one span around its body."""
+        return _SpanContext(self, rid, name, args)
+
+    def finish_request(self, rid: str) -> None:
+        with self._lock:
+            spans = self._live.pop(rid, None)
+            meta = self._meta.pop(rid, {"kind": "request"})
+            if spans is None:
+                return
+            self._done.append((rid, meta, spans))
+            if len(self._done) > self.max_requests:
+                dropped = self._done[: -self.max_requests]
+                del self._done[: -self.max_requests]
+                for old_rid, _, _ in dropped:
+                    self._tids.pop(old_rid, None)
+
+    def _all_requests(self) -> List[Tuple[str, dict, List[dict]]]:
+        with self._lock:
+            out = list(self._done)
+            out.extend(
+                (rid, self._meta.get(rid, {}), list(spans))
+                for rid, spans in self._live.items()
+            )
+            return out
+
+    def export_chrome(self) -> dict:
+        """``{"traceEvents": [...], "displayTimeUnit": "ms"}`` — drop
+        the JSON in Perfetto / ``chrome://tracing``. Timestamps are µs
+        on the process-local monotonic clock (offsets are meaningful,
+        absolute values are not)."""
+        events: List[dict] = []
+        with self._lock:
+            tids = dict(self._tids)
+        for rid, meta, spans in self._all_requests():
+            tid = tids.get(rid, 0)
+            for span in spans:
+                event = {
+                    "name": span["name"],
+                    "cat": meta.get("kind", "request"),
+                    "ph": "X",
+                    "ts": round(span["start_s"] * 1e6, 3),
+                    "dur": round((span["end_s"] - span["start_s"]) * 1e6, 3),
+                    "pid": 0,
+                    "tid": tid,
+                    "args": {"request_id": rid, **span.get("args", {})},
+                }
+                events.append(event)
+            events.append({
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 0,
+                "tid": tid,
+                "args": {"name": f"{meta.get('kind', 'request')} {rid}"},
+            })
+        events.sort(key=lambda e: e.get("ts", 0.0))
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_jsonl(self) -> str:
+        """One span per line: ``{"request_id", "name", "start_ms",
+        "duration_ms", ...}`` (monotonic-clock ms)."""
+        lines = []
+        for rid, meta, spans in self._all_requests():
+            for span in spans:
+                record = {
+                    "request_id": rid,
+                    "kind": meta.get("kind", "request"),
+                    "name": span["name"],
+                    "start_ms": round(span["start_s"] * 1e3, 3),
+                    "duration_ms": round(
+                        (span["end_s"] - span["start_s"]) * 1e3, 3
+                    ),
+                }
+                record.update(span.get("args", {}))
+                lines.append(json.dumps(record))
+        return "\n".join(lines) + "\n" if lines else ""
+
+    def reset(self) -> None:
+        with self._lock:
+            self._live.clear()
+            self._meta.clear()
+            self._done.clear()
+            self._tids.clear()
+
+
+class _SpanContext:
+    def __init__(self, recorder: TraceRecorder, rid: str, name: str, args: dict):
+        self._recorder = recorder
+        self._rid = rid
+        self._name = name
+        self._args = args
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_SpanContext":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._recorder.record_span(
+            self._rid, self._name, self._t0, time.perf_counter(), **self._args
+        )
+
+
+# --------------------------------------------------------------------- #
+# process-global defaults
+# --------------------------------------------------------------------- #
+
+_REGISTRY = MetricsRegistry()
+_TRACER = TraceRecorder()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global default registry (what ``GET /metrics`` serves
+    unless a component was built with an explicit one)."""
+    return _REGISTRY
+
+
+def get_tracer() -> TraceRecorder:
+    """The process-global default trace recorder."""
+    return _TRACER
