@@ -37,6 +37,7 @@ NAV = [
     ("Model", "model.md"),
     ("Parallelism", "parallelism.md"),
     ("Serving", "serving.md"),
+    ("Prefix caching", "prefix_caching.md"),
     ("Observability", "observability.md"),
     ("Checkpoints", "checkpoints.md"),
     ("Remote deployment", "remote.md"),

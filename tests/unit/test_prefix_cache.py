@@ -1,0 +1,476 @@
+"""Automatic prefix KV-cache tests.
+
+Two layers under test:
+
+- :class:`unionml_tpu.serving.prefix_cache.RadixPrefixCache` alone — a pure
+  host structure (radix tree + byte-budgeted LRU store), exercised with
+  fabricated KV trees: match/insert, eviction order, pinned and leased
+  survival, and concurrent lookup/insert safety.
+- the :class:`~unionml_tpu.serving.engine.DecodeEngine` integration —
+  the contract that matters: cold, warm-hit, and partial-hit
+  generations are TOKEN-IDENTICAL to the cache-off engine / solo
+  generator, a warm admission skips the shared prefix's prefill
+  programs (asserted via the ``prefill_tokens_saved`` counter and the
+  trace's prefill-span shape), and ``system_prefix`` rides the cache as
+  a pinned entry.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu import telemetry
+from unionml_tpu.models import Llama, LlamaConfig
+from unionml_tpu.models.generate import make_generator
+from unionml_tpu.serving.engine import DecodeEngine
+from unionml_tpu.serving.prefix_cache import RadixPrefixCache, tree_nbytes
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    cfg = LlamaConfig.tiny(vocab_size=97)
+    module = Llama(cfg)
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return module, params
+
+
+def _solo(module, params, prompt, n_new):
+    gen = make_generator(module, max_new_tokens=n_new, max_len=128)
+    return np.asarray(gen(params, jnp.asarray([prompt], jnp.int32)))[0].tolist()
+
+
+def _block_tree(block=4, fill=0.0):
+    """A fabricated one-layer KV tree shaped like the engine's
+    ``[1, block, heads, dim]`` cache rows."""
+    k = np.full((1, block, 2, 4), fill, np.float32)
+    return ((k, k + 1.0),)
+
+
+_BLOCK_BYTES = tree_nbytes(_block_tree())
+
+
+# --------------------------------------------------------------------- #
+# host-level store semantics
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.quick
+def test_prefix_cache_match_insert_roundtrip():
+    cache = RadixPrefixCache(block_size=4, max_bytes=1 << 20,
+                        registry=telemetry.MetricsRegistry())
+    toks = np.arange(1, 13, dtype=np.int32)          # 3 full blocks
+    miss = cache.match(toks)
+    assert miss.n_blocks == 0 and miss.rows == []
+    miss.release()
+    cache.insert(toks, 0, [_block_tree(fill=float(i)) for i in range(3)])
+    assert cache.entries == 3
+    assert cache.bytes == 3 * _BLOCK_BYTES
+
+    hit = cache.match(toks)
+    assert hit.n_blocks == 3 and hit.n_tokens == 12
+    # rows come back in prompt order, by identity of content
+    assert hit.rows[1][0][0][0, 0, 0, 0] == 1.0
+    hit.release()
+
+    # a diverging prompt shares only the leading blocks
+    part = cache.match(np.concatenate([toks[:8], [90, 91, 92, 93]]))
+    assert part.n_blocks == 2
+    part.release()
+    # sub-block tails never match (keys are whole blocks)
+    short = cache.match(toks[:7])
+    assert short.n_blocks == 1
+    short.release()
+
+    s = cache.stats()
+    # miss, full hit, diverging partial, and the 7-token lookup (its one
+    # cacheable block matched → a full hit at block granularity)
+    assert s["hits"] == 2 and s["misses"] == 1
+    assert s["partial_hits"] == 1 and s["hit_rate"] == pytest.approx(0.75)
+
+
+@pytest.mark.quick
+def test_prefix_cache_insert_requires_ancestors():
+    """Blocks whose prefix path is missing are dropped — a child's rows
+    are meaningless without the blocks above them."""
+    cache = RadixPrefixCache(block_size=4, max_bytes=1 << 20,
+                        registry=telemetry.MetricsRegistry())
+    toks = np.arange(1, 13, dtype=np.int32)
+    attached = cache.insert(toks, 2, [_block_tree()])  # parents absent
+    assert attached == 0 and cache.entries == 0
+    cache.insert(toks, 0, [_block_tree(), _block_tree()])
+    assert cache.insert(toks, 2, [_block_tree()]) == 1
+    assert cache.entries == 3
+
+
+@pytest.mark.quick
+def test_prefix_cache_lru_eviction_under_byte_budget():
+    """Over-budget inserts evict least-recently-used LEAF blocks first;
+    the store never exceeds max_bytes."""
+    cache = RadixPrefixCache(block_size=4, max_bytes=3 * _BLOCK_BYTES,
+                        registry=telemetry.MetricsRegistry())
+    a = np.arange(1, 9, dtype=np.int32)       # 2 blocks
+    b = np.arange(50, 58, dtype=np.int32)     # 2 blocks, distinct subtree
+    cache.insert(a, 0, [_block_tree(), _block_tree()])
+    cache.match(a).release()                  # refresh a's recency
+    cache.insert(b, 0, [_block_tree(), _block_tree()])
+    assert cache.bytes <= 3 * _BLOCK_BYTES
+    assert cache.entries == 3
+    # a's LEAF (block 2) was the LRU victim; its root block survives
+    assert cache.match(a).n_blocks >= 1
+    got_b = cache.match(b)
+    assert got_b.n_blocks == 2               # the fresh insert is intact
+    got_b.release()
+    assert cache.stats()["evictions"] == 1
+
+
+@pytest.mark.quick
+def test_prefix_cache_insert_never_evicts_own_chain():
+    """Regression: a mid-insert eviction pass must not pick a block of
+    the chain being inserted as its LRU victim — that detached the
+    chain while its bytes stayed charged (a permanent budget leak).
+    The in-progress path is refcount-protected, so an over-budget tail
+    is REJECTED instead."""
+    cache = RadixPrefixCache(block_size=4, max_bytes=2 * _BLOCK_BYTES + 1,
+                             registry=telemetry.MetricsRegistry())
+    toks = np.arange(1, 13, dtype=np.int32)           # a 3-block chain
+    attached = cache.insert(toks, 0, [_block_tree(fill=float(i))
+                                      for i in range(3)])
+    assert attached == 2                              # tail rejected, not
+    assert cache.entries == 2                         # a sibling evicted
+    assert cache.bytes == 2 * _BLOCK_BYTES
+    lease = cache.match(toks)                         # chain reachable and
+    assert lease.n_blocks == 2                        # consistent
+    assert lease.rows[1][0][0][0, 0, 0, 0] == 1.0
+    lease.release()
+    s = cache.stats()
+    assert s["evictions"] == 0
+    # and the budget still works once the insert is over: new unrelated
+    # inserts evict the (now unprotected) LRU chain normally
+    other = np.arange(50, 58, dtype=np.int32)
+    cache.insert(other, 0, [_block_tree(), _block_tree()])
+    assert cache.bytes <= 2 * _BLOCK_BYTES + 1
+
+
+@pytest.mark.quick
+def test_prefix_cache_pinned_blocks_survive_pressure():
+    """pin() marks a token path never-evictable — present and future
+    blocks — while unpinned neighbours churn."""
+    cache = RadixPrefixCache(block_size=4, max_bytes=2 * _BLOCK_BYTES,
+                        registry=telemetry.MetricsRegistry())
+    pinned = np.arange(1, 9, dtype=np.int32)
+    cache.pin(pinned)
+    cache.insert(pinned, 0, [_block_tree(), _block_tree()])  # pinned at attach
+    for i in range(5):
+        other = np.arange(100 + 10 * i, 104 + 10 * i, dtype=np.int32)
+        cache.insert(other, 0, [_block_tree()])
+    surv = cache.match(pinned)
+    assert surv.n_blocks == 2, "pinned blocks were evicted"
+    surv.release()
+    assert cache.bytes <= 2 * _BLOCK_BYTES + _BLOCK_BYTES  # churn bounded
+
+
+@pytest.mark.quick
+def test_prefix_cache_lease_blocks_eviction():
+    """An un-released lease (an in-flight admission) pins its matched
+    path against eviction; release makes it reclaimable again."""
+    cache = RadixPrefixCache(block_size=4, max_bytes=1 * _BLOCK_BYTES,
+                        registry=telemetry.MetricsRegistry())
+    a = np.arange(1, 5, dtype=np.int32)
+    cache.insert(a, 0, [_block_tree()])
+    lease = cache.match(a)
+    assert lease.n_blocks == 1
+    b = np.arange(50, 54, dtype=np.int32)
+    cache.insert(b, 0, [_block_tree()])   # no room: a is leased
+    assert cache.match(b).n_blocks == 0   # rejected, not forced in
+    assert cache.stats()["insert_rejected_blocks"] == 1
+    still = cache.match(a)
+    assert still.n_blocks == 1
+    still.release()
+    lease.release()
+    lease.release()                        # idempotent
+    cache.insert(b, 0, [_block_tree()])   # now a is evictable
+    got = cache.match(b)
+    assert got.n_blocks == 1
+    got.release()
+
+
+@pytest.mark.quick
+def test_prefix_cache_concurrent_lookup_insert():
+    """Hammer match/insert/release from many threads: no exceptions, no
+    budget violation, and the tree stays internally consistent."""
+    cache = RadixPrefixCache(block_size=4, max_bytes=20 * _BLOCK_BYTES,
+                        registry=telemetry.MetricsRegistry())
+    rng = np.random.default_rng(0)
+    seqs = [
+        np.concatenate([np.arange(1, 9), rng.integers(10, 90, 8)]).astype(np.int32)
+        for _ in range(8)
+    ]
+    errors = []
+
+    def worker(seed):
+        r = np.random.default_rng(seed)
+        try:
+            for _ in range(60):
+                toks = seqs[r.integers(len(seqs))]
+                lease = cache.match(toks)
+                nb = len(toks) // 4
+                if lease.n_blocks < nb:
+                    cache.insert(
+                        toks, lease.n_blocks,
+                        [_block_tree(fill=float(j))
+                         for j in range(lease.n_blocks, nb)],
+                    )
+                lease.release()
+        except BaseException as exc:  # noqa: BLE001 - surfaced below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert cache.bytes <= 20 * _BLOCK_BYTES
+    lease = cache.match(seqs[0])
+    assert 0 <= lease.n_blocks <= 4
+    lease.release()
+
+
+@pytest.mark.quick
+def test_prefix_cache_clear_keeps_pins():
+    cache = RadixPrefixCache(block_size=4, max_bytes=1 << 20,
+                        registry=telemetry.MetricsRegistry())
+    toks = np.arange(1, 9, dtype=np.int32)
+    cache.pin(toks)
+    cache.insert(toks, 0, [_block_tree(), _block_tree()])
+    cache.clear()
+    assert cache.entries == 0 and cache.bytes == 0
+    cache.insert(toks, 0, [_block_tree(), _block_tree()])
+    # re-inserted blocks re-pin: pressure cannot evict them
+    cache.max_bytes = 2 * _BLOCK_BYTES
+    cache.insert(np.arange(60, 64, dtype=np.int32), 0, [_block_tree()])
+    lease = cache.match(toks)
+    assert lease.n_blocks == 2
+    lease.release()
+
+
+# --------------------------------------------------------------------- #
+# engine integration: token identity + reuse accounting
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.quick
+def test_engine_prefix_cache_token_parity_and_savings(tiny_llama):
+    """THE acceptance contract: cold, full-hit, and partial-hit prompts
+    produce tokens bit-identical to the cache-off engine, while the
+    warm admissions skip the shared prefix's prefill work (tokens-saved
+    counter; the warm request's trace prefills only the suffix)."""
+    module, params = tiny_llama
+    rng = np.random.default_rng(7)
+    shared = rng.integers(1, 97, 24).tolist()
+    cold = shared + rng.integers(1, 97, 4).tolist()
+    partial = shared + rng.integers(1, 97, 7).tolist()
+
+    plain = DecodeEngine(
+        module, slots=2, max_new_tokens=6, prompt_buckets=(32,), chunk_steps=3,
+        registry=telemetry.MetricsRegistry(),
+    )
+    try:
+        want_cold = plain.generate(params, [cold])[0]
+        want_partial = plain.generate(params, [partial])[0]
+    finally:
+        plain.close()
+    assert want_cold == _solo(module, params, cold, 6)
+
+    registry = telemetry.MetricsRegistry()
+    tracer = telemetry.TraceRecorder()
+    cache = RadixPrefixCache(block_size=8, max_bytes=32 << 20, registry=registry)
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=6, prompt_buckets=(32,), chunk_steps=3,
+        prefix_cache=cache, registry=registry, tracer=tracer,
+    )
+    try:
+        assert engine.generate(params, [cold])[0] == want_cold       # miss
+        assert engine.generate(params, [cold])[0] == want_cold       # full hit
+        assert engine.generate(params, [partial])[0] == want_partial  # partial
+        s = engine.stats()["prefix_cache"]
+        assert s["misses"] == 1
+        assert s["hits"] + s["partial_hits"] == 2
+        # warm hit reuses 24 shared tokens (3 blocks); the partial hit
+        # at least the same 3 blocks again
+        assert s["prefill_tokens_saved"] >= 48
+        saved = registry.counter(
+            "unionml_prefix_cache_prefill_tokens_saved_total", "", ("cache",)
+        ).labels(cache=cache.instance).value
+        assert saved == s["prefill_tokens_saved"]
+        # trace shape: the warm requests spliced cached blocks instead
+        # of running prefill programs over them, and each request still
+        # has exactly ONE terminal prefill span (the sampled token 0)
+        spans = [
+            line for line in tracer.export_jsonl().splitlines() if line
+        ]
+        import json
+
+        names = [json.loads(line)["name"] for line in spans]
+        assert names.count("prefill") == 3
+        assert any(n.startswith("prefix-splice[") for n in names)
+        prefill_tokens = [
+            json.loads(line)["tokens"] for line in spans
+            if json.loads(line)["name"] == "prefill"
+        ]
+        # cold admission prefilled all 28 tokens; warm ones only their
+        # uncovered suffixes (4 and 7+24-24 tokens past the 3 blocks)
+        assert max(prefill_tokens) == len(cold)
+        assert sorted(prefill_tokens)[:2] == [len(cold) - 24, len(partial) - 24]
+    finally:
+        engine.close()
+
+
+@pytest.mark.quick
+def test_engine_prefix_cache_composes_with_chunked_prefill(tiny_llama):
+    """A long-bucket admission with a cache hit still interleaves: the
+    suffix runs block-granularity chunks through the same machinery,
+    and outputs stay solo-identical."""
+    module, params = tiny_llama
+    cache = RadixPrefixCache(block_size=8, max_bytes=32 << 20,
+                        registry=telemetry.MetricsRegistry())
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=6, prompt_buckets=(16, 64),
+        prefill_chunk=16, chunk_steps=3, prefix_cache=cache,
+        registry=telemetry.MetricsRegistry(),
+    )
+    try:
+        rng = np.random.default_rng(11)
+        shared = rng.integers(1, 97, 32).tolist()
+        prompts = [
+            shared + rng.integers(1, 97, n).tolist() for n in (5, 17, 30)
+        ]
+        for p in prompts:
+            assert engine.generate(params, [p])[0] == _solo(module, params, p, 6)
+        # the 2nd and 3rd shared the 32-token (4-block) prefix
+        assert engine.stats()["prefix_cache"]["prefill_tokens_saved"] >= 64
+    finally:
+        engine.close()
+
+
+@pytest.mark.quick
+def test_engine_prefix_cache_with_kv_quant(tiny_llama):
+    """Cached blocks carry the int8 KV layout (quantized rows + scale
+    planes) through extract → host store → splice unchanged."""
+    import dataclasses
+
+    module, params = tiny_llama
+    qmodule = Llama(dataclasses.replace(module.config, kv_quant=True))
+    cache = RadixPrefixCache(block_size=8, max_bytes=32 << 20,
+                        registry=telemetry.MetricsRegistry())
+    engine = DecodeEngine(
+        qmodule, slots=2, max_new_tokens=6, prompt_buckets=(32,),
+        chunk_steps=3, prefix_cache=cache,
+        registry=telemetry.MetricsRegistry(),
+    )
+    try:
+        rng = np.random.default_rng(13)
+        shared = rng.integers(1, 97, 16).tolist()
+        p1 = shared + rng.integers(1, 97, 5).tolist()
+        p2 = shared + rng.integers(1, 97, 9).tolist()
+        for p in (p1, p1, p2):
+            assert engine.generate(params, [p])[0] == _solo(qmodule, params, p, 6)
+        assert engine.stats()["prefix_cache"]["prefill_tokens_saved"] > 0
+    finally:
+        engine.close()
+
+
+@pytest.mark.quick
+def test_engine_system_prefix_rides_cache_pinned(tiny_llama):
+    """The back-compat shim: system_prefix tokens are prepended and
+    their blocks pinned — the second admission on reuses them instead
+    of re-prefilling, and outputs equal the prefixed solo run."""
+    module, params = tiny_llama
+    rng = np.random.default_rng(17)
+    prefix = rng.integers(1, 97, 16).tolist()  # block-aligned (16 = default)
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=6, prompt_buckets=(8, 16),
+        chunk_steps=3, system_prefix=prefix,
+        registry=telemetry.MetricsRegistry(),
+    )
+    try:
+        assert engine.prefix_cache is not None  # the shim auto-enables it
+        prompts = [rng.integers(1, 97, size=n).tolist() for n in (5, 9)]
+        for p in prompts:
+            assert engine.generate(params, [p])[0] == _solo(
+                module, params, prefix + p, 6
+            )
+        s = engine.stats()["prefix_cache"]
+        # request 2 reused the pinned 16-token prefix block
+        assert s["prefill_tokens_saved"] >= 16
+        # pinned entries survive a pressure cap far below the store size
+        engine.prefix_cache.max_bytes = 1
+        engine.prefix_cache.insert(
+            np.arange(200, 216, dtype=np.int32) % 97, 0, [_block_tree(16)]
+        )
+        lease = engine.prefix_cache.match(np.asarray(prefix, np.int32))
+        assert lease.n_blocks == 1, "pinned system_prefix block evicted"
+        lease.release()
+    finally:
+        engine.close()
+
+
+@pytest.mark.quick
+def test_spec_engine_accepts_system_prefix(tiny_llama):
+    """Satellite: the old hard ValueError is lifted — a speculative
+    engine with system_prefix prepends it through both prefills and
+    stays token-identical to the target's greedy prefixed solo run."""
+    module, params = tiny_llama
+    draft = module  # same module as its own draft: acceptance = 100%
+    engine = DecodeEngine(
+        module, draft_module=draft, speculate_k=2, slots=2,
+        max_new_tokens=6, prompt_buckets=(16,), chunk_steps=2,
+        system_prefix=[5, 9, 13],
+        registry=telemetry.MetricsRegistry(),
+    )
+    try:
+        prompt = [1, 2, 3, 4, 5]
+        out = engine.generate(
+            {"target": params, "draft": params}, [prompt]
+        )[0]
+        assert out == _solo(module, params, [5, 9, 13] + prompt, 6)
+    finally:
+        engine.close()
+
+
+@pytest.mark.slow
+def test_engine_prefix_cache_eviction_stress(tiny_llama):
+    """Eviction under a byte budget far smaller than the working set:
+    many distinct prompts churn the store; every output stays
+    solo-identical, the budget is never exceeded, and leased blocks are
+    never yanked from under an in-flight admission."""
+    module, params = tiny_llama
+    # start unbounded; the budget is tightened to ~4 real blocks once a
+    # real block's byte size is known
+    cache = RadixPrefixCache(block_size=8, max_bytes=1 << 30,
+                        registry=telemetry.MetricsRegistry())
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=4, prompt_buckets=(32,),
+        chunk_steps=2, prefix_cache=cache,
+        registry=telemetry.MetricsRegistry(),
+    )
+    try:
+        rng = np.random.default_rng(23)
+        # size the budget from a real block's bytes: insert once, read back
+        engine.generate(params, [rng.integers(1, 97, 16).tolist()])
+        real_block_bytes = cache.bytes // max(1, cache.entries)
+        cache.max_bytes = 4 * real_block_bytes
+        prompts = [rng.integers(1, 97, size=rng.integers(9, 33)).tolist()
+                   for _ in range(24)]
+        for p in prompts:
+            assert engine.generate(params, [p])[0] == _solo(module, params, p, 4)
+            assert cache.bytes <= cache.max_bytes
+        s = engine.stats()["prefix_cache"]
+        assert s["evictions"] > 0
+        assert s["entries"] <= 4
+    finally:
+        engine.close()

@@ -182,8 +182,8 @@ def test_spec_engine_validation(pair):
     target, draft, params = pair
     with pytest.raises(ValueError, match="greedy-only"):
         DecodeEngine(target, draft_module=draft, temperature=0.7)
-    with pytest.raises(ValueError, match="system_prefix"):
-        DecodeEngine(target, draft_module=draft, system_prefix=[1, 2])
+    with pytest.raises(ValueError, match="prefix KV-cache"):
+        DecodeEngine(target, draft_module=draft, prefix_cache=True)
     with pytest.raises(ValueError, match="vocabularies differ"):
         DecodeEngine(
             target,

@@ -10,6 +10,10 @@ predictor call). The TPU-native redesign has two layers:
   engine for LLM serving: fixed resident slots, per-slot KV fill,
   requests join/retire at chunk boundaries instead of waiting out the
   in-flight generation (the 8-client p95 fix).
+- :mod:`unionml_tpu.serving.prefix_cache` — automatic cross-request
+  prompt-prefix reuse: a radix tree of KV blocks in a byte-budgeted
+  host store; engine admissions splice the longest cached prefix and
+  prefill only the uncovered suffix (docs/prefix_caching.md).
 - transport: :mod:`unionml_tpu.serving.http` is a dependency-free stdlib
   HTTP server with the same surface (``GET /``, ``POST /predict``,
   ``GET /health``, ``GET /stats``, Prometheus ``GET /metrics``);
@@ -25,5 +29,9 @@ trace spans (docs/observability.md).
 from unionml_tpu.serving.batcher import MicroBatcher
 from unionml_tpu.serving.engine import DecodeEngine
 from unionml_tpu.serving.http import ServingApp, create_app
+from unionml_tpu.serving.prefix_cache import RadixPrefixCache
 
-__all__ = ["DecodeEngine", "MicroBatcher", "ServingApp", "create_app"]
+__all__ = [
+    "DecodeEngine", "MicroBatcher", "RadixPrefixCache", "ServingApp",
+    "create_app",
+]

@@ -57,13 +57,27 @@ Prompts are placed **unpadded** at cache rows ``[0, P)`` — per-slot
 positions make left-padding unnecessary, so a slot-decoded sequence is
 token-identical to its solo :func:`~unionml_tpu.models.generate
 .make_generator` run (tested in tests/unit/test_engine.py).
+
+Automatic prefix reuse: built with a
+:class:`~unionml_tpu.serving.prefix_cache.RadixPrefixCache`, admission walks
+a radix tree of previously-served prompt prefixes, splices the matched
+KV block rows host→device into the fresh cache (one compiled
+``[1, block]`` splice program, dispatched through the same interleaved
+admission loop as chunked prefill), and prefills only the uncovered
+suffix; prefill completion extracts the prompt's new full blocks
+device→host (async copy) and inserts them back into the tree. A shared
+``system_prefix`` is a back-compat shim over this path: its tokens are
+prepended to every request and its blocks are pinned in the cache, so
+it is prefilled once and never evicted (docs/prefix_caching.md).
 """
 
 from __future__ import annotations
 
+import math
 import queue
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, List, Optional, Sequence
 
@@ -105,6 +119,17 @@ def _splice_rows(dst_tree, src_tree, b_start, r_start):
     )
 
 
+def _concat_rows(trees):
+    """Concatenate host KV block trees along the row axis (axis 1) —
+    groups cache blocks into one splice-unit tree host-side, so the
+    device splice count scales with the admission's chunk unit, not the
+    cache's block size."""
+    return tuple(
+        tuple(np.concatenate(bufs, axis=1) for bufs in zip(*layers))
+        for layers in zip(*trees)
+    )
+
+
 @dataclass
 class _Admission:
     """A chunked prefill in progress: host cursor over the lead chunks.
@@ -117,11 +142,18 @@ class _Admission:
     req: "_Request"
     slot: int
     bucket: int
-    chunk: int                      # prefill_chunk (tokens per program)
+    chunk: int                      # tokens per program (prefill_chunk,
+    #                                 or the prefix-cache block size)
     n_chunks: int                   # total programs incl. the final
     padded: np.ndarray              # [bucket] right-padded prompt
-    fresh: Any                      # [1, P + bucket] cache being filled
+    fresh: Any                      # [1, bucket] cache being filled
     next_chunk: int = 0
+    # prefix-cache hit: one entry per chunk-sized splice unit (a tuple
+    # of cached host block trees covering rows [i*chunk, (i+1)*chunk)),
+    # spliced before the remaining chunks run (next_chunk starts past
+    # them)
+    splice_rows: List[Any] = field(default_factory=list)
+    next_splice: int = 0
 
 
 @dataclass
@@ -151,6 +183,10 @@ class _Request:
     _dispatch_t: float = 0.0
     _expected: int = 0                  # tokens covered by dispatched work
     _chunk_i: int = 0                   # harvested decode chunks (trace names)
+    _lease: Optional[Any] = None        # PrefixLease pinning matched blocks
+    _matched_blocks: int = 0            # radix-tree blocks found at admission
+    _prefilled_tokens: int = 0          # prompt tokens actually prefilled
+    _saved_tokens: int = 0              # prompt tokens spliced from cache
 
     def emit(self, chunk: List[int]) -> None:
         if self.stream is not None and chunk:
@@ -213,11 +249,31 @@ class DecodeEngine:
             token-identical to plain greedy decoding of the target for
             any draft. ``bind``/``generate`` then take the
             ``{"target": ..., "draft": ...}`` params mapping. Greedy
-            only; not composed with ``system_prefix``. Measured
-            (BASELINE.md round 5): crossover ~25% observed acceptance,
-            1.69× at full, 8B target + 0.3B draft.
+            only; composes with ``system_prefix`` (the prefix rides
+            through both models' prefills) but not with
+            ``prefix_cache`` (the draft would need a mirrored block
+            store). Measured (BASELINE.md round 5): crossover ~25%
+            observed acceptance, 1.69× at full, 8B target + 0.3B draft.
         speculate_k: draft tokens proposed per round (k+1 emitted max;
             a round costs k+1 draft steps + one (k+1)-token verify).
+        system_prefix: token ids prepended to EVERY request's prompt (a
+            shared system prompt). Back-compat shim over the prefix
+            cache: the prefix blocks are pinned there, so after the
+            first admission computes them they are spliced — never
+            re-prefilled — and can never be evicted. Buckets are
+            widened by the prefix length (and rounded up to splice
+            alignment) internally.
+        prefix_cache: a :class:`~unionml_tpu.serving.prefix_cache
+            .RadixPrefixCache` (or ``True`` for a default one) enabling
+            automatic cross-request prefix reuse: admission splices the
+            longest cached block-prefix of the prompt into the slot and
+            prefills only the uncovered suffix; completion inserts the
+            prompt's KV blocks back. Buckets are rounded up to
+            ``lcm(block_size, prefill_chunk)`` multiples so cached
+            admissions stay shape-static. One cache per weight binding:
+            ``bind`` to different params clears it. Defaults to a
+            private cache when ``system_prefix`` is set (the shim),
+            else disabled.
         registry/tracer: explicit telemetry sinks
             (:mod:`unionml_tpu.telemetry`). Default to the process-global
             registry and trace recorder, so a ``ServingApp``'s
@@ -246,6 +302,7 @@ class DecodeEngine:
         system_prefix: Optional[Sequence[int]] = None,
         draft_module=None,
         speculate_k: int = 4,
+        prefix_cache=None,
         registry: Optional[telemetry.MetricsRegistry] = None,
         tracer: Optional[telemetry.TraceRecorder] = None,
     ):
@@ -271,10 +328,13 @@ class DecodeEngine:
                     "speculation needs the rejection-sampling correction; "
                     "match make_speculative_generator)"
                 )
-            if system_prefix is not None:
+            if prefix_cache not in (None, False):
                 raise ValueError(
-                    "speculative decoding is not composed with "
-                    "system_prefix yet — drop one of the two"
+                    "the speculative engine does not compose with the "
+                    "prefix KV-cache yet — the draft model would need a "
+                    "mirrored block store; drop prefix_cache "
+                    "(system_prefix alone is fine: the prefix rides "
+                    "through both prefills)"
                 )
             if self.draft.config.vocab_size != module.config.vocab_size:
                 raise ValueError(
@@ -300,30 +360,24 @@ class DecodeEngine:
         self.cfg = module.config
         self.slots = slots
         self.max_new_tokens = max_new_tokens
-        self.buckets = tuple(sorted(set(int(b) for b in prompt_buckets)))
         self.prefill_chunk = None if prefill_chunk is None else int(prefill_chunk)
-        if self.prefill_chunk is not None:
-            if self.prefill_chunk < 1:
-                raise ValueError("prefill_chunk must be >= 1")
-            bad = [
-                b for b in self.buckets
-                if b > self.prefill_chunk and b % self.prefill_chunk
-            ]
-            if bad:
-                raise ValueError(
-                    f"buckets {bad} are not multiples of prefill_chunk "
-                    f"{self.prefill_chunk} — chunked prefill needs even "
-                    "chunk coverage (pad the bucket or change the chunk)"
-                )
+        if self.prefill_chunk is not None and self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
         self.chunk_steps = chunk_steps
         self.pipeline_depth = max(1, pipeline_depth)
         self.eos_id = eos_id
         self.pad_id = pad_id
         self.submit_timeout = submit_timeout
-        # shared system prefix: its KV rows occupy [0, prefix_len) of
-        # EVERY slot, seeded once per bound weights (one [1, P] prefill)
-        # and splice-broadcast into the resident cache; per-request
-        # prefills then cover only the user prompt at rows >= prefix_len
+        # telemetry sinks before the cache: a default-constructed cache
+        # registers its series in the engine's registry
+        self._registry = registry if registry is not None else telemetry.get_registry()
+        self._tracer = tracer if tracer is not None else telemetry.get_tracer()
+        self.instance = telemetry.instance_label("engine")
+        # shared system prefix (back-compat shim over the prefix cache):
+        # the tokens are PREPENDED to every request's prompt and their
+        # KV blocks pinned in the cache — the first admission prefills
+        # them, every later one splices them, and they can never be
+        # evicted. This replaces the old seed-once broadcast programs.
         self._prefix_tokens = (
             None
             if system_prefix is None
@@ -334,7 +388,59 @@ class DecodeEngine:
         self.prefix_len = (
             0 if self._prefix_tokens is None else len(self._prefix_tokens)
         )
-        self._prefix_rows = None  # [1, prefix_len] KV tree, set at seed
+        if (
+            prefix_cache is None
+            and self._prefix_tokens is not None
+            and self.draft is None
+        ):
+            prefix_cache = True  # the shim keeps old system_prefix reuse
+        if prefix_cache is True:
+            from unionml_tpu.serving.prefix_cache import RadixPrefixCache
+
+            prefix_cache = RadixPrefixCache(registry=self._registry)
+        self.prefix_cache = prefix_cache or None
+        if self._prefix_tokens is not None and self.prefix_cache is not None:
+            self.prefix_cache.pin(self._prefix_tokens)
+        # device-resident LRU of recently-spliced units (dispatcher
+        # thread only): a hot prefix — the pinned system_prefix above
+        # all — uploads host→device ONCE, not per admission. Entries
+        # hold the host block tuples too, so an id() key can never be
+        # recycled while its entry lives. The cap bounds device bytes
+        # (cap × unit tokens of KV).
+        self._dev_splice: "OrderedDict" = OrderedDict()
+        self._dev_splice_cap = 8
+        # bucket set: the prefix shim widens every bucket by the prefix
+        # length (prompts now INCLUDE the prefix), and a prefix cache
+        # rounds buckets up to lcm(block, prefill_chunk) so cached
+        # admissions (block-granularity chunks) and chunked prefill both
+        # keep static, evenly-covered shapes
+        raw = sorted(set(int(b) for b in prompt_buckets))
+        if self.prefix_len or self.prefix_cache is not None:
+            align = (
+                self.prefix_cache.block_size
+                if self.prefix_cache is not None
+                else 1
+            )
+            if self.prefill_chunk is not None:
+                align = math.lcm(align, self.prefill_chunk)
+            raw = sorted(set(
+                -(-(b + self.prefix_len) // align) * align for b in raw
+            ))
+        self.buckets = tuple(raw)
+        # per-request prompts are truncated to this BEFORE the prefix is
+        # prepended, so the prefix can never be cut by a long prompt
+        self._user_max = self.buckets[-1] - self.prefix_len
+        if self.prefill_chunk is not None:
+            bad = [
+                b for b in self.buckets
+                if b > self.prefill_chunk and b % self.prefill_chunk
+            ]
+            if bad:
+                raise ValueError(
+                    f"buckets {bad} are not multiples of prefill_chunk "
+                    f"{self.prefill_chunk} — chunked prefill needs even "
+                    "chunk coverage (pad the bucket or change the chunk)"
+                )
         # spare rows: a slot may overshoot its token budget by up to the
         # full in-flight window (pipeline_depth chunks dispatched before
         # the host harvests the completion, plus the chunk being
@@ -342,8 +448,7 @@ class DecodeEngine:
         # the fill invariant (fill always points at a masked-False row)
         # without per-slot write redirection
         self.cache_len = (
-            self.prefix_len
-            + self.buckets[-1]
+            self.buckets[-1]
             + max_new_tokens
             + (self.pipeline_depth + 1) * chunk_steps * self._round_stride
             # a speculative round writes k rows past its counted advance
@@ -354,13 +459,13 @@ class DecodeEngine:
         )
         if self.cache_len > min(max_lens):
             raise ValueError(
-                f"cache length {self.cache_len} (= prefix {self.prefix_len} "
-                f"+ max bucket {self.buckets[-1]} + max_new_tokens "
-                f"{max_new_tokens} + (pipeline_depth {self.pipeline_depth} "
-                f"+ 1) * chunk_steps {chunk_steps} * round stride "
-                f"{self._round_stride} spare rows) exceeds model max_len "
-                f"{min(max_lens)}; lower pipeline_depth/chunk_steps or "
-                "raise max_len"
+                f"cache length {self.cache_len} (= max bucket "
+                f"{self.buckets[-1]} incl. any system prefix + "
+                f"max_new_tokens {max_new_tokens} + (pipeline_depth "
+                f"{self.pipeline_depth} + 1) * chunk_steps {chunk_steps} "
+                f"* round stride {self._round_stride} spare rows) exceeds "
+                f"model max_len {min(max_lens)}; lower pipeline_depth/"
+                "chunk_steps or raise max_len"
             )
         self._sample = make_sampler(
             temperature=temperature, top_k=top_k, top_p=top_p
@@ -389,9 +494,8 @@ class DecodeEngine:
         # registry (one scrape surface across engine/batcher/HTTP/
         # trainer); stats() is a thin view over these instruments. The
         # instance label keeps concurrent engines' series separate.
-        self._registry = registry if registry is not None else telemetry.get_registry()
-        self._tracer = tracer if tracer is not None else telemetry.get_tracer()
-        self.instance = telemetry.instance_label("engine")
+        # (registry/tracer/instance were resolved above, before the
+        # prefix cache registered its own series.)
         self._build_instruments()
         # harvest-span anchor: set at the top of each _process_entry
         # (harvester thread only), read by _finish_if_done under the lock
@@ -508,7 +612,6 @@ class DecodeEngine:
             return
 
         cfg, L, B = self.cfg, self.cache_len, self.slots
-        P = self.prefix_len
         module, sample = self.module, self._sample
         eos_id, pad_id = self.eos_id, self.pad_id
 
@@ -516,118 +619,66 @@ class DecodeEngine:
             return {
                 "cache": init_cache(cfg, B, L),
                 "kv_mask": jnp.zeros((B, L), bool),
-                # empty slots idle at row P, NOT 0: dead slots still run
-                # the decode apply and write garbage k/v at their fill
-                # row. Row P is masked False and overwritten by the next
-                # prefill's suffix splice; row 0 would be a PREFIX row —
-                # shared, seeded once, never rewritten — and idle writes
-                # there corrupted every later occupant (caught by
-                # test_engine_system_prefix_matches_prefixed_solo).
-                "fill": jnp.full((B,), P, jnp.int32),
+                # empty slots idle at row 0: dead slots still run the
+                # decode apply and write garbage k/v at their fill row —
+                # row 0 stays masked False and is overwritten by the
+                # next admission's full-bucket splice
+                "fill": jnp.zeros((B,), jnp.int32),
                 "last_tok": jnp.zeros((B,), jnp.int32),
                 "done": jnp.ones((B,), bool),
             }
 
         self._init_state = jax.jit(init_state)
 
-        if P:
-            prefix_toks = jnp.asarray(self._prefix_tokens, jnp.int32)[None]
-
-            def seed_prefix(params, state):
-                """Prefill the shared prefix ONCE ([1, P] program) and
-                broadcast its KV rows into rows [0, P) of every slot."""
-                pcache = init_cache(cfg, 1, P)
-                _, pcache = module.apply(
-                    {"params": params}, prefix_toks,
-                    positions=jnp.arange(P)[None, :],
-                    cache=pcache, cache_index=jnp.int32(0),
-                    logit_index=jnp.zeros((1,), jnp.int32),
-                )
-                broadcast = tuple(
-                    tuple(
-                        jnp.broadcast_to(rows, (B,) + rows.shape[1:])
-                        for rows in player
-                    )
-                    for player in pcache
-                )
-                cache = _splice_rows(state["cache"], broadcast, 0, 0)
-                return {**state, "cache": cache}, pcache
-
-            self._seed_prefix = jax.jit(seed_prefix, donate_argnums=(1,))
-
         import functools
-
-        def build_fresh(prefix_rows, bucket: int):
-            """A fresh [1, P + bucket] cache seeded with the shared
-            prefix rows (traced into both prefill forms)."""
-            fresh = init_cache(cfg, 1, P + bucket)
-            if P:
-                fresh = _splice_rows(fresh, prefix_rows, 0, 0)
-            return fresh
 
         def finish_prefill(params, state, fresh, slot, toks, start, true_len,
                            key, **apply_kwargs):
-            """The SINGLE home for the prefill tail (monolithic and
-            chunked admissions both trace it — a desynced invariant here
-            would corrupt one path silently): run ``toks`` (the whole
-            right-padded bucket at ``start=0``, or the final chunk at its
-            suffix offset) against ``fresh``, sample the first token at
-            the last REAL position, splice the whole suffix into ``slot``
-            (garbage rows above ``true_len`` stay masked False in the
-            resident kv_mask)."""
-            bucket = fresh[0][0].shape[1] - P
+            """The SINGLE home for the prefill tail (monolithic, chunked,
+            and prefix-cached admissions all trace it — a desynced
+            invariant here would corrupt one path silently): run ``toks``
+            (the whole right-padded bucket at ``start=0``, or the final
+            chunk at its offset) against ``fresh``, sample the first
+            token at the last REAL position, splice the whole fresh
+            cache into ``slot`` — cached-prefix rows spliced before the
+            chunks ran are carried along; garbage rows above ``true_len``
+            stay masked False in the resident kv_mask."""
+            bucket = fresh[0][0].shape[1]
             c = toks.shape[1]
-            kv_mask = jnp.concatenate(
-                [
-                    jnp.ones((1, P), bool),
-                    (jnp.arange(bucket) < true_len)[None, :],
-                ],
-                axis=1,
-            )
+            kv_mask = (jnp.arange(bucket) < true_len)[None, :]
             logits, filled = module.apply(
                 {"params": params}, toks,
-                positions=P + start + jnp.arange(c)[None, :],
-                cache=fresh, cache_index=P + start, kv_mask=kv_mask,
+                positions=start + jnp.arange(c)[None, :],
+                cache=fresh, cache_index=start, kv_mask=kv_mask,
                 # head on the last REAL position only — the full-bucket
                 # head would materialize [1, bucket, vocab] fp32
                 logit_index=jnp.reshape(true_len - 1 - start, (1,)),
                 **apply_kwargs,
             )
             first = sample(logits[:, 0], key)[0]
-            # suffix rows only ([P, P + bucket)): the slot's prefix rows
-            # were broadcast at seed time and are never rewritten
-            suffix = tuple(
-                tuple(
-                    jax.lax.dynamic_slice_in_dim(rows, P, bucket, axis=1)
-                    for rows in flayer
-                )
-                for flayer in filled
-            )
-            cache = _splice_rows(state["cache"], suffix, slot, P)
-            row_mask = jnp.arange(L) < P + true_len
+            cache = _splice_rows(state["cache"], filled, slot, 0)
+            row_mask = jnp.arange(L) < true_len
             return {
                 "cache": cache,
                 "kv_mask": state["kv_mask"].at[slot].set(row_mask),
-                "fill": state["fill"].at[slot].set(P + true_len),
+                "fill": state["fill"].at[slot].set(true_len),
                 "last_tok": state["last_tok"].at[slot].set(first),
                 "done": state["done"].at[slot].set(False),
             }, first
 
-        # a monolithic admission with no shared prefix covers the whole
-        # visible history, so cfg.prefill_impl == "flash" may run it
-        # through the flash kernel (right-padded buckets need no pad
-        # mask: causal alone hides the trailing garbage). Chunked
-        # admissions and prefix engines keep the cached path.
+        # a monolithic admission covers the whole visible history, so
+        # cfg.prefill_impl == "flash" may run it through the flash
+        # kernel (right-padded buckets need no pad mask: causal alone
+        # hides the trailing garbage). Chunked and prefix-cached
+        # admissions keep the cached path.
         _full_kwargs = (
-            {"full_prefill": True}
-            if P == 0 and cfg.prefill_impl == "flash"
-            else {}
+            {"full_prefill": True} if cfg.prefill_impl == "flash" else {}
         )
 
-        def prefill(params, state, slot, tokens, true_len, key, prefix_rows):
+        def prefill(params, state, slot, tokens, true_len, key):
             """Monolithic admission: fresh build + full-bucket finish in
             ONE program (short buckets; one dispatch per admission)."""
-            fresh = build_fresh(prefix_rows, tokens.shape[0])
+            fresh = init_cache(cfg, 1, tokens.shape[0])
             return finish_prefill(
                 params, state, fresh, slot, tokens[None], jnp.int32(0),
                 true_len, key, **_full_kwargs,
@@ -636,13 +687,16 @@ class DecodeEngine:
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
 
         # ---- chunked prefill (long buckets): lead chunks fill a fresh
-        # [1, P + bucket] cache WITHOUT touching the resident state, so
+        # [1, bucket] cache WITHOUT touching the resident state, so
         # decode chunks interleave between them; only the final chunk
-        # (finish_prefill) splices into the slot and samples token 0 ----
+        # (finish_prefill) splices into the slot and samples token 0.
+        # Prefix-cached admissions ride the same machinery with
+        # chunk = the cache block size and the leading chunks replaced
+        # by host-row splices. ----
 
         @functools.partial(jax.jit, static_argnames=("bucket",))
-        def init_fresh(prefix_rows, *, bucket):
-            return build_fresh(prefix_rows, bucket)
+        def init_fresh(*, bucket):
+            return init_cache(cfg, 1, bucket)
 
         self._init_fresh = init_fresh
 
@@ -650,13 +704,13 @@ class DecodeEngine:
             """One lead chunk: tokens are fully real (the host only runs
             chunks covering the true length; the final, possibly padded,
             chunk goes through ``finish_prefill``)."""
-            lf = fresh[0][0].shape[1]          # P + bucket (static)
+            lf = fresh[0][0].shape[1]          # bucket (static)
             c = toks.shape[1]
-            kv_mask = (jnp.arange(lf) < P + start + c)[None, :]
+            kv_mask = (jnp.arange(lf) < start + c)[None, :]
             _, fresh = module.apply(
                 {"params": params}, toks,
-                positions=P + start + jnp.arange(c)[None, :],
-                cache=fresh, cache_index=P + start, kv_mask=kv_mask,
+                positions=start + jnp.arange(c)[None, :],
+                cache=fresh, cache_index=start, kv_mask=kv_mask,
                 # head output unused → DCE'd; the chunk only fills cache
                 logit_index=jnp.zeros((1,), jnp.int32),
             )
@@ -664,8 +718,9 @@ class DecodeEngine:
 
         self._prefill_step = jax.jit(prefill_step, donate_argnums=(1,))
         # donate the resident state only: no output matches the fresh
-        # cache's [1, P + bucket] shape, so donating it would just warn
+        # cache's [1, bucket] shape, so donating it would just warn
         self._prefill_final = jax.jit(finish_prefill, donate_argnums=(1,))
+        self._build_cache_programs()
 
         def decode_chunk(params, state, active, keys):
             """``chunk_steps`` decode steps for every slot in one scan."""
@@ -707,6 +762,46 @@ class DecodeEngine:
 
         self._decode_chunk = jax.jit(decode_chunk, donate_argnums=(1,))
 
+    def _build_cache_programs(self):
+        """Prefix-cache device programs (cache-enabled engines only):
+
+        - ``_splice_block``: write one cached splice unit's host rows
+          into a fresh ``[1, bucket]`` cache at a dynamic row offset
+          (compiled once per (bucket, unit) shape; the host→device copy
+          happens once per unit via the ``_dev_splice`` memo).
+        - ``_extract_rows``: slice a slot's leading ``n`` resident rows
+          in ONE dispatch (compiled once per bucket), feeding the async
+          device→host insert path — the harvester splits the contiguous
+          copy into blocks host-side.
+
+        Both are rank-generic over the cache tree like
+        :func:`_splice_rows`, so int8-KV scale planes ride along."""
+        if self.prefix_cache is None:
+            return
+        import functools
+
+        import jax
+
+        def splice_block(fresh, rows, start):
+            return _splice_rows(fresh, rows, 0, start)
+
+        self._splice_block = jax.jit(splice_block, donate_argnums=(0,))
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def extract_rows(cache, slot, *, n):
+            return tuple(
+                tuple(
+                    jax.lax.dynamic_slice(
+                        buf, (slot, 0) + (0,) * (buf.ndim - 2),
+                        (1, n) + buf.shape[2:],
+                    )
+                    for buf in layer
+                )
+                for layer in cache
+            )
+
+        self._extract_rows = extract_rows
+
     def _build_spec_programs(self):
         """Speculative-mode device programs (``draft_module`` set).
 
@@ -719,8 +814,10 @@ class DecodeEngine:
         greedy acceptance advancing per-slot fills — the
         ``make_speculative_generator`` round body (same acceptance/
         emission/eos invariants; a desync there breaks token identity)
-        restructured for the resident slot batch. No system prefix in
-        this mode (refused at construction), so P == 0 throughout.
+        restructured for the resident slot batch. A ``system_prefix``
+        arrives PREPENDED to every prompt (the shim), so both prefills
+        cover it like any other tokens; no prefix cache in this mode
+        (refused at construction).
         """
         import functools
 
@@ -784,13 +881,13 @@ class DecodeEngine:
                 "done": state["done"].at[slot].set(False),
             }, first
 
-        # the spec engine has no prefix mode, so every monolithic
-        # admission is a full prefill — each model honors its OWN
+        # every monolithic admission is a full prefill (any system
+        # prefix is part of the prompt) — each model honors its OWN
         # prefill_impl (target and draft configs may differ)
         _t_full = {"full_prefill": True} if cfg.prefill_impl == "flash" else {}
         _d_full = {"full_prefill": True} if dcfg.prefill_impl == "flash" else {}
 
-        def prefill(params, state, slot, tokens, true_len, key, prefix_rows):
+        def prefill(params, state, slot, tokens, true_len, key):
             fresh = (
                 init_cache(cfg, 1, tokens.shape[0]),
                 init_cache(dcfg, 1, tokens.shape[0]),
@@ -803,7 +900,7 @@ class DecodeEngine:
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
 
         @functools.partial(jax.jit, static_argnames=("bucket",))
-        def init_fresh(prefix_rows, *, bucket):
+        def init_fresh(*, bucket):
             return (init_cache(cfg, 1, bucket), init_cache(dcfg, 1, bucket))
 
         self._init_fresh = init_fresh
@@ -952,7 +1049,11 @@ class DecodeEngine:
             row = np.asarray(p, dtype=np.int32).ravel()
             if row.size == 0:
                 raise ValueError("empty prompt")
-            row = row[-self.buckets[-1]:]  # left-truncate to largest bucket
+            # left-truncate BEFORE prepending any system prefix, so the
+            # prefix survives arbitrarily long prompts
+            row = row[-self._user_max:]
+            if self._prefix_tokens is not None:
+                row = np.concatenate([self._prefix_tokens, row])
             req = _Request(prompt=row, max_new_tokens=n)
             req.rid = self._tracer.new_request("generate")
             self._queue.put(req)
@@ -999,7 +1100,9 @@ class DecodeEngine:
         row = np.asarray(prompt, dtype=np.int32).ravel()
         if row.size == 0:
             raise ValueError("empty prompt")
-        row = row[-self.buckets[-1]:]
+        row = row[-self._user_max:]
+        if self._prefix_tokens is not None:
+            row = np.concatenate([self._prefix_tokens, row])
         req = _Request(prompt=row, max_new_tokens=n, stream=queue.Queue())
         req.rid = self._tracer.new_request("stream")
         self._queue.put(req)
@@ -1059,22 +1162,49 @@ class DecodeEngine:
                     "cannot swap engine params while requests are in "
                     "flight — drain the engine (or create a new one) first"
                 )
-            if self._params is not None and self.prefix_len:
-                # resident prefix KV rows belong to the OLD weights;
-                # drop the state so admission re-seeds under the new tree
-                self._state = None
-                self._prefix_rows = None
+            if self._params is not None and self.prefix_cache is not None:
+                # stored KV blocks belong to the OLD weights; splicing
+                # them under the new tree would corrupt silently (pin
+                # registrations survive — the prefix re-pins on
+                # reinsert). The device-resident splice memo goes with
+                # them.
+                self.prefix_cache.clear()
+                self._dev_splice.clear()
             self._params = params
 
     def warmup(self, params) -> int:
-        """Pre-compile every engine executable (one prefill per bucket +
-        the decode chunk). Returns the number compiled."""
+        """Pre-compile the engine executables: per bucket, the cold
+        prefill, and — with a prefix cache — that bucket's cached
+        admission path too (splice + ``[1, block]`` finish via a
+        full-hit pass, the ``[1, block]`` lead chunk via a partial-hit
+        pass where the bucket has room), plus the decode chunk and the
+        extract programs. A live request must never pay a serve-time
+        XLA compile just because it HIT the cache. Returns the number
+        of cold-path executables; the cache is left empty."""
         self.bind(params)
         # 2 tokens, not 1: a 1-token request completes at prefill and
         # would never compile the decode chunk
         n = min(2, self.max_new_tokens)
         for b in self.buckets:
-            self.generate(params, [np.zeros(b, np.int32) + 1], max_new_tokens=n)
+            if self.prefix_cache is not None:
+                # each bucket must MISS first so its cold program
+                # compiles (every admission inserts, and the warmup
+                # prompts share prefixes across buckets)
+                self.prefix_cache.clear()
+            ones = np.ones(b - self.prefix_len, np.int32)
+            self.generate(params, [ones], max_new_tokens=n)
+            if self.prefix_cache is not None:
+                blk = self.prefix_cache.block_size
+                # full hit: splices + the [1, block] finish program
+                self.generate(params, [ones], max_new_tokens=n)
+                if b >= 3 * blk:
+                    # partial hit (>= 1 matched block, >= 2 uncovered):
+                    # compiles the [1, block] lead-chunk program
+                    part = ones.copy()
+                    part[-2 * blk:] = 2
+                    self.generate(params, [part], max_new_tokens=n)
+        if self.prefix_cache is not None:
+            self.prefix_cache.clear()
         return len(self.buckets) + 1
 
     def stats(self) -> dict:
@@ -1107,6 +1237,8 @@ class DecodeEngine:
                     spec_accepted / max(1, spec_rounds * self.speculate_k), 3
                 ),
             }
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
         for name, h in (
             ("queue_wait_ms", self._h_queue),
             ("prefill_ms", self._h_prefill),
@@ -1130,6 +1262,8 @@ class DecodeEngine:
             self._h_dispatch, self._h_harvest,
         ):
             m.reset()
+        if self.prefix_cache is not None:
+            self.prefix_cache.reset_stats()
 
     def close(self):
         self._stop.set()
@@ -1139,6 +1273,16 @@ class DecodeEngine:
             adm, self._admission = self._admission, None
         if adm is not None:
             self._drop_admission(adm.req, RuntimeError("decode engine closed"))
+        # drain the in-flight pipeline the harvester no longer owns:
+        # stranded insert entries still hold lease refcounts — leaking
+        # them would pin blocks in a user-supplied cache forever
+        while True:
+            try:
+                entry = self._inflight.get_nowait()
+            except queue.Empty:
+                break
+            if entry[0] == "insert":
+                self._release_lease(entry[1])
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -1152,6 +1296,7 @@ class DecodeEngine:
             if req is not None:
                 req.error = RuntimeError("decode engine closed")
                 self._tracer.finish_request(req.rid)
+                self._release_lease(req)
                 req.event.set()
                 req.finish_stream()
         self._occupant = [None] * self.slots
@@ -1196,7 +1341,7 @@ class DecodeEngine:
         (key,) = self._next_key()
         self._state, first = self._prefill(
             self._params, self._state, jnp.int32(slot), jnp.asarray(padded),
-            jnp.int32(len(req.prompt)), key, self._prefix_rows,
+            jnp.int32(len(req.prompt)), key,
         )
         _start_host_copy(first)
         with self._lock:
@@ -1205,6 +1350,64 @@ class DecodeEngine:
             req._expected = 1
             self._m_slots_busy.set(self._slots_in_use_locked())
         self._inflight.put(("prefill", slot, req, first))
+        self._schedule_insert(req, slot)
+
+    def _device_splice_rows(self, blocks):
+        """Device-resident rows for one splice unit (a tuple of cached
+        host block trees), LRU-memoized on the blocks' object identity:
+        a hot prefix — the pinned ``system_prefix`` above all — uploads
+        host→device ONCE, then every later admission splices the
+        resident copy. Each entry keeps the host tuples alive, so an
+        ``id()`` key can never be recycled while its entry lives.
+        Dispatcher thread only."""
+        import jax.numpy as jnp
+
+        key = tuple(id(b) for b in blocks)
+        hit = self._dev_splice.get(key)
+        if hit is not None:
+            self._dev_splice.move_to_end(key)
+            return hit[1]
+        host = blocks[0] if len(blocks) == 1 else _concat_rows(blocks)
+        dev = self._jax.tree_util.tree_map(jnp.asarray, host)
+        self._dev_splice[key] = (blocks, dev)
+        while len(self._dev_splice) > self._dev_splice_cap:
+            self._dev_splice.popitem(last=False)
+        return dev
+
+    def _schedule_insert(self, req: _Request, slot: int) -> None:
+        """Dispatcher, right after a prefill dispatch: extract the
+        slot's leading resident rows in ONE compiled dispatch, kick the
+        async device→host copy, and queue the tree insert behind the
+        in-flight readbacks — the harvester materializes the bytes once
+        they are already local and splits them into blocks, so neither
+        thread blocks on the transfer. Fully-matched prompts skip the
+        extraction; the entry always carries the request so its lease is
+        released only after the insert could build on live ancestors."""
+        import jax.numpy as jnp
+
+        cache = self.prefix_cache
+        if cache is None:
+            return
+        nb = len(req.prompt) // cache.block_size
+        first_new = min(req._matched_blocks, nb)
+        if first_new >= nb:
+            rows = None  # nothing new to store — release-only entry
+        else:
+            rows = self._extract_rows(
+                self._state["cache"], jnp.int32(slot),
+                n=self._bucket_for(len(req.prompt)),
+            )
+            for layer in rows:
+                for buf in layer:
+                    _start_host_copy(buf)
+        self._inflight.put(("insert", req, first_new, rows))
+
+    def _release_lease(self, req: _Request) -> None:
+        """Unpin the request's matched cache blocks (idempotent; error
+        paths and the insert path may both get here)."""
+        lease, req._lease = req._lease, None
+        if lease is not None:
+            lease.release()
 
     def _req_done(self, req: _Request, tok: int) -> bool:
         """The single stop predicate (shared by retirement and the
@@ -1247,6 +1450,40 @@ class DecodeEngine:
         dispatch order, so a slot's prefill token always lands before its
         decode tokens and before any reuse of the slot."""
         self._harvest_t0 = time.perf_counter()
+        if entry[0] == "insert":
+            # prompt blocks back into the radix tree: materialize the
+            # (already-local, copy kicked at dispatch) host bytes, split
+            # the contiguous row window into per-block OWNED copies
+            # (`.copy()` — a view would pin the whole window in RAM
+            # while charging only block bytes), and attach. A failed
+            # insert must never fail the request — the same device error
+            # would already have surfaced through the request's own
+            # prefill readback, which precedes this entry.
+            _, req, first_new, rows = entry
+            try:
+                if rows is not None and self.prefix_cache is not None:
+                    blk = self.prefix_cache.block_size
+                    nb = len(req.prompt) // blk
+                    full = tuple(
+                        tuple(np.asarray(buf) for buf in layer)
+                        for layer in rows
+                    )
+                    blocks = [
+                        tuple(
+                            tuple(
+                                buf[:, j * blk:(j + 1) * blk].copy()
+                                for buf in layer
+                            )
+                            for layer in full
+                        )
+                        for j in range(first_new, nb)
+                    ]
+                    self.prefix_cache.insert(req.prompt, first_new, blocks)
+            except Exception as exc:
+                logger.info(f"prefix-cache insert skipped: {exc!r}")
+            finally:
+                self._release_lease(req)
+            return
         if entry[0] == "prefill":
             _, slot, req, first = entry
             tok = int(np.asarray(first))
@@ -1256,7 +1493,8 @@ class DecodeEngine:
                 req.ttft_ms = (now - req.submitted) * 1e3
                 req._prefill_end = now
                 self._tracer.record_span(
-                    req.rid, "prefill", req._dispatch_t, now
+                    req.rid, "prefill", req._dispatch_t, now,
+                    tokens=req._prefilled_tokens,
                 )
                 req.tokens.append(tok)
                 req.emit([tok])
@@ -1413,6 +1651,7 @@ class DecodeEngine:
                 return
             req.error = exc
             self._admitting -= 1
+        self._release_lease(req)
         (self._m_abandoned if req.abandoned else self._m_errors).inc()
         self._tracer.finish_request(req.rid)
         req.event.set()
@@ -1420,10 +1659,15 @@ class DecodeEngine:
 
     def _start_admission(self, req: _Request) -> None:
         """Dispatcher: begin admitting a dequeued request (counted in
-        ``_admitting`` by ``_pop_request``). Short buckets prefill in one
-        monolithic dispatch; buckets larger than ``prefill_chunk`` start
-        a chunked admission whose lead chunks are dispatched one per loop
-        pass, interleaved with decode chunks."""
+        ``_admitting`` by ``_pop_request``). With a prefix cache, the
+        longest cached block-prefix of the prompt is leased (pinned
+        against eviction) and the admission becomes a block-granularity
+        chunked one: the leading chunks are replaced by host-row
+        splices, and only the uncovered suffix runs prefill programs.
+        Otherwise short buckets prefill in one monolithic dispatch and
+        buckets larger than ``prefill_chunk`` start a chunked admission
+        whose lead chunks are dispatched one per loop pass, interleaved
+        with decode chunks."""
         try:
             if req.abandoned:
                 self._drop_admission(
@@ -1432,14 +1676,39 @@ class DecodeEngine:
                 return
             if self._state is None:
                 self._state = self._init_state()
-                if self.prefix_len:
-                    # seed the shared prefix rows for the bound weights
-                    self._state, self._prefix_rows = self._seed_prefix(
-                        self._params, self._state
-                    )
+            cache, m_used = self.prefix_cache, 0
             bucket = self._bucket_for(len(req.prompt))
             chunk = self.prefill_chunk
-            if chunk is None or bucket <= chunk:
+            # cached-admission granularity: the cache block for
+            # monolithic-class buckets, prefill_chunk for chunked ones —
+            # a cached long prompt must never degrade its suffix to
+            # block-sized programs (a small hit would then admit far
+            # SLOWER than a miss). Buckets are lcm(block, chunk)-rounded
+            # at construction, so unit-aligned starts are block-aligned.
+            unit = None
+            if cache is not None:
+                unit = cache.block_size
+                if chunk is not None and bucket > chunk:
+                    # must stay block-representable AND chunk-aligned;
+                    # == prefill_chunk whenever block divides it (the
+                    # common case — same compiled shapes as a miss)
+                    unit = math.lcm(unit, chunk)
+                lease = cache.match(req.prompt)
+                req._lease = lease
+                req._matched_blocks = lease.n_blocks
+                blk = cache.block_size
+                # usable match: unit-quantized, and capped one token
+                # short of the prompt — finish_prefill must run at
+                # least the last real token to sample token 0 from it
+                m_used = min(
+                    lease.n_blocks, (len(req.prompt) - 1) // blk
+                ) * blk // unit * unit
+            # credited to the tokens-saved counter at admission
+            # completion (_advance_admission), not here: a dropped or
+            # abandoned admission saved nothing
+            req._saved_tokens = m_used
+            req._prefilled_tokens = len(req.prompt) - m_used
+            if m_used == 0 and (chunk is None or bucket <= chunk):
                 self._admit(req)
                 with self._lock:
                     self._admitting -= 1
@@ -1447,25 +1716,42 @@ class DecodeEngine:
             slot, bucket, padded = self._admission_preamble(req)
             # only the chunks covering the TRUE length run — a short
             # prompt routed into a long bucket pays for its own length
-            n_chunks = -(-len(req.prompt) // chunk)
-            fresh = self._init_fresh(self._prefix_rows, bucket=bucket)
+            # (and a cached admission only the uncovered suffix)
+            chunk_use = unit if m_used else chunk
+            if m_used:
+                # group the matched blocks into unit-sized splice
+                # entries (one device dispatch per unit, memoized
+                # host→device via _dev_splice)
+                g = unit // cache.block_size
+                splice_rows = [
+                    tuple(req._lease.rows[u * g:(u + 1) * g])
+                    for u in range(m_used // unit)
+                ]
+            else:
+                splice_rows = []
+            n_chunks = -(-len(req.prompt) // chunk_use)
+            adm = _Admission(
+                req=req, slot=slot, bucket=bucket, chunk=chunk_use,
+                n_chunks=n_chunks, padded=padded,
+                fresh=self._init_fresh(bucket=bucket),
+                next_chunk=m_used // chunk_use,
+                splice_rows=splice_rows,
+            )
             with self._lock:
-                self._admission = _Admission(
-                    req=req, slot=slot, bucket=bucket, chunk=chunk,
-                    n_chunks=n_chunks, padded=padded, fresh=fresh,
-                )
+                self._admission = adm
         except BaseException as exc:
             with self._lock:
                 self._admission = None
             self._drop_admission(req, exc)
 
     def _advance_admission(self, adm: _Admission) -> None:
-        """Dispatch ONE prefill chunk of the in-progress admission (the
-        final chunk finishes into the slot); decode chunks dispatch
-        between calls, so resident slots never stall behind a long
-        prompt's whole prefill. ``_fail_all``/``close`` may concurrently
-        null ``_admission`` — every transition re-checks identity under
-        the lock so the admission is completed or dropped exactly once."""
+        """Dispatch ONE step of the in-progress admission — a cached
+        block splice, a lead prefill chunk, or the final chunk that
+        finishes into the slot; decode chunks dispatch between calls, so
+        resident slots never stall behind a long prompt's prefill.
+        ``_fail_all``/``close`` may concurrently null ``_admission`` —
+        every transition re-checks identity under the lock so the
+        admission is completed or dropped exactly once."""
         import jax.numpy as jnp
 
         req = adm.req
@@ -1479,11 +1765,33 @@ class DecodeEngine:
                     req, TimeoutError("request abandoned during admission")
                 )
                 return
+            if adm.next_splice < len(adm.splice_rows):
+                # cached-prefix unit: device-resident rows (memoized
+                # host→device upload) spliced into the fresh cache in
+                # place of the prefill program that would have
+                # recomputed them
+                i = adm.next_splice
+                t0 = time.perf_counter()
+                rows = self._device_splice_rows(adm.splice_rows[i])
+                adm.fresh = self._splice_block(
+                    adm.fresh, rows, jnp.int32(i * adm.chunk)
+                )
+                adm.next_splice += 1
+                self._tracer.record_span(
+                    req.rid, f"prefix-splice[{i}]", t0, time.perf_counter(),
+                    tokens=adm.chunk,
+                )
+                return
             start = adm.next_chunk * adm.chunk
             toks = jnp.asarray(adm.padded[None, start: start + adm.chunk])
             if adm.next_chunk < adm.n_chunks - 1:
+                t0 = time.perf_counter()
                 adm.fresh = self._prefill_step(
                     self._params, adm.fresh, toks, jnp.int32(start)
+                )
+                self._tracer.record_span(
+                    req.rid, f"prefill-chunk[{adm.next_chunk}]", t0,
+                    time.perf_counter(), tokens=adm.chunk,
                 )
                 adm.next_chunk += 1
                 return
@@ -1505,6 +1813,11 @@ class DecodeEngine:
                 self._admitting -= 1
                 self._m_slots_busy.set(self._slots_in_use_locked())
             self._inflight.put(("prefill", adm.slot, req, first))
+            self._schedule_insert(req, adm.slot)
+            if self.prefix_cache is not None and req._saved_tokens:
+                # the admission actually completed on spliced rows —
+                # NOW the skipped prefill work is real
+                self.prefix_cache.record_saved_tokens(req._saved_tokens)
         except BaseException as exc:
             with self._lock:
                 if self._admission is adm:
@@ -1571,9 +1884,9 @@ class DecodeEngine:
                     req.error = exc
                     self._m_errors.inc()
                     self._tracer.finish_request(req.rid)
+                    self._release_lease(req)
                     req.event.set()
                     req.finish_stream()
                     self._occupant[slot] = None
             self._m_slots_busy.set(0)
         self._state = None
-        self._prefix_rows = None

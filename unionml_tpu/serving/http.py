@@ -17,12 +17,14 @@ Same endpoint surface as the reference's FastAPI app
 - ``GET /health`` — ``{"status": "ok", "model_loaded": bool}``,
 - ``GET /stats`` — serving observability: per-request queue-wait /
   prefill / decode (or device) time splits — plus a ``ttft_ms``
-  percentile from the engine — from the active batcher or decode engine
-  (no reference counterpart — needed to attribute tail latency between
-  transport queueing and device time),
+  percentile from the engine, and a ``prefix_cache`` section
+  (hit rate, prefill-tokens-saved, store bytes) when the engine runs
+  an automatic prefix KV-cache — from the active batcher or decode
+  engine (no reference counterpart — needed to attribute tail latency
+  between transport queueing and device time),
 - ``GET /metrics`` — Prometheus text exposition of the shared
-  :mod:`unionml_tpu.telemetry` registry (engine, batcher, HTTP-layer,
-  and trainer series in one scrape surface).
+  :mod:`unionml_tpu.telemetry` registry (engine, batcher, prefix-cache,
+  HTTP-layer, and trainer series in one scrape surface).
 
 Every response carries an ``X-Request-ID`` header (a generated
 telemetry request id) and lands in the per-endpoint

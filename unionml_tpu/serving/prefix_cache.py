@@ -1,0 +1,511 @@
+"""Automatic prefix KV-cache: radix-tree prompt reuse over a host store.
+
+Real LLM traffic repeats itself: system prompts, few-shot templates, and
+chat histories give most requests a long common prefix, yet a decode
+engine that re-prefills every admitted prompt from row 0 pays the full
+O(prompt) prefill each time. This module is the cross-request reuse
+layer — RadixAttention (SGLang, Zheng et al. 2024) / vLLM automatic
+prefix caching (Kwon et al., SOSP 2023) restructured for this
+framework's host/device split:
+
+- :class:`RadixPrefixCache` — a thread-safe radix tree keyed on fixed-size
+  **token blocks** (``block_size`` tokens per node, the vLLM block
+  granularity: every distinct block length would otherwise compile its
+  own XLA splice/prefill executable). Each node owns the host-RAM copy
+  of its block's KV rows (the per-layer tuple-of-tuples cache tree,
+  ``[1, block, ...]`` numpy leaves — rank-generic, so bf16 KV buffers
+  and int8-cache scale planes ride along unchanged).
+- a **byte-budgeted host block store**: inserts charge each block's
+  ``nbytes`` against ``max_bytes`` and evict least-recently-used leaf
+  blocks to fit. Eviction is leaf-first (a parent's rows stay valid
+  without its children) and skips blocks that are **pinned** (e.g. a
+  ``system_prefix``) or **leased** by an in-flight admission — an entry
+  referenced by a running prefill can never be freed under it.
+- :class:`PrefixLease` — the in-use pin handle :meth:`RadixPrefixCache.match`
+  returns: it holds refcounts on every matched node until the engine has
+  spliced the rows to device (and inserted any new suffix blocks), then
+  releases exactly once.
+
+The device side lives in :class:`unionml_tpu.serving.engine.DecodeEngine`:
+on admission it walks this tree for the longest cached prefix, splices
+the matched block rows into the slot's fresh cache (host→device, one
+compiled ``[1, block]`` splice program), prefills only the uncovered
+suffix, and on prefill completion copies the prompt's new full blocks
+back here (device→host, async). This module itself never imports jax —
+it is a pure host-memory structure, safe to unit-test and reuse anywhere.
+
+Telemetry (the PR-1 registry; all series carry a per-instance ``cache``
+label):
+
+- ``unionml_prefix_cache_hits_total`` / ``_partial_hits_total`` /
+  ``_misses_total`` — lookup outcomes (full = every cacheable block of
+  the prompt matched),
+- ``unionml_prefix_cache_prefill_tokens_saved_total`` — prompt tokens
+  whose prefill was skipped because their KV came from the cache,
+- ``unionml_prefix_cache_bytes`` / ``_entries`` — store gauges,
+- ``unionml_prefix_cache_evictions_total`` /
+  ``_inserted_blocks_total`` / ``_insert_rejected_blocks_total``,
+- ``unionml_prefix_cache_lookup_ms`` / ``_insert_ms`` — latency
+  histograms.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from unionml_tpu import telemetry
+
+__all__ = ["RadixPrefixCache", "PrefixLease", "tree_nbytes"]
+
+
+def tree_nbytes(rows: Any) -> int:
+    """Total bytes of one block's KV tree (tuple-of-tuples of arrays)."""
+    total = 0
+    for layer in rows:
+        for buf in layer:
+            total += int(np.asarray(buf).nbytes)
+    return total
+
+
+class _Node:
+    """One cached block: ``block_size`` tokens' KV rows plus tree links.
+
+    ``refcount`` counts live :class:`PrefixLease` holders (in-flight
+    admissions reading or extending this path); ``pinned`` marks blocks
+    under a registered pin sequence (``system_prefix``). Either makes
+    the node unevictable."""
+
+    __slots__ = (
+        "key", "rows", "nbytes", "children", "parent", "refcount",
+        "pinned", "last_used", "depth",
+    )
+
+    def __init__(self, key: bytes, rows: Any, nbytes: int,
+                 parent: Optional["_Node"], depth: int):
+        self.key = key
+        self.rows = rows
+        self.nbytes = nbytes
+        self.children: Dict[bytes, "_Node"] = {}
+        self.parent = parent
+        self.refcount = 0
+        self.pinned = False
+        self.last_used = 0
+        self.depth = depth  # block index (root = -1)
+
+
+class PrefixLease:
+    """In-use pin over the matched path; release exactly once.
+
+    ``rows`` is the list of matched blocks' host KV trees in prompt
+    order (``n_blocks`` entries, each covering ``block_size`` tokens).
+    The engine may consume fewer than all of them; the lease still pins
+    the whole path so a follow-up :meth:`RadixPrefixCache.insert` of suffix
+    blocks finds its ancestors alive."""
+
+    __slots__ = ("_cache", "_nodes", "rows", "n_blocks", "n_tokens")
+
+    def __init__(self, cache: "RadixPrefixCache", nodes: List[_Node]):
+        self._cache = cache
+        self._nodes = nodes
+        self.rows = [n.rows for n in nodes]
+        self.n_blocks = len(nodes)
+        self.n_tokens = len(nodes) * cache.block_size
+
+    def release(self) -> None:
+        """Drop the in-use pins (idempotent AND race-safe — an engine
+        error path and the normal insert path may both reach here; the
+        node-list swap happens under the cache lock so the refcounts
+        can only ever be decremented once)."""
+        with self._cache._lock:
+            nodes, self._nodes = self._nodes, []
+            for node in nodes:
+                node.refcount -= 1
+
+
+class RadixPrefixCache:
+    """Radix tree of prompt-prefix KV blocks in a byte-budgeted host store.
+
+    Args:
+        block_size: tokens per tree node. The device side compiles one
+            splice and one suffix-prefill executable per ``[1,
+            block_size]`` shape, so this quantizes both the key space
+            and the reusable match length (a match is usable in
+            ``block_size`` steps). 16 matches the vLLM default; larger
+            blocks cut per-admission dispatches, smaller ones waste
+            fewer tokens on the rounded-down tail.
+        max_bytes: host-RAM budget for stored KV rows. Inserting past it
+            evicts least-recently-used unpinned, unleased leaf blocks;
+            when nothing is evictable the incoming blocks are dropped
+            (counted in ``insert_rejected_blocks``), never the in-use
+            ones.
+        registry: explicit :class:`~unionml_tpu.telemetry
+            .MetricsRegistry`; defaults to the process-global one so
+            ``GET /metrics`` picks the cache up automatically.
+    """
+
+    def __init__(
+        self,
+        *,
+        block_size: int = 16,
+        max_bytes: int = 256 << 20,
+        registry: Optional[telemetry.MetricsRegistry] = None,
+    ):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if max_bytes < 1:
+            raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
+        self.block_size = int(block_size)
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._root = _Node(b"", None, 0, None, -1)
+        self._bytes = 0
+        self._entries = 0
+        self._clock = 0  # monotone LRU stamp (under the lock)
+        self._pinned_seqs: List[np.ndarray] = []
+        self._registry = (
+            registry if registry is not None else telemetry.get_registry()
+        )
+        self.instance = telemetry.instance_label("prefix_cache")
+        self._build_instruments()
+
+    # ------------------------------------------------------------------ #
+    # telemetry
+    # ------------------------------------------------------------------ #
+
+    def _build_instruments(self) -> None:
+        R, lbl = self._registry, {"cache": self.instance}
+
+        def counter(name, help):
+            return R.counter(name, help, ("cache",)).labels(**lbl)
+
+        def hist(name, help):
+            return R.histogram(name, help, ("cache",)).labels(**lbl)
+
+        self._m_hits = counter(
+            "unionml_prefix_cache_hits_total",
+            "Lookups where every cacheable block of the prompt matched.",
+        )
+        self._m_partial = counter(
+            "unionml_prefix_cache_partial_hits_total",
+            "Lookups matching some but not all cacheable prompt blocks.",
+        )
+        self._m_misses = counter(
+            "unionml_prefix_cache_misses_total",
+            "Lookups matching no cached block.",
+        )
+        self._m_saved = counter(
+            "unionml_prefix_cache_prefill_tokens_saved_total",
+            "Prompt tokens whose prefill was skipped via cached KV rows.",
+        )
+        self._m_evictions = counter(
+            "unionml_prefix_cache_evictions_total",
+            "Blocks evicted to fit the byte budget.",
+        )
+        self._m_inserted = counter(
+            "unionml_prefix_cache_inserted_blocks_total",
+            "Blocks attached to the tree.",
+        )
+        self._m_rejected = counter(
+            "unionml_prefix_cache_insert_rejected_blocks_total",
+            "Blocks dropped because the budget had no evictable room.",
+        )
+        self._g_bytes = R.gauge(
+            "unionml_prefix_cache_bytes",
+            "Host bytes held by stored KV blocks.", ("cache",),
+        ).labels(**lbl)
+        self._g_entries = R.gauge(
+            "unionml_prefix_cache_entries",
+            "Blocks resident in the radix tree.", ("cache",),
+        ).labels(**lbl)
+        self._h_lookup = hist(
+            "unionml_prefix_cache_lookup_ms", "match() wall time.",
+        )
+        self._h_insert = hist(
+            "unionml_prefix_cache_insert_ms", "insert() wall time.",
+        )
+
+    # ------------------------------------------------------------------ #
+    # lookup / insert
+    # ------------------------------------------------------------------ #
+
+    def _block_key(self, tokens: np.ndarray, i: int) -> bytes:
+        b = self.block_size
+        return tokens[i * b:(i + 1) * b].tobytes()
+
+    def match(self, tokens: Sequence[int]) -> PrefixLease:
+        """Longest cached block-prefix of ``tokens``; pins the path.
+
+        Returns a :class:`PrefixLease` (possibly empty). The caller MUST
+        :meth:`~PrefixLease.release` it — leased blocks are immune to
+        eviction until then. Counts the lookup as a hit (all
+        ``len(tokens) // block_size`` cacheable blocks matched), partial
+        hit, or miss; a prompt with ZERO cacheable blocks (shorter than
+        one block) is not counted at all — the cache was never
+        applicable, and a miss there would read as mis-sizing."""
+        t0 = time.perf_counter()
+        tokens = np.ascontiguousarray(tokens, np.int32).ravel()
+        cacheable = len(tokens) // self.block_size
+        nodes: List[_Node] = []
+        with self._lock:
+            self._clock += 1
+            node = self._root
+            for i in range(cacheable):
+                child = node.children.get(self._block_key(tokens, i))
+                if child is None:
+                    break
+                child.refcount += 1
+                child.last_used = self._clock
+                nodes.append(child)
+                node = child
+        if cacheable == 0:
+            pass
+        elif not nodes:
+            self._m_misses.inc()
+        elif len(nodes) == cacheable:
+            self._m_hits.inc()
+        else:
+            self._m_partial.inc()
+        self._h_lookup.observe((time.perf_counter() - t0) * 1e3)
+        return PrefixLease(self, nodes)
+
+    def insert(
+        self,
+        tokens: Sequence[int],
+        first_block: int,
+        blocks: Sequence[Any],
+    ) -> int:
+        """Attach ``blocks`` (host KV trees for token blocks
+        ``[first_block, first_block + len(blocks))`` of ``tokens``) to
+        the tree; returns how many were newly attached.
+
+        Blocks whose node already exists are skipped (their arrays are
+        dropped — concurrent identical admissions race benignly). Blocks
+        whose ancestors are missing (evicted mid-flight with no lease
+        held) are dropped too: a child's rows are meaningless without
+        the prefix path above them. Each attach charges the byte budget
+        and evicts LRU unpinned/unleased leaves to fit; when nothing
+        more is evictable the remaining blocks are rejected."""
+        t0 = time.perf_counter()
+        tokens = np.ascontiguousarray(tokens, np.int32).ravel()
+        attached = rejected = evicted = 0
+        with self._lock:
+            self._clock += 1
+            # the walked/attached chain is refcount-protected for the
+            # duration of the call: a mid-insert eviction pass must not
+            # pick a block we just attached (or are attaching under) as
+            # its LRU victim — that would detach the chain while we keep
+            # charging the budget for nodes no longer reachable
+            path: List[_Node] = []
+            # the eviction heap is seeded by ONE tree walk for the whole
+            # insert and reused across the block loop (nodes that turn
+            # unevictable are re-validated at pop): at steady state —
+            # store at budget, the normal LRU condition — a rescan per
+            # block would make each admission's insert O(blocks×entries)
+            # under the lock the dispatcher's match() waits on
+            heap: Optional[List[Tuple[int, int, _Node]]] = None
+
+            def step(n: _Node) -> _Node:
+                n.refcount += 1
+                path.append(n)
+                return n
+
+            node = self._root
+            ok = True
+            for i in range(first_block):
+                node = node.children.get(self._block_key(tokens, i))
+                if node is None:
+                    ok = False
+                    break
+                step(node)
+            if ok:
+                for j, rows in enumerate(blocks):
+                    i = first_block + j
+                    key = self._block_key(tokens, i)
+                    child = node.children.get(key)
+                    if child is not None:
+                        child.last_used = self._clock
+                        node = step(child)
+                        continue
+                    nbytes = tree_nbytes(rows)
+                    n, heap = self._evict_locked(
+                        self.max_bytes - nbytes, heap
+                    )
+                    evicted += n
+                    if self._bytes + nbytes > self.max_bytes:
+                        rejected += len(blocks) - j
+                        break
+                    child = _Node(key, rows, nbytes, node, i)
+                    child.last_used = self._clock
+                    child.pinned = self._under_pin(tokens, i)
+                    node.children[key] = child
+                    node = step(child)
+                    self._bytes += nbytes
+                    self._entries += 1
+                    attached += 1
+            else:
+                rejected += len(blocks)
+            for n in path:
+                n.refcount -= 1
+            self._sync_gauges_locked()
+        if attached:
+            self._m_inserted.inc(attached)
+        if rejected:
+            self._m_rejected.inc(rejected)
+        if evicted:
+            self._m_evictions.inc(evicted)
+        self._h_insert.observe((time.perf_counter() - t0) * 1e3)
+        return attached
+
+    def record_saved_tokens(self, n: int) -> None:
+        """Credit ``n`` prompt tokens whose prefill the caller skipped
+        by splicing cached rows (the engine calls this per admission)."""
+        if n > 0:
+            self._m_saved.inc(n)
+
+    # ------------------------------------------------------------------ #
+    # pinning / eviction
+    # ------------------------------------------------------------------ #
+
+    def pin(self, tokens: Sequence[int]) -> None:
+        """Mark every block under ``tokens`` never-evictable — present
+        AND future (blocks inserted later along this path are pinned at
+        attach time). The ``system_prefix`` back-compat path."""
+        tokens = np.ascontiguousarray(tokens, np.int32).ravel()
+        if tokens.size == 0:
+            return
+        with self._lock:
+            self._pinned_seqs.append(tokens)
+            node = self._root
+            for i in range(len(tokens) // self.block_size):
+                node = node.children.get(self._block_key(tokens, i))
+                if node is None:
+                    break
+                node.pinned = True
+
+    def _under_pin(self, tokens: np.ndarray, i: int) -> bool:
+        """Is block ``i`` of ``tokens`` covered by a pinned sequence?
+        (lock held)"""
+        end = (i + 1) * self.block_size
+        for seq in self._pinned_seqs:
+            if seq.size >= end and np.array_equal(seq[:end], tokens[:end]):
+                return True
+        return False
+
+    @staticmethod
+    def _evictable(node: _Node) -> bool:
+        return not node.children and not node.pinned and node.refcount == 0
+
+    def _evict_locked(
+        self,
+        budget: int,
+        heap: Optional[List[Tuple[int, int, _Node]]] = None,
+    ) -> Tuple[int, Optional[List[Tuple[int, int, _Node]]]]:
+        """Evict LRU evictable leaves until ``self._bytes <= budget``;
+        returns ``(evicted, heap)``. ONE tree walk seeds a min-heap of
+        evictable leaves keyed on recency — built lazily and returned so
+        a multi-block ``insert()`` reuses it across its whole loop;
+        parents are pushed as their last child goes and every pop is
+        re-validated, so the total cost per insert is O(entries +
+        evictions·log entries), not a rescan per victim (the lock is
+        held, and the dispatcher's ``match()`` waits on it). (lock
+        held)"""
+        if self._bytes <= budget:
+            return 0, heap
+        if heap is None:
+            heap = []
+            stack = [self._root]
+            while stack:
+                node = stack.pop()
+                stack.extend(node.children.values())
+                if node is not self._root and self._evictable(node):
+                    heapq.heappush(heap, (node.last_used, id(node), node))
+        evicted = 0
+        while self._bytes > budget and heap:
+            _, _, victim = heapq.heappop(heap)
+            if victim.parent is None or not self._evictable(victim):
+                continue  # detached or re-shielded since pushed
+            parent = victim.parent
+            del parent.children[victim.key]
+            self._bytes -= victim.nbytes
+            self._entries -= 1
+            victim.parent = None
+            victim.rows = None
+            evicted += 1
+            if parent is not self._root and self._evictable(parent):
+                heapq.heappush(heap, (parent.last_used, id(parent), parent))
+        return evicted, heap
+
+    # ------------------------------------------------------------------ #
+    # maintenance / views
+    # ------------------------------------------------------------------ #
+
+    def clear(self) -> None:
+        """Drop every stored block (pinned included — cached KV belongs
+        to ONE weight binding; the engine clears on a params swap). Pin
+        registrations survive, so re-inserted prefix blocks re-pin."""
+        with self._lock:
+            self._root.children.clear()
+            self._bytes = 0
+            self._entries = 0
+            self._sync_gauges_locked()
+
+    def _sync_gauges_locked(self) -> None:
+        self._g_bytes.set(self._bytes)
+        self._g_entries.set(self._entries)
+
+    @property
+    def bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    @property
+    def entries(self) -> int:
+        with self._lock:
+            return self._entries
+
+    def stats(self) -> dict:
+        """The ``prefix_cache`` section of ``DecodeEngine.stats()`` /
+        ``GET /stats`` — a thin view over this instance's registry
+        series (the same numbers ``GET /metrics`` exposes)."""
+        hits = int(self._m_hits.value)
+        partial = int(self._m_partial.value)
+        misses = int(self._m_misses.value)
+        lookups = hits + partial + misses
+        out = {
+            "block_size": self.block_size,
+            "max_bytes": self.max_bytes,
+            "bytes": self.bytes,
+            "entries": self.entries,
+            "hits": hits,
+            "partial_hits": partial,
+            "misses": misses,
+            "hit_rate": round((hits + partial) / max(1, lookups), 3),
+            "prefill_tokens_saved": int(self._m_saved.value),
+            "evictions": int(self._m_evictions.value),
+            "inserted_blocks": int(self._m_inserted.value),
+            "insert_rejected_blocks": int(self._m_rejected.value),
+        }
+        for name, h in (
+            ("lookup_ms", self._h_lookup), ("insert_ms", self._h_insert),
+        ):
+            summary = h.summary()
+            if summary:
+                out[name] = summary
+        return out
+
+    def reset_stats(self) -> None:
+        """Zero the flow counters/histograms (benchmarks call this
+        between phases); the store gauges re-sync to live contents."""
+        for m in (
+            self._m_hits, self._m_partial, self._m_misses, self._m_saved,
+            self._m_evictions, self._m_inserted, self._m_rejected,
+            self._h_lookup, self._h_insert,
+        ):
+            m.reset()
+        with self._lock:
+            self._sync_gauges_locked()
