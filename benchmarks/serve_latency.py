@@ -12,6 +12,10 @@ Usage::
     UNIONML_TPU_BENCH_PRESET=tiny python benchmarks/serve_latency.py  # CPU smoke
     UNIONML_TPU_BENCH_PRESET=serve_prefix_cache python benchmarks/serve_latency.py
     # ^ automatic prefix KV-cache: shared-prefix stream, cache on vs off
+    UNIONML_TPU_BENCH_PRESET=serve_overload python benchmarks/serve_latency.py
+    # ^ admission control under saturation: shed rate + accepted p99 on
+    #   an over-admitted stream, and recovery time after an injected
+    #   device fault (docs/robustness.md)
 """
 
 from __future__ import annotations
@@ -542,8 +546,173 @@ def prefix_cache_engine_leg() -> None:
     }))
 
 
+def overload_leg() -> None:
+    """Admission control + supervised recovery under saturation
+    (``UNIONML_TPU_BENCH_PRESET=serve_overload``).
+
+    Phase 1 — **over-admitted stream**: more concurrent clients than
+    the bounded engine (slots + ``max_queue_depth``) can hold, no
+    client backoff. Reports the shed rate (Overloaded rejections /
+    offered requests) and the accepted requests' p50/p99 — the
+    admission-control contract: bounded latency for what is accepted,
+    fast typed rejection for the rest, instead of unbounded queueing
+    where EVERY request eventually times out.
+
+    Phase 2 — **recovery time**: with every slot resident, a
+    FaultInjector raises an OOM-shaped XLA error on the next decode
+    dispatch; the metric is the wall time from arming the fault to the
+    first successfully completed request on the rebuilt state.
+    """
+    import threading
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu.models import Llama, LlamaConfig
+    from unionml_tpu.serving._stats import percentile_summary
+    from unionml_tpu.serving.engine import DecodeEngine
+    from unionml_tpu.serving.faults import (
+        FaultInjector, Overloaded, xla_oom_error,
+    )
+
+    backend = jax.default_backend()
+    if backend == "cpu":
+        cfg = serving_config("tiny")
+        module = Llama(cfg)
+        tokens0 = jnp.zeros((1, 8), jnp.int32)
+        params = jax.jit(module.init)(jax.random.PRNGKey(0), tokens0)["params"]
+        n_req, clients, slots, queue_depth = 48, 8, 2, 4
+        new_tokens, bucket, chunk_steps = 16, 16, 4
+    else:
+        cfg = serving_config("serve_1p5b")
+        qcfg = LlamaConfig(**{**cfg.__dict__, "quantized": True})
+        module = Llama(qcfg)
+        params = random_quantized_params(module)
+        n_req, clients, slots, queue_depth = 256, 32, 8, 16
+        new_tokens, bucket, chunk_steps = 32, 64, 8
+    fi = FaultInjector()
+    engine = DecodeEngine(
+        module, slots=slots, max_new_tokens=new_tokens,
+        prompt_buckets=(bucket,), chunk_steps=chunk_steps,
+        max_queue_depth=queue_depth, fault_injector=fi,
+    )
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, bucket // 2).tolist()
+        for _ in range(n_req)
+    ]
+    try:
+        engine.warmup(params)
+        engine.reset_stats()
+
+        lat, shed, failed, lock = [], [0], [], threading.Lock()
+
+        def client(rows):
+            for p in rows:
+                t0 = time.perf_counter()
+                try:
+                    engine.generate(params, [p])
+                except Overloaded:
+                    with lock:
+                        shed[0] += 1
+                    continue
+                except Exception as exc:
+                    # anything else (timeout, breaker, ...) must be
+                    # COUNTED, not silently truncate the sample — a
+                    # survivorship-biased p99 would report a healthy
+                    # tail exactly when the system is misbehaving
+                    with lock:
+                        failed.append(f"{type(exc).__name__}: {exc}")
+                    continue
+                with lock:
+                    lat.append((time.perf_counter() - t0) * 1e3)
+
+        threads = [
+            threading.Thread(target=client, args=(prompts[i::clients],))
+            for i in range(clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        s = percentile_summary(lat)
+        print(json.dumps({
+            "metric": "serve_overload_accepted_p99_ms",
+            "offered": n_req,
+            "clients": clients,
+            "slots": slots,
+            "max_queue_depth": queue_depth,
+            "accepted": len(lat),
+            "shed": shed[0],
+            "failed": len(failed),
+            "failed_errors": sorted(set(failed))[:3],
+            "shed_rate": round(shed[0] / n_req, 3),
+            "value": round(s.get("p99", 0.0), 1),
+            "p50_ms": round(s.get("p50", 0.0), 1),
+            "wall_ms": round(wall_ms, 1),
+            "unit": "ms",
+        }))
+
+        # ---- phase 2: recovery time after an injected device fault ----
+        def occupant(p):
+            try:
+                engine.generate(params, [p])
+            except BaseException:
+                pass  # the poisoned batch: expected to fail
+
+        occ = [
+            threading.Thread(target=occupant, args=(prompts[i],))
+            for i in range(slots)
+        ]
+        for t in occ:
+            t.start()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with engine._lock:  # resident-count poll (bench-only peek)
+                if sum(r is not None for r in engine._occupant) == slots:
+                    break
+            time.sleep(0.002)
+        fi.arm("engine.dispatch", exc=xla_oom_error())
+        t_fault = time.perf_counter()
+        while True:  # first completed request marks recovered service
+            try:
+                engine.generate(params, [prompts[0]])
+                break
+            except Exception:
+                time.sleep(0.002)
+        recovery_ms = (time.perf_counter() - t_fault) * 1e3
+        for t in occ:
+            t.join(timeout=60)
+        print(json.dumps({
+            "metric": "serve_overload_recovery_ms",
+            "slots": slots,
+            "value": round(recovery_ms, 1),
+            "recoveries": engine.stats()["robustness"]["recoveries"],
+            "unit": "ms",
+        }))
+    finally:
+        engine.close()
+
+
 if __name__ == "__main__":
-    if os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_prefix_cache":
+    if os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_overload":
+        if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
+            os.environ.get("UNIONML_TPU_BENCH_PREFIX")
+        ):
+            # hardcoded workload, same rule as serve_prefix_cache below
+            raise SystemExit(
+                "UNIONML_TPU_BENCH_PRESET=serve_overload takes no CLI "
+                f"flags or KV/PREFIX env legs (got {sys.argv[1:]}); its "
+                "workload is hardcoded in overload_leg"
+            )
+        overload_leg()
+    elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_prefix_cache":
         if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
             os.environ.get("UNIONML_TPU_BENCH_PREFIX")
         ):

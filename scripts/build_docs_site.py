@@ -42,6 +42,7 @@ NAV = [
     ("Checkpoints", "checkpoints.md"),
     ("Remote deployment", "remote.md"),
     ("Reliability", "reliability.md"),
+    ("Serving robustness", "robustness.md"),
     ("Performance", "performance.md"),
     ("CLI", "cli.md"),
     ("Tutorial: MNIST", "tutorials/mnist.md"),

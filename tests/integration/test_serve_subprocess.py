@@ -93,7 +93,10 @@ def test_serve_subprocess_lifecycle(model_artifact_path, tmp_path):
         _wait_live(port, proc)
         status, health = _get(f"http://127.0.0.1:{port}/health")
         assert status == 200
-        assert health == {"status": "ok", "model_loaded": True}
+        assert health == {
+            "status": "ok", "model_loaded": True,
+            "queue_depth": 0, "breaker_open": False,
+        }
 
         # predict from raw features
         status, preds = _post(

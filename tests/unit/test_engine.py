@@ -266,7 +266,13 @@ def test_engine_with_moe_llama():
 def test_engine_under_tensor_parallel_sharding(tiny_llama):
     """Continuous batching with TP-sharded weights: GSPMD propagates the
     `tensor`-axis sharding through prefill and decode chunks, and slot
-    outputs stay token-identical to the unsharded solo run.
+    outputs stay token-identical to the solo run **under the same
+    sharding**. (Comparing against the UNSHARDED solo run is wrong:
+    sharded matmuls reduce partial sums in a different order, and on a
+    randomly-initialized tiny model the resulting ulp-level logit
+    differences flip near-tie argmaxes — the sharded solo generator
+    diverges from the unsharded one identically, so that comparison
+    tested numerics, not the engine.)
 
     pipeline_depth=1 on the CPU mesh: deeper async pipelines of
     multi-device programs starve XLA's rendezvous on few-core hosts
@@ -292,7 +298,7 @@ def test_engine_under_tensor_parallel_sharding(tiny_llama):
         prompts = [[1, 2, 3, 4, 5], [6, 7, 8]]
         outs = engine.generate(tp_params, prompts)
         for prompt, out in zip(prompts, outs):
-            assert out == _solo(module, params, prompt, 6)
+            assert out == _solo(module, tp_params, prompt, 6)
     finally:
         engine.close()
 

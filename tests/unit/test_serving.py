@@ -31,7 +31,10 @@ def test_landing_and_health(server):
     assert r.status_code == 200 and "unionml-tpu serving" in r.text
     r = httpx.get(f"{url}/health")
     assert r.status_code == 200
-    assert r.json() == {"status": "ok", "model_loaded": True}
+    assert r.json() == {
+        "status": "ok", "model_loaded": True,
+        "queue_depth": 0, "breaker_open": False,
+    }
 
 
 def test_predict_features_and_inputs(server):
@@ -221,6 +224,79 @@ def test_stats_endpoint_direct_and_batched(trained_model):
         app.shutdown()
 
 
+def test_health_draining_503_stdlib(trained_model):
+    """App-level drain: /health reports draining with a 503 (the
+    readiness contract load balancers key on) and /predict stops
+    admitting with a Retry-After; resume() reopens."""
+    app = ServingApp(trained_model)
+    host, port = app.serve(port=0, blocking=False)
+    url = f"http://{host}:{port}"
+    try:
+        assert app.drain() is True
+        r = httpx.get(f"{url}/health")
+        assert r.status_code == 503 and r.json()["status"] == "draining"
+        r = httpx.post(f"{url}/predict", json={"features": [{"x": 1.0, "x2": 2.0}]})
+        assert r.status_code == 503
+        assert r.json()["reason"] == "draining"
+        assert int(r.headers["retry-after"]) >= 1
+        app.resume()
+        assert httpx.get(f"{url}/health").status_code == 200
+        r = httpx.post(f"{url}/predict", json={"features": [{"x": 1.0, "x2": 2.0}]})
+        assert r.status_code == 200
+    finally:
+        app.shutdown()
+
+
+def test_health_draining_503_fastapi(trained_model):
+    """Transport parity: the FastAPI adapter serves the same not-ready
+    => 503 health contract as the stdlib server."""
+    fastapi = pytest.importorskip("fastapi")
+    from fastapi.testclient import TestClient
+
+    app = fastapi.FastAPI()
+    trained_model.serve(app)
+    with TestClient(app) as client:
+        h = client.get("/health")
+        assert h.status_code == 200
+        body = h.json()
+        assert body["status"] == "ok"
+        assert body["queue_depth"] == 0 and body["breaker_open"] is False
+        core = app.state.unionml_tpu
+        core.drain()
+        try:
+            h = client.get("/health")
+            assert h.status_code == 503 and h.json()["status"] == "draining"
+            r = client.post("/predict", json={"features": [[0.1, 0.2]]})
+            assert r.status_code == 503
+            assert int(r.headers["retry-after"]) >= 1
+        finally:
+            core.resume()
+        assert client.get("/health").status_code == 200
+
+
+def test_health_sourced_from_engine():
+    """ServingApp(health=engine.health): /health carries the engine's
+    queue/breaker state and drains through the engine hook."""
+    app, engine = _lm_serving_app(stream=False)
+    host, port = app.serve(port=0, blocking=False)
+    url = f"http://{host}:{port}"
+    try:
+        h = httpx.get(f"{url}/health")
+        assert h.status_code == 200
+        body = h.json()
+        assert body["status"] == "ok" and body["model_loaded"] is True
+        assert body["queue_depth"] == 0 and body["breaker_open"] is False
+        assert app.drain(timeout=30) is True      # delegates to engine.drain
+        assert engine.health()["status"] == "draining"
+        assert httpx.get(f"{url}/health").status_code == 503
+        engine.resume()
+        app.resume()
+        assert httpx.get(f"{url}/health").status_code == 200
+    finally:
+        app.shutdown()
+        engine.close()
+
+
 def test_fastapi_stats_route_parity(trained_model):
     fastapi = pytest.importorskip("fastapi")
     from fastapi.testclient import TestClient
@@ -318,7 +394,8 @@ def _lm_serving_app(stream=True):
         return engine.generate(p, prompts)
 
     lm.artifact = ModelArtifact(params, {}, {})
-    kwargs = dict(stats=engine.stats)
+    # the full engine wiring: stats + health + drain hooks
+    kwargs = dict(stats=engine.stats, health=engine.health, drain=engine.drain)
     if stream:
         kwargs["stream"] = lambda p, prompts: engine.generate_stream(p, prompts[0])
     return ServingApp(lm, **kwargs), engine

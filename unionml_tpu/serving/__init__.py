@@ -24,14 +24,32 @@ Both engines, both transports, and the step trainer publish through the
 :mod:`unionml_tpu.telemetry` registry — one ``GET /metrics`` scrape
 covers every layer, and engine requests record Perfetto-exportable
 trace spans (docs/observability.md).
+
+Fault tolerance (:mod:`unionml_tpu.serving.faults`,
+docs/robustness.md): bounded queues and per-request deadlines shed load
+with typed errors the transports map to 429/503/504 (+ ``Retry-After``),
+the engine supervises itself — a failed device program fails only its
+poisoned batch, rebuilds, and trips a circuit breaker if rebuilds keep
+failing — ``drain()`` finishes in-flight streams for graceful
+shutdown, and a deterministic :class:`~unionml_tpu.serving.faults
+.FaultInjector` makes every failure mode reproducible in CPU-only
+tests.
 """
 
 from unionml_tpu.serving.batcher import MicroBatcher
 from unionml_tpu.serving.engine import DecodeEngine
+from unionml_tpu.serving.faults import (
+    DeadlineExceeded,
+    EngineUnavailable,
+    FaultInjector,
+    Overloaded,
+    deadline_scope,
+)
 from unionml_tpu.serving.http import ServingApp, create_app
 from unionml_tpu.serving.prefix_cache import RadixPrefixCache
 
 __all__ = [
-    "DecodeEngine", "MicroBatcher", "RadixPrefixCache", "ServingApp",
-    "create_app",
+    "DeadlineExceeded", "DecodeEngine", "EngineUnavailable",
+    "FaultInjector", "MicroBatcher", "Overloaded", "RadixPrefixCache",
+    "ServingApp", "create_app", "deadline_scope",
 ]

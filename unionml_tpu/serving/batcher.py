@@ -28,6 +28,12 @@ import numpy as np
 
 from unionml_tpu import telemetry
 from unionml_tpu._logging import logger
+from unionml_tpu.serving.faults import (
+    DeadlineExceeded,
+    EngineUnavailable,
+    Overloaded,
+    current_deadline_ms,
+)
 
 DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 
@@ -96,6 +102,9 @@ class _Pending:
     # burning a device call on a result nobody will read (mirrors the
     # engine's req.abandoned convention)
     abandoned: bool = False
+    # absolute perf_counter deadline (None = none): enforced at drain,
+    # so an expired entry is shed before joining a device batch
+    deadline: Optional[float] = None
 
 
 class MicroBatcher:
@@ -110,6 +119,8 @@ class MicroBatcher:
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         row_lists: bool = False,
         registry: Optional[telemetry.MetricsRegistry] = None,
+        max_queue_depth: Optional[int] = None,
+        fault_injector=None,
     ):
         """``row_lists=True``: features/results are plain Python lists of
         per-example rows (possibly ragged — LLM token-id prompts), so the
@@ -118,12 +129,35 @@ class MicroBatcher:
 
         ``registry``: explicit telemetry sink; defaults to the
         process-global registry so ``GET /metrics`` covers this batcher
-        (series isolated per instance by the ``batcher`` label)."""
+        (series isolated per instance by the ``batcher`` label).
+
+        ``max_queue_depth``: admission control — a ``submit()`` that
+        would push the not-yet-batched queue past this many entries
+        raises :class:`~unionml_tpu.serving.faults.Overloaded` instead
+        of queueing forever (the transports map it to HTTP 429 with
+        ``Retry-After``). ``None`` keeps the historical unbounded queue.
+
+        ``fault_injector``: a :class:`~unionml_tpu.serving.faults
+        .FaultInjector` whose ``batcher.predict`` point fires before
+        the shared device call (chaos tests; ``None`` is zero-cost)."""
         self._predict_fn = predict_fn
         self.row_lists = row_lists
         self.max_batch_size = max_batch_size
         self.max_wait_s = max_wait_ms / 1000.0
         self.buckets = tuple(sorted(set(buckets) | {max_batch_size}))
+        if max_queue_depth is not None and max_queue_depth < 1:
+            raise ValueError("max_queue_depth must be >= 1 when set")
+        self.max_queue_depth = max_queue_depth
+        self._faults = fault_injector
+        self._draining = False
+        # admission lock: depth-check + enqueue must be atomic, or N
+        # concurrent submitters each pass the check and push the queue
+        # past the bound. _pending counts undisposed entries (queued OR
+        # inside the worker's device call) — what drain() must wait on;
+        # queue.empty() alone returns early while the last batch is
+        # still on device.
+        self._admit_lock = threading.Lock()
+        self._pending = 0
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
         self._stop = threading.Event()
         self._registry = registry if registry is not None else telemetry.get_registry()
@@ -171,6 +205,25 @@ class MicroBatcher:
             "unionml_batcher_device_ms",
             "Shared batched device-call time per request.", ("batcher",),
         ).labels(**lbl)
+        rejected = R.counter(
+            "unionml_batcher_rejected_total",
+            "submit() calls rejected at admission control, by reason "
+            "(queue_full -> 429, draining -> 503).",
+            ("batcher", "reason"),
+        )
+        self._m_rejected = {
+            reason: rejected.labels(batcher=self.instance, reason=reason)
+            for reason in ("queue_full", "draining")
+        }
+        self._m_deadline_shed = counter(
+            "unionml_batcher_deadline_shed_total",
+            "Entries shed at batch-drain time because their deadline "
+            "expired before the device call.",
+        )
+        self._g_queue_depth = R.gauge(
+            "unionml_batcher_queue_depth",
+            "Entries queued awaiting a batch.", ("batcher",),
+        ).labels(**lbl)
 
     def _bucket(self, n: int) -> int:
         for b in self.buckets:
@@ -178,23 +231,101 @@ class MicroBatcher:
                 return b
         return self.buckets[-1]
 
-    def submit(self, features: Any, timeout: Optional[float] = 60.0) -> Any:
+    def submit(
+        self,
+        features: Any,
+        timeout: Optional[float] = 60.0,
+        *,
+        deadline_ms: Optional[float] = None,
+    ) -> Any:
         """Block until the batched prediction for ``features`` is ready.
 
         A timed-out submit marks its entry **abandoned**: the worker
         skips it at drain time (``batcher_abandoned_total``) instead of
-        burning a device call on a result nobody will read."""
+        burning a device call on a result nobody will read.
+
+        Admission control: while draining, raises
+        :class:`~unionml_tpu.serving.faults.EngineUnavailable`; with
+        ``max_queue_depth`` set and the queue full, raises
+        :class:`~unionml_tpu.serving.faults.Overloaded`. A deadline
+        (explicit ``deadline_ms``, or the ambient
+        :func:`~unionml_tpu.serving.faults.deadline_scope` the HTTP
+        layer opens from ``X-Deadline-Ms``) sheds the entry with
+        :class:`~unionml_tpu.serving.faults.DeadlineExceeded` if it
+        expires before the device call starts."""
+        if deadline_ms is None:
+            deadline_ms = current_deadline_ms()
         pending = _Pending(
             features=features, rows=_leading_dim(features, self.row_lists),
             submitted=time.perf_counter(),
         )
-        self._queue.put(pending)
+        if deadline_ms is not None:
+            pending.deadline = pending.submitted + deadline_ms / 1e3
+        with self._admit_lock:
+            if self._draining:
+                self._m_rejected["draining"].inc()
+                raise EngineUnavailable(
+                    "micro-batcher is draining and not accepting requests",
+                    reason="draining", retry_after_s=1.0,
+                )
+            if self.max_queue_depth is not None:
+                depth = self._queue.qsize()
+                if depth >= self.max_queue_depth:
+                    self._m_rejected["queue_full"].inc()
+                    raise Overloaded(
+                        f"micro-batcher queue is full ({depth} queued >= "
+                        f"max_queue_depth {self.max_queue_depth})",
+                        retry_after_s=max(0.1, self.max_wait_s),
+                    )
+            self._queue.put(pending)
+            self._pending += 1
+        self._g_queue_depth.set(self._queue.qsize())
         if not pending.event.wait(timeout):
             pending.abandoned = True
             raise TimeoutError("micro-batcher did not produce a result in time")
         if pending.error is not None:
             raise pending.error
         return pending.result
+
+    def health(self) -> dict:
+        """Readiness surface for ``GET /health`` (same shape as
+        :meth:`DecodeEngine.health <unionml_tpu.serving.engine
+        .DecodeEngine.health>`; the batcher has no device state to
+        rebuild, so no circuit breaker — ``breaker_open`` is always
+        False)."""
+        return {
+            "status": "draining" if self._draining else "ok",
+            "queue_depth": self._queue.qsize(),
+            "breaker_open": False,
+        }
+
+    def _dispose(self, n: int = 1) -> None:
+        """An entry left the system (delivered, errored, shed, or
+        skipped as abandoned): retire its drain() obligation."""
+        with self._admit_lock:
+            self._pending -= n
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain: stop admitting (``submit()`` raises
+        :class:`~unionml_tpu.serving.faults.EngineUnavailable`), then
+        block until every accepted entry has been **delivered** — the
+        pending count covers the batch inside the device call too, not
+        just the queue (a cold compile can hold it for seconds; a
+        queue-only check would hand shutdown a worker mid-call).
+        Returns True when drained, False on ``timeout``."""
+        t0 = time.perf_counter()
+        self._draining = True
+        while True:
+            with self._admit_lock:
+                if self._pending == 0:
+                    return True
+            if timeout is not None and time.perf_counter() - t0 > timeout:
+                return False
+            time.sleep(0.005)
+
+    def resume(self) -> None:
+        """Reopen admissions after :meth:`drain`."""
+        self._draining = False
 
     def stats(self) -> dict:
         """Serving observability: queue-wait vs device-time split.
@@ -210,6 +341,15 @@ class MicroBatcher:
             "mean_batch_rows": round(
                 int(self._m_rows.value) / max(1, batches), 2
             ),
+            "robustness": {
+                "queue_depth": self._queue.qsize(),
+                "rejected": {
+                    reason: int(c.value)
+                    for reason, c in self._m_rejected.items()
+                },
+                "deadline_shed": int(self._m_deadline_shed.value),
+                "draining": self._draining,
+            },
         }
         for name, h in (
             ("queue_wait_ms", self._h_queue), ("device_ms", self._h_device)
@@ -225,7 +365,8 @@ class MicroBatcher:
         that phase); scrapers see the resets as counter restarts."""
         for m in (
             self._m_requests, self._m_errors, self._m_abandoned,
-            self._m_batches, self._m_rows, self._h_batch, self._h_queue,
+            self._m_batches, self._m_rows, self._m_deadline_shed,
+            *self._m_rejected.values(), self._h_batch, self._h_queue,
             self._h_device,
         ):
             m.reset()
@@ -242,18 +383,40 @@ class MicroBatcher:
                 break
             pending.error = RuntimeError("micro-batcher closed")
             pending.event.set()
+            self._dispose()
 
     # ------------------------------------------------------------------ #
 
+    def _shed_dead(self, p: _Pending) -> bool:
+        """Drop an entry nobody benefits from batching: abandoned
+        (waiter gone) or deadline-expired (shed with a typed error
+        BEFORE it joins a device batch — the admission-control
+        contract). Returns True when the entry was shed."""
+        if p.abandoned:
+            self._m_abandoned.inc()
+            self._dispose()
+            return True
+        if p.deadline is not None and time.perf_counter() > p.deadline:
+            waited_ms = (time.perf_counter() - p.submitted) * 1e3
+            p.error = DeadlineExceeded(
+                f"request deadline expired while queued "
+                f"(waited {waited_ms:.0f} ms)",
+                deadline_ms=(p.deadline - p.submitted) * 1e3,
+            )
+            self._m_deadline_shed.inc()
+            p.event.set()
+            self._dispose()
+            return True
+        return False
+
     def _drain(self) -> List[_Pending]:
-        while True:  # skip abandoned entries without starting a batch
+        while True:  # skip dead entries without starting a batch
             try:
                 first = self._queue.get(timeout=0.1)
             except queue.Empty:
                 return []
-            if not first.abandoned:
+            if not self._shed_dead(first):
                 break
-            self._m_abandoned.inc()
         batch = [first]
         rows = first.rows
         deadline = threading.Event()
@@ -265,8 +428,7 @@ class MicroBatcher:
                     nxt = self._queue.get(timeout=self.max_wait_s / 4)
                 except queue.Empty:
                     continue
-                if nxt.abandoned:
-                    self._m_abandoned.inc()
+                if self._shed_dead(nxt):
                     continue
                 if rows + nxt.rows > self.max_batch_size:
                     self._queue.put(nxt)  # over cap: leave for the next batch
@@ -275,6 +437,7 @@ class MicroBatcher:
                 rows += nxt.rows
         finally:
             timer.cancel()
+        self._g_queue_depth.set(self._queue.qsize())
         return batch
 
     def _run(self):
@@ -283,10 +446,15 @@ class MicroBatcher:
             # belt: a submit may time out between drain and dispatch
             still_live = [p for p in batch if not p.abandoned]
             self._m_abandoned.inc(len(batch) - len(still_live))
+            self._dispose(len(batch) - len(still_live))
             batch = still_live
             if not batch:
                 continue
             try:
+                if self._faults is not None:
+                    # chaos point: a raise here surfaces to every waiter
+                    # in the batch (the shared-device-call error path)
+                    self._faults.fire("batcher.predict")
                 t_start = time.perf_counter()
                 for p in batch:
                     p.queue_wait_ms = (t_start - p.submitted) * 1e3
@@ -332,3 +500,4 @@ class MicroBatcher:
             finally:
                 for p in batch:
                     p.event.set()
+                self._dispose(len(batch))

@@ -69,6 +69,22 @@ device→host (async copy) and inserts them back into the tree. A shared
 ``system_prefix`` is a back-compat shim over this path: its tokens are
 prepended to every request and its blocks are pinned in the cache, so
 it is prefilled once and never evicted (docs/prefix_caching.md).
+
+Fault tolerance (docs/robustness.md): submissions pass **admission
+control** — a bounded queue (``max_queue_depth`` →
+:class:`~unionml_tpu.serving.faults.Overloaded`), per-request deadlines
+(``deadline_ms``, or an ambient :func:`~unionml_tpu.serving.faults
+.deadline_scope`) shed at dequeue before they consume prefill, and a
+**circuit breaker** that rejects fast while the engine is repeatedly
+failing to rebuild. A failed device program no longer kills every
+in-flight request: :meth:`_recover` fails only the poisoned batch (the
+resident occupants + the in-progress admission, whose donated device
+state the error invalidated), rebuilds decode state, and lets queued
+survivors re-admit; in-flight readbacks from the poisoned era are
+epoch-tagged and never materialized. :meth:`drain` stops admissions and
+finishes in-flight streams for graceful shutdown/redeploy, and a
+:class:`~unionml_tpu.serving.faults.FaultInjector` provides the
+deterministic injection points that make all of the above CPU-testable.
 """
 
 from __future__ import annotations
@@ -77,7 +93,7 @@ import math
 import queue
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, List, Optional, Sequence
 
@@ -85,6 +101,12 @@ import numpy as np
 
 from unionml_tpu import telemetry
 from unionml_tpu._logging import logger
+from unionml_tpu.serving.faults import (
+    DeadlineExceeded,
+    EngineUnavailable,
+    Overloaded,
+    current_deadline_ms,
+)
 
 __all__ = ["DecodeEngine"]
 
@@ -179,6 +201,9 @@ class _Request:
     ttft_ms: float = 0.0
     abandoned: bool = False             # waiter gave up (timeout): retire asap
     rid: str = ""                       # telemetry trace-span request id
+    # absolute perf_counter deadline (None = none): checked at DEQUEUE,
+    # so an expired request is shed before it consumes prefill
+    deadline: Optional[float] = None
     _prefill_end: float = 0.0
     _dispatch_t: float = 0.0
     _expected: int = 0                  # tokens covered by dispatched work
@@ -280,6 +305,28 @@ class DecodeEngine:
             ``GET /metrics`` covers this engine automatically and every
             request's ``queue → prefill → decode-chunk[i] → harvest``
             spans land in the exportable trace.
+        max_queue_depth: admission control — submissions beyond this
+            many queued (not-yet-admitted) requests raise
+            :class:`~unionml_tpu.serving.faults.Overloaded` instead of
+            queueing unboundedly (the transports map it to HTTP 429
+            with ``Retry-After``). ``None`` (default) keeps the
+            historical unbounded queue.
+        breaker_threshold/breaker_window_s/breaker_cooldown_s: the
+            circuit breaker — ``breaker_threshold`` recoveries within
+            ``breaker_window_s`` seconds open it for
+            ``breaker_cooldown_s`` seconds, during which submissions
+            fail fast with :class:`~unionml_tpu.serving.faults
+            .EngineUnavailable` and ``health()`` reports ``degraded``
+            (a persistently-poisoned device must shed load, not grind
+            every request through another doomed rebuild). Any
+            successfully completed request closes the failure window.
+        fault_injector: a :class:`~unionml_tpu.serving.faults
+            .FaultInjector` whose ``engine.prefill`` /
+            ``engine.dispatch`` / ``engine.harvest`` /
+            ``engine.dequeue`` points this engine fires — the chaos
+            harness that makes recovery, shedding, and breaker behavior
+            deterministically reproducible in CPU-only tests. ``None``
+            (production default) is zero-cost.
     """
 
     def __init__(
@@ -305,6 +352,11 @@ class DecodeEngine:
         prefix_cache=None,
         registry: Optional[telemetry.MetricsRegistry] = None,
         tracer: Optional[telemetry.TraceRecorder] = None,
+        max_queue_depth: Optional[int] = None,
+        breaker_threshold: int = 3,
+        breaker_window_s: float = 30.0,
+        breaker_cooldown_s: float = 5.0,
+        fault_injector=None,
     ):
         import jax
 
@@ -368,6 +420,26 @@ class DecodeEngine:
         self.eos_id = eos_id
         self.pad_id = pad_id
         self.submit_timeout = submit_timeout
+        # fault tolerance: admission control + supervision knobs
+        if max_queue_depth is not None and max_queue_depth < 1:
+            raise ValueError("max_queue_depth must be >= 1 when set")
+        if breaker_threshold < 1:
+            raise ValueError("breaker_threshold must be >= 1")
+        self.max_queue_depth = max_queue_depth
+        self.breaker_threshold = breaker_threshold
+        self.breaker_window_s = breaker_window_s
+        self.breaker_cooldown_s = breaker_cooldown_s
+        self._faults = fault_injector
+        self._draining = False
+        self._breaker_open_until = 0.0
+        # recovery timestamps within the breaker window (lock-guarded);
+        # cleared on any successful completion, so only CONSECUTIVE
+        # rebuild failures accumulate toward the threshold
+        self._recovery_times: "deque[float]" = deque()
+        # bumped by _recover: in-flight readbacks dispatched under an
+        # older epoch belong to the poisoned era and are never
+        # materialized (their requests were already failed)
+        self._epoch = 0
         # telemetry sinks before the cache: a default-constructed cache
         # registers its series in the engine's registry
         self._registry = registry if registry is not None else telemetry.get_registry()
@@ -592,10 +664,154 @@ class DecodeEngine:
             "unionml_engine_spec_accepted_tokens_total",
             "Draft tokens accepted by the target verify forward.",
         )
+        # fault tolerance: admission control / supervision series
+        rejected = R.counter(
+            "unionml_engine_rejected_total",
+            "Submissions rejected at admission control, by reason "
+            "(queue_full -> 429, breaker_open/draining -> 503).",
+            ("engine", "reason"),
+        )
+        self._m_rejected = {
+            reason: rejected.labels(engine=self.instance, reason=reason)
+            for reason in ("queue_full", "breaker_open", "draining")
+        }
+        self._m_deadline_shed = counter(
+            "unionml_engine_deadline_shed_total",
+            "Requests shed at dequeue because their deadline expired "
+            "before prefill (no device work burned).",
+        )
+        self._m_recoveries = counter(
+            "unionml_engine_recoveries_total",
+            "Supervised recoveries: a failed device program failed only "
+            "its poisoned batch and the decode state was rebuilt.",
+        )
+        self._g_breaker = R.gauge(
+            "unionml_engine_breaker_open",
+            "1 while the circuit breaker rejects submissions.",
+            ("engine",),
+        ).labels(**lbl)
+        self._g_queue_depth = R.gauge(
+            "unionml_engine_queue_depth",
+            "Requests queued awaiting admission.", ("engine",),
+        ).labels(**lbl)
+        self._h_drain = hist(
+            "unionml_engine_drain_ms",
+            "drain() wall time: stop-admissions to queue+slots idle.",
+        )
 
     def _slots_in_use_locked(self) -> int:
         """Occupied-slot count; call with the lock held."""
         return sum(1 for r in self._occupant if r is not None)
+
+    def _fire(self, point: str) -> None:
+        """Chaos-injection site (zero-cost without an injector)."""
+        if self._faults is not None:
+            self._faults.fire(point)
+
+    @property
+    def breaker_open(self) -> bool:
+        """True while the circuit breaker rejects submissions (the
+        cooldown after ``breaker_threshold`` recoveries in the window).
+        Reading it keeps the ``unionml_engine_breaker_open`` gauge
+        honest — the breaker closes by TIME passing, not by an event."""
+        is_open = time.monotonic() < self._breaker_open_until
+        self._g_breaker.set(1.0 if is_open else 0.0)
+        return is_open
+
+    def _gated_submit(self, reqs: List[_Request]) -> None:
+        """Admission control + enqueue, atomically under the engine
+        lock (shared by ``generate`` and ``generate_stream``): reject
+        BEFORE any request is enqueued, so a multi-prompt call never
+        partially admits — and so N concurrent submitters cannot each
+        pass a depth check and push the queue past ``max_queue_depth``
+        (the exact overload the bound exists for)."""
+        with self._lock:
+            self._admission_gate_locked(len(reqs))
+            for req in reqs:
+                self._queue.put(req)
+        self._g_queue_depth.set(self._queue.qsize())
+
+    def _admission_gate_locked(self, n_new: int) -> None:
+        if self._draining:
+            self._m_rejected["draining"].inc(n_new)
+            raise EngineUnavailable(
+                "decode engine is draining and not accepting requests",
+                reason="draining", retry_after_s=1.0,
+            )
+        remaining = self._breaker_open_until - time.monotonic()
+        if remaining > 0:
+            self._m_rejected["breaker_open"].inc(n_new)
+            raise EngineUnavailable(
+                "decode engine circuit breaker is open "
+                f"({len(self._recovery_times)} recent recovery failures); "
+                f"retry in {remaining:.1f}s",
+                reason="breaker_open", retry_after_s=max(0.1, remaining),
+            )
+        if self.max_queue_depth is not None:
+            depth = self._queue.qsize()
+            if depth + n_new > self.max_queue_depth:
+                self._m_rejected["queue_full"].inc(n_new)
+                raise Overloaded(
+                    f"decode engine queue is full ({depth} queued + "
+                    f"{n_new} new > max_queue_depth "
+                    f"{self.max_queue_depth})",
+                    retry_after_s=1.0,
+                )
+
+    def health(self) -> dict:
+        """Readiness surface for ``GET /health``: ``status`` is ``ok``,
+        ``degraded`` (circuit breaker open), or ``draining``; plus the
+        queue depth and breaker state the transports report."""
+        breaker = self.breaker_open
+        if self._draining:
+            status = "draining"
+        elif breaker:
+            status = "degraded"
+        else:
+            status = "ok"
+        return {
+            "status": status,
+            "queue_depth": self._queue.qsize(),
+            "breaker_open": breaker,
+        }
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain: stop admitting (new submissions raise
+        :class:`~unionml_tpu.serving.faults.EngineUnavailable` and
+        ``health()`` flips to ``draining``), then block until every
+        queued and in-flight request — streams included — has finished
+        and all readbacks are harvested. Returns True when fully
+        drained, False on ``timeout`` (work may still be in flight;
+        admissions stay stopped either way). Reversible with
+        :meth:`resume`; observability lands in the
+        ``unionml_engine_drain_ms`` histogram."""
+        t0 = time.perf_counter()
+        self._draining = True
+        drained = False
+        while True:
+            with self._lock:
+                drained = (
+                    self._queue.empty()
+                    and self._admitting == 0
+                    and self._admission is None
+                    and all(r is None for r in self._occupant)
+                    and self._inflight.empty()
+                )
+            if drained:
+                break
+            if (
+                timeout is not None
+                and time.perf_counter() - t0 > timeout
+            ):
+                break
+            time.sleep(0.005)
+        self._h_drain.observe((time.perf_counter() - t0) * 1e3)
+        return drained
+
+    def resume(self) -> None:
+        """Reopen admissions after :meth:`drain` (rolling-restart flows
+        that drain, swap weights via :meth:`bind`, and serve again)."""
+        self._draining = False
 
     # ------------------------------------------------------------------ #
     # device programs (compiled once per shape)
@@ -1030,12 +1246,20 @@ class DecodeEngine:
         prompts: Sequence[Sequence[int]],
         *,
         max_new_tokens: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
     ) -> list:
         """Generate for a list of token-id prompts; blocks until all done.
 
         Compatible with the ``make_lm_predictor`` row-lists contract:
         returns one token list per prompt. ``params`` binds on first call
         (pass serving-ready weights — cast/quantized).
+
+        ``deadline_ms`` (or an ambient :func:`~unionml_tpu.serving
+        .faults.deadline_scope` — how ``X-Deadline-Ms`` reaches here
+        through the transports) bounds each request's total latency:
+        still-queued requests whose deadline expires are shed at
+        dequeue with :class:`~unionml_tpu.serving.faults
+        .DeadlineExceeded`, before they consume prefill.
         """
         self.bind(params)
         n = max_new_tokens if max_new_tokens is not None else self.max_new_tokens
@@ -1044,7 +1268,11 @@ class DecodeEngine:
                 f"max_new_tokens {n} outside [1, {self.max_new_tokens}] "
                 "(raise the engine's max_new_tokens)"
             )
-        reqs = []
+        if deadline_ms is None:
+            deadline_ms = current_deadline_ms()
+        # validate EVERY prompt before creating any request or trace
+        # rid, so a bad later prompt cannot leak earlier ones' state
+        rows = []
         for p in prompts:
             row = np.asarray(p, dtype=np.int32).ravel()
             if row.size == 0:
@@ -1054,10 +1282,23 @@ class DecodeEngine:
             row = row[-self._user_max:]
             if self._prefix_tokens is not None:
                 row = np.concatenate([self._prefix_tokens, row])
+            rows.append(row)
+        reqs = []
+        for row in rows:
             req = _Request(prompt=row, max_new_tokens=n)
+            if deadline_ms is not None:
+                req.deadline = req.submitted + deadline_ms / 1e3
             req.rid = self._tracer.new_request("generate")
-            self._queue.put(req)
             reqs.append(req)
+        try:
+            self._gated_submit(reqs)
+        except BaseException:
+            # rejected before enqueue: close the trace timelines or the
+            # recorder leaks one live request per shed submission —
+            # precisely under the sustained overload shedding exists for
+            for req in reqs:
+                self._tracer.finish_request(req.rid)
+            raise
         out = []
         for req in reqs:
             if not req.event.wait(self.submit_timeout):
@@ -1079,6 +1320,7 @@ class DecodeEngine:
         prompt: Sequence[int],
         *,
         max_new_tokens: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
     ):
         """Yield token chunks for ONE prompt as the engine harvests them.
 
@@ -1097,6 +1339,8 @@ class DecodeEngine:
                 f"max_new_tokens {n} outside [1, {self.max_new_tokens}] "
                 "(raise the engine's max_new_tokens)"
             )
+        if deadline_ms is None:
+            deadline_ms = current_deadline_ms()
         row = np.asarray(prompt, dtype=np.int32).ravel()
         if row.size == 0:
             raise ValueError("empty prompt")
@@ -1104,8 +1348,14 @@ class DecodeEngine:
         if self._prefix_tokens is not None:
             row = np.concatenate([self._prefix_tokens, row])
         req = _Request(prompt=row, max_new_tokens=n, stream=queue.Queue())
+        if deadline_ms is not None:
+            req.deadline = req.submitted + deadline_ms / 1e3
         req.rid = self._tracer.new_request("stream")
-        self._queue.put(req)
+        try:
+            self._gated_submit([req])
+        except BaseException:
+            self._tracer.finish_request(req.rid)  # no leak on rejection
+            raise
         try:
             while True:
                 try:
@@ -1239,6 +1489,17 @@ class DecodeEngine:
             }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
+        out["robustness"] = {
+            "queue_depth": self._queue.qsize(),
+            "rejected": {
+                reason: int(c.value)
+                for reason, c in self._m_rejected.items()
+            },
+            "deadline_shed": int(self._m_deadline_shed.value),
+            "recoveries": int(self._m_recoveries.value),
+            "breaker_open": self.breaker_open,
+            "draining": self._draining,
+        }
         for name, h in (
             ("queue_wait_ms", self._h_queue),
             ("prefill_ms", self._h_prefill),
@@ -1258,8 +1519,10 @@ class DecodeEngine:
             self._m_requests, self._m_errors, self._m_abandoned,
             self._m_timeouts, self._m_steps, self._m_chunks,
             self._m_occupied, self._m_spec_rounds, self._m_spec_accepted,
+            self._m_deadline_shed, self._m_recoveries,
+            *self._m_rejected.values(),
             self._h_queue, self._h_prefill, self._h_decode, self._h_ttft,
-            self._h_dispatch, self._h_harvest,
+            self._h_dispatch, self._h_harvest, self._h_drain,
         ):
             m.reset()
         if self.prefix_cache is not None:
@@ -1282,7 +1545,7 @@ class DecodeEngine:
             except queue.Empty:
                 break
             if entry[0] == "insert":
-                self._release_lease(entry[1])
+                self._release_lease(entry[2])
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -1339,18 +1602,36 @@ class DecodeEngine:
 
         slot, _bucket, padded = self._admission_preamble(req)
         (key,) = self._next_key()
-        self._state, first = self._prefill(
-            self._params, self._state, jnp.int32(slot), jnp.asarray(padded),
+        with self._lock:
+            ep0 = self._epoch
+            st = self._state
+        if st is None:
+            st = self._init_state()
+        new_state, first = self._prefill(
+            self._params, st, jnp.int32(slot), jnp.asarray(padded),
             jnp.int32(len(req.prompt)), key,
         )
         _start_host_copy(first)
         with self._lock:
+            if self._epoch != ep0:
+                # _recover ran (harvester thread) while this prefill was
+                # in flight: new_state derives from the invalidated
+                # resident buffers — DISCARD it (self._state stays the
+                # recovery's None, so the next admission rebuilds) and
+                # fail this request with the poisoned batch (the raise
+                # lands in _start_admission's error path).
+                raise RuntimeError(
+                    "engine recovered while this admission's prefill "
+                    "was in flight; the request failed with the "
+                    "poisoned batch"
+                )
+            self._state = new_state
             self._occupant[slot] = req
             self._slot_gen[slot] += 1
             req._expected = 1
             self._m_slots_busy.set(self._slots_in_use_locked())
-        self._inflight.put(("prefill", slot, req, first))
-        self._schedule_insert(req, slot)
+        self._inflight.put(("prefill", ep0, slot, req, first))
+        self._schedule_insert(req, slot, ep0)
 
     def _device_splice_rows(self, blocks):
         """Device-resident rows for one splice unit (a tuple of cached
@@ -1374,7 +1655,7 @@ class DecodeEngine:
             self._dev_splice.popitem(last=False)
         return dev
 
-    def _schedule_insert(self, req: _Request, slot: int) -> None:
+    def _schedule_insert(self, req: _Request, slot: int, epoch: int) -> None:
         """Dispatcher, right after a prefill dispatch: extract the
         slot's leading resident rows in ONE compiled dispatch, kick the
         async device→host copy, and queue the tree insert behind the
@@ -1390,17 +1671,18 @@ class DecodeEngine:
             return
         nb = len(req.prompt) // cache.block_size
         first_new = min(req._matched_blocks, nb)
-        if first_new >= nb:
+        st = self._state  # one read: _recover may null it concurrently
+        if first_new >= nb or st is None:
             rows = None  # nothing new to store — release-only entry
         else:
             rows = self._extract_rows(
-                self._state["cache"], jnp.int32(slot),
+                st["cache"], jnp.int32(slot),
                 n=self._bucket_for(len(req.prompt)),
             )
             for layer in rows:
                 for buf in layer:
                     _start_host_copy(buf)
-        self._inflight.put(("insert", req, first_new, rows))
+        self._inflight.put(("insert", epoch, req, first_new, rows))
 
     def _release_lease(self, req: _Request) -> None:
         """Unpin the request's matched cache blocks (idempotent; error
@@ -1434,6 +1716,10 @@ class DecodeEngine:
                 self._h_decode.observe(req.decode_ms)
                 self._h_ttft.observe(req.ttft_ms)
                 self._m_requests.inc()
+                # a successful completion proves the rebuilt state
+                # serves: only CONSECUTIVE rebuild failures accumulate
+                # toward the circuit breaker
+                self._recovery_times.clear()
             else:
                 self._m_abandoned.inc()
             self._occupant[slot] = None
@@ -1450,6 +1736,18 @@ class DecodeEngine:
         dispatch order, so a slot's prefill token always lands before its
         decode tokens and before any reuse of the slot."""
         self._harvest_t0 = time.perf_counter()
+        with self._lock:
+            cur_epoch = self._epoch
+        if entry[1] != cur_epoch:
+            # poisoned-era readback: _recover already failed its
+            # requests and the donated device buffers it references may
+            # be invalid — never materialize them. An insert entry
+            # still releases its lease (idempotent) so recovery can
+            # never leak a prefix-cache pin.
+            if entry[0] == "insert":
+                self._release_lease(entry[2])
+            return
+        self._fire("engine.harvest")
         if entry[0] == "insert":
             # prompt blocks back into the radix tree: materialize the
             # (already-local, copy kicked at dispatch) host bytes, split
@@ -1459,7 +1757,7 @@ class DecodeEngine:
             # insert must never fail the request — the same device error
             # would already have surfaced through the request's own
             # prefill readback, which precedes this entry.
-            _, req, first_new, rows = entry
+            _, _, req, first_new, rows = entry
             try:
                 if rows is not None and self.prefix_cache is not None:
                     blk = self.prefix_cache.block_size
@@ -1485,7 +1783,7 @@ class DecodeEngine:
                 self._release_lease(req)
             return
         if entry[0] == "prefill":
-            _, slot, req, first = entry
+            _, _, slot, req, first = entry
             tok = int(np.asarray(first))
             now = time.perf_counter()  # after the readback: prefill_ms
             with self._lock:           # includes its in-flight lag
@@ -1500,7 +1798,7 @@ class DecodeEngine:
                 req.emit([tok])
                 self._finish_if_done(slot, tok)
             return
-        _, mask, gens, toks, dispatched = entry
+        _, _, mask, gens, toks, dispatched = entry
         if self.draft is not None:
             self._process_spec_chunk(mask, gens, toks, dispatched)
             return
@@ -1591,15 +1889,18 @@ class DecodeEngine:
                 r is not None and r._expected < r.max_new_tokens
                 for r in self._occupant
             )
-        if not mask.any() or not needed:
+            ep0 = self._epoch
+            st = self._state
+        if not mask.any() or not needed or st is None:
             return False
         if not self._chunk_credits.acquire(blocking=False):
             return False  # pipeline_depth chunks already awaiting harvest
         t_dispatch = time.perf_counter()
         try:
+            self._fire("engine.dispatch")
             keys = jnp.stack(self._next_key(self.chunk_steps))
-            self._state, toks = self._decode_chunk(
-                self._params, self._state, jnp.asarray(mask), keys
+            new_state, toks = self._decode_chunk(
+                self._params, st, jnp.asarray(mask), keys
             )
             for leaf in toks if isinstance(toks, tuple) else (toks,):
                 _start_host_copy(leaf)
@@ -1610,6 +1911,14 @@ class DecodeEngine:
             self._chunk_credits.release()
             raise
         with self._lock:
+            if self._epoch != ep0:
+                # _recover ran (harvester thread) mid-dispatch: new_state
+                # derives from the invalidated buffers — discard it
+                # (self._state stays the recovery's None) and drop the
+                # readback; the requests it covered are already failed
+                self._chunk_credits.release()
+                return True
+            self._state = new_state
             for slot in np.flatnonzero(mask):
                 if self._occupant[slot] is not None:
                     # the GUARANTEED emission per chunk (1 token/round in
@@ -1624,13 +1933,14 @@ class DecodeEngine:
             self._m_chunks.inc()
             self._m_steps.inc(self.chunk_steps)
             self._m_occupied.inc(int(mask.sum()) * self.chunk_steps)
-        self._inflight.put(("chunk", mask, gens, toks, t_dispatch))
+        self._inflight.put(("chunk", ep0, mask, gens, toks, t_dispatch))
         return True
 
     def _pop_request(self) -> Optional[_Request]:
         """Atomically dequeue a request and mark it as mid-admission, so
         bind()'s busy check never sees a gap where the request is neither
         queued nor occupying a slot."""
+        self._fire("engine.dequeue")
         with self._lock:
             if None not in self._occupant:
                 return None
@@ -1639,12 +1949,13 @@ class DecodeEngine:
             except queue.Empty:
                 return None
             self._admitting += 1
+        self._g_queue_depth.set(self._queue.qsize())
         return req
 
     def _drop_admission(self, req: _Request, exc: BaseException) -> None:
         """Fail a request still mid-admission and release its count.
         Idempotent (keyed on the request event): the dispatcher's own
-        error path and a concurrent ``_fail_all`` from the harvester must
+        error path and a concurrent ``_recover`` from the harvester must
         not double-release ``_admitting``."""
         with self._lock:
             if req.event.is_set():
@@ -1652,7 +1963,12 @@ class DecodeEngine:
             req.error = exc
             self._admitting -= 1
         self._release_lease(req)
-        (self._m_abandoned if req.abandoned else self._m_errors).inc()
+        if req.abandoned:
+            self._m_abandoned.inc()
+        elif isinstance(exc, DeadlineExceeded):
+            self._m_deadline_shed.inc()
+        else:
+            self._m_errors.inc()
         self._tracer.finish_request(req.rid)
         req.event.set()
         req.finish_stream()
@@ -1674,8 +1990,21 @@ class DecodeEngine:
                     req, TimeoutError("request abandoned before admission")
                 )
                 return
-            if self._state is None:
-                self._state = self._init_state()
+            if req.deadline is not None and time.perf_counter() > req.deadline:
+                # shed at dequeue: an expired request must never consume
+                # prefill (under overload that device time is exactly
+                # what the live requests behind it need)
+                waited_ms = (time.perf_counter() - req.submitted) * 1e3
+                self._drop_admission(req, DeadlineExceeded(
+                    f"request deadline expired while queued "
+                    f"(waited {waited_ms:.0f} ms)",
+                    deadline_ms=(req.deadline - req.submitted) * 1e3,
+                ))
+                return
+            self._fire("engine.prefill")
+            # the resident state inits lazily inside _admit / the final
+            # chunk of _advance_admission (NOT here: an unlocked write
+            # would race a concurrent _recover's reset)
             cache, m_used = self.prefix_cache, 0
             bucket = self._bucket_for(len(req.prompt))
             chunk = self.prefill_chunk
@@ -1749,7 +2078,7 @@ class DecodeEngine:
         block splice, a lead prefill chunk, or the final chunk that
         finishes into the slot; decode chunks dispatch between calls, so
         resident slots never stall behind a long prompt's prefill.
-        ``_fail_all``/``close`` may concurrently null ``_admission`` —
+        ``_recover``/``close`` may concurrently null ``_admission`` —
         every transition re-checks identity under the lock so the
         admission is completed or dropped exactly once."""
         import jax.numpy as jnp
@@ -1765,6 +2094,7 @@ class DecodeEngine:
                     req, TimeoutError("request abandoned during admission")
                 )
                 return
+            self._fire("engine.prefill")
             if adm.next_splice < len(adm.splice_rows):
                 # cached-prefix unit: device-resident rows (memoized
                 # host→device upload) spliced into the fresh cache in
@@ -1796,24 +2126,42 @@ class DecodeEngine:
                 adm.next_chunk += 1
                 return
             (key,) = self._next_key()
-            self._state, first = self._prefill_final(
-                self._params, self._state, adm.fresh, jnp.int32(adm.slot),
+            with self._lock:
+                ep0 = self._epoch
+                st = self._state
+                if self._admission is not adm:
+                    # raced with _recover/close: the request was already
+                    # failed and its count released — do not re-admit
+                    return
+            if st is None:
+                # first admission ever, or a recovery dropped the
+                # resident state while this admission was mid-flight but
+                # BEFORE it was registered (so _recover could not drop
+                # it): build it fresh and proceed — returning here
+                # instead would strand the admission (never completed,
+                # never dropped) and wedge the engine
+                st = self._init_state()
+            new_state, first = self._prefill_final(
+                self._params, st, adm.fresh, jnp.int32(adm.slot),
                 toks, jnp.int32(start), jnp.int32(len(req.prompt)), key,
             )
             _start_host_copy(first)
             with self._lock:
-                if self._admission is not adm:
-                    # raced with _fail_all/close: the request was already
-                    # failed and its count released — do not re-admit
+                if self._admission is not adm or self._epoch != ep0:
+                    # raced with _recover/close mid-dispatch: the request
+                    # was already failed, and new_state derives from the
+                    # invalidated buffers — discard it (self._state stays
+                    # the recovery's None)
                     return
+                self._state = new_state
                 self._admission = None
                 self._occupant[adm.slot] = req
                 self._slot_gen[adm.slot] += 1
                 req._expected = 1
                 self._admitting -= 1
                 self._m_slots_busy.set(self._slots_in_use_locked())
-            self._inflight.put(("prefill", adm.slot, req, first))
-            self._schedule_insert(req, adm.slot)
+            self._inflight.put(("prefill", ep0, adm.slot, req, first))
+            self._schedule_insert(req, adm.slot, ep0)
             if self.prefix_cache is not None and req._saved_tokens:
                 # the admission actually completed on spliced rows —
                 # NOW the skipped prefill work is real
@@ -1854,7 +2202,7 @@ class DecodeEngine:
                     # keeps the 1-core host responsive without spinning)
                     time.sleep(0.002)
             except BaseException as exc:  # pragma: no cover - engine crash
-                self._fail_all(exc)
+                self._recover(exc)
 
     def _harvest_loop(self):
         """Harvester: block on the oldest in-flight readback, account its
@@ -1867,18 +2215,34 @@ class DecodeEngine:
             try:
                 self._process_entry(entry)
             except BaseException as exc:  # pragma: no cover - engine crash
-                self._fail_all(exc)
+                self._recover(exc)
             finally:
                 if entry[0] == "chunk":
                     self._chunk_credits.release()
 
-    def _fail_all(self, exc: BaseException) -> None:
-        logger.info(f"decode engine error: {exc!r}")
+    def _recover(self, exc: BaseException) -> None:
+        """Engine supervision (replaces the old terminal ``_fail_all``):
+        a failed device program fails ONLY the poisoned batch — the
+        resident occupants and the in-progress admission, whose donated
+        device state the error invalidated — then bumps the readback
+        epoch (in-flight entries from the poisoned era are skipped at
+        harvest, leases released) and drops the decode state so the
+        next admission rebuilds it; queued requests were never touched
+        and re-admit as survivors. Each recovery feeds the circuit
+        breaker: ``breaker_threshold`` of them within
+        ``breaker_window_s`` (with no successful completion in between)
+        open it for ``breaker_cooldown_s``."""
+        t0 = time.perf_counter()
+        logger.info(
+            f"decode engine error: {exc!r} — failing the poisoned batch "
+            "and rebuilding decode state"
+        )
         with self._lock:
             adm, self._admission = self._admission, None
         if adm is not None:
             self._drop_admission(adm.req, exc)
         with self._lock:
+            self._epoch += 1
             for slot, req in enumerate(self._occupant):
                 if req is not None:
                     req.error = exc
@@ -1889,4 +2253,27 @@ class DecodeEngine:
                     req.finish_stream()
                     self._occupant[slot] = None
             self._m_slots_busy.set(0)
-        self._state = None
+            self._state = None
+            self._m_recoveries.inc()
+            now = time.monotonic()
+            self._recovery_times.append(now)
+            while (
+                self._recovery_times
+                and now - self._recovery_times[0] > self.breaker_window_s
+            ):
+                self._recovery_times.popleft()
+            if len(self._recovery_times) >= self.breaker_threshold:
+                self._breaker_open_until = now + self.breaker_cooldown_s
+                self._g_breaker.set(1.0)
+                logger.info(
+                    f"engine circuit breaker OPEN: "
+                    f"{len(self._recovery_times)} recoveries within "
+                    f"{self.breaker_window_s}s; rejecting submissions "
+                    f"for {self.breaker_cooldown_s}s"
+                )
+        # the recovery itself is a traceable event (spans are how the
+        # PR-1 telemetry narrates a request timeline; recoveries get
+        # their own synthetic timeline)
+        rid = self._tracer.new_request("recovery")
+        self._tracer.record_span(rid, "recover", t0, time.perf_counter())
+        self._tracer.finish_request(rid)

@@ -13,6 +13,14 @@ import time
 from typing import Any, Optional
 
 from unionml_tpu import telemetry
+from unionml_tpu.serving.faults import (
+    DeadlineExceeded,
+    EngineUnavailable,
+    Overloaded,
+    deadline_scope,
+    http_fault_response,
+    parse_deadline_header,
+)
 from unionml_tpu.serving.http import ServingApp
 
 
@@ -43,7 +51,7 @@ def serving_app(
         return core
 
     try:
-        from fastapi import FastAPI, HTTPException  # gated optional import
+        from fastapi import FastAPI, HTTPException, Request  # gated optional import
         from fastapi.responses import HTMLResponse
     except ImportError as exc:
         raise ImportError(
@@ -63,32 +71,60 @@ def serving_app(
     def root():  # reference: fastapi.py:36-48
         return core.root()
 
+    def _parse_deadline(request) -> Optional[float]:
+        try:  # the shared parser: the two transports cannot drift
+            return parse_deadline_header(request.headers.get("x-deadline-ms"))
+        except ValueError as exc:
+            raise HTTPException(status_code=422, detail=str(exc))
+
+    def _fault_http(exc: Exception) -> "HTTPException":
+        """The faults.http_fault_response contract (429/503 +
+        Retry-After, 504) — same mapping the stdlib transport sends."""
+        status, extra = http_fault_response(exc)
+        return HTTPException(
+            status_code=status, detail=str(exc), headers=extra or None
+        )
+
+    _FAULTS = (Overloaded, EngineUnavailable, DeadlineExceeded)
+
+    # sync `def` (here and on /predict/stream), not `async def`: FastAPI
+    # then runs the blocking predictor call in the threadpool instead of
+    # freezing the event loop — and the thread-local deadline_scope
+    # stays on the thread that performs the engine/batcher submission.
     @app.post("/predict")
-    async def predict(payload: dict):  # reference: fastapi.py:50-64
+    def predict(payload: dict, request: Request):  # reference: fastapi.py:50-64
         try:
-            return core.predict(payload)
+            with deadline_scope(_parse_deadline(request)):
+                return core.predict(payload)
+        except _FAULTS as exc:
+            raise _fault_http(exc)
         except (ValueError, KeyError, TypeError) as exc:
             raise HTTPException(status_code=422, detail=str(exc))
 
-    # sync `def`, not `async def`: FastAPI then runs it (and the body's
-    # blocking first-chunk pull — queue + prefill, ~120 ms at 8B, up to
-    # submit_timeout on a wedged engine) in the threadpool instead of
-    # freezing the event loop for every other request. The wire framing
-    # comes from the shared core.predict_stream_events, so the two
-    # transports cannot drift.
+    # the body's blocking first-chunk pull — queue + prefill, ~120 ms at
+    # 8B, up to submit_timeout on a wedged engine — also runs in the
+    # threadpool. The wire framing comes from the shared
+    # core.predict_stream_events, so the two transports cannot drift.
     @app.post("/predict/stream")
-    def predict_stream(payload: dict):  # SSE token streaming
+    def predict_stream(payload: dict, request: Request):  # SSE token streaming
         from fastapi.responses import StreamingResponse
 
         try:
-            frames = core.predict_stream_events(payload)
+            with deadline_scope(_parse_deadline(request)):
+                frames = core.predict_stream_events(payload)
+        except _FAULTS as exc:
+            raise _fault_http(exc)
         except (ValueError, KeyError, TypeError) as exc:
             raise HTTPException(status_code=422, detail=str(exc))
         return StreamingResponse(frames, media_type="text/event-stream")
 
     @app.get("/health")
     async def health():  # reference: fastapi.py:66-70
-        return core.health()
+        from fastapi.responses import JSONResponse
+
+        h = core.health()
+        # same not-ready => 503 contract as the stdlib transport
+        return JSONResponse(h, status_code=core.health_status(h))
 
     @app.get("/stats")
     async def stats():  # no reference counterpart: latency attribution

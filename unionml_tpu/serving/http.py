@@ -14,7 +14,12 @@ Same endpoint surface as the reference's FastAPI app
   concatenated chunks are identical to the ``/predict`` response. Time
   to first token ≈ queue + prefill, not the full generation — the
   latency win streaming exists for.
-- ``GET /health`` — ``{"status": "ok", "model_loaded": bool}``,
+- ``GET /health`` — readiness:
+  ``{"status": ok|degraded|draining, "model_loaded": bool,
+  "queue_depth": int, "breaker_open": bool}`` sourced from the active
+  engine/batcher (``health=`` hook); any status other than ``ok``
+  answers **503** on both transports so load balancers stop routing
+  here (docs/robustness.md),
 - ``GET /stats`` — serving observability: per-request queue-wait /
   prefill / decode (or device) time splits — plus a ``ttft_ms``
   percentile from the engine, and a ``prefix_cache`` section
@@ -29,6 +34,17 @@ Same endpoint surface as the reference's FastAPI app
 Every response carries an ``X-Request-ID`` header (a generated
 telemetry request id) and lands in the per-endpoint
 ``unionml_http_requests_total`` / ``unionml_http_request_ms`` series.
+
+Fault tolerance at the transport boundary (docs/robustness.md): an
+``X-Deadline-Ms`` request header opens a :func:`~unionml_tpu.serving
+.faults.deadline_scope` around the predictor call, so engine/batcher
+submissions shed the request once the budget expires; typed serving
+errors map to statuses — :class:`~unionml_tpu.serving.faults
+.Overloaded` → **429** with ``Retry-After``,
+:class:`~unionml_tpu.serving.faults.EngineUnavailable` (breaker open /
+draining) → **503** with ``Retry-After``, :class:`~unionml_tpu.serving
+.faults.DeadlineExceeded` → **504**. ``ServingApp.drain()`` stops
+admissions app-wide and flips ``/health`` to ``draining``/503.
 
 Startup model loading mirrors fastapi.py:22-34: ``UNIONML_MODEL_PATH``
 env first, then the remote registry when ``remote=True``.
@@ -48,6 +64,14 @@ import numpy as np
 
 from unionml_tpu import telemetry
 from unionml_tpu._logging import logger
+from unionml_tpu.serving.faults import (
+    DeadlineExceeded,
+    EngineUnavailable,
+    Overloaded,
+    deadline_scope,
+    http_fault_response,
+    parse_deadline_header,
+)
 
 # bound HTTP label cardinality: unknown paths share one series instead
 # of letting a scanner mint a metric per probed URL
@@ -93,6 +117,8 @@ class ServingApp:
         stream: Optional[Any] = None,
         extra_stats: Optional[dict] = None,
         registry: Optional[telemetry.MetricsRegistry] = None,
+        health: Optional[Any] = None,
+        drain: Optional[Any] = None,
         **batcher_kwargs,
     ):
         """``warmup``: optional callable invoked with the loaded model
@@ -120,7 +146,17 @@ class ServingApp:
         ``registry``: explicit :class:`~unionml_tpu.telemetry
         .MetricsRegistry` served at ``GET /metrics``; defaults to the
         process-global registry, so an engine or trainer built anywhere
-        in the process shows up in this app's scrape."""
+        in the process shows up in this app's scrape.
+
+        ``health``: optional zero-arg callable returning the readiness
+        dict merged into ``GET /health`` (``DecodeEngine.health`` when
+        the predictor wraps an engine); defaults to the micro-batcher's
+        when ``batch=True``. A non-``ok`` status answers 503.
+
+        ``drain``: optional callable (accepting one optional timeout
+        argument) invoked by :meth:`drain` — wire
+        ``DecodeEngine.drain`` so the app-level drain also finishes the
+        engine's in-flight streams; defaults to the micro-batcher's."""
         self.model = model
         self.remote = remote
         self.app_version = app_version
@@ -130,6 +166,9 @@ class ServingApp:
         self.warmup = warmup
         self._stats_fn = stats
         self._stream_fn = stream
+        self._health_fn = health
+        self._drain_fn = drain
+        self._draining = False
         self._extra_stats = dict(extra_stats or {})
         self._batcher = None
         self._batcher_kwargs = batcher_kwargs
@@ -194,7 +233,52 @@ class ServingApp:
         return LANDING_HTML.format(name=self.model.name)
 
     def health(self) -> dict:
-        return {"status": "ok", "model_loaded": self.model.artifact is not None}
+        """Readiness: ``status`` is ``ok`` / ``degraded`` (engine
+        circuit breaker open) / ``draining``, plus the queue depth and
+        breaker state from the active engine/batcher. Transports answer
+        503 for any non-``ok`` status (see :meth:`health_status`)."""
+        out = {
+            "status": "ok",
+            "model_loaded": self.model.artifact is not None,
+            "queue_depth": 0,
+            "breaker_open": False,
+        }
+        src = self._health_fn
+        if src is None and self._batcher is not None:
+            src = self._batcher.health
+        if src is not None:
+            out.update(src())
+        if self._draining:
+            # app-level drain overrides the component view: this
+            # process is going away even if the engine itself is idle
+            out["status"] = "draining"
+        return out
+
+    def health_status(self, health: dict) -> int:
+        """HTTP status for a :meth:`health` body: 503 whenever the app
+        is not ready to take traffic (degraded/draining), so load
+        balancers and k8s readiness probes stop routing here."""
+        return 200 if health.get("status") == "ok" else 503
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain: stop admitting (predict/stream answer 503,
+        ``/health`` flips to ``draining``) and delegate to the wired
+        component drain (``drain=`` hook, or the micro-batcher's) so
+        in-flight requests and streams finish. Returns True when fully
+        drained. The HTTP server keeps answering health/metrics —
+        shutdown is still :meth:`shutdown`."""
+        self._draining = True
+        fn = self._drain_fn
+        if fn is None and self._batcher is not None:
+            fn = self._batcher.drain
+        if fn is None:
+            return True
+        return bool(fn(timeout))
+
+    def resume(self) -> None:
+        """Reopen admissions after :meth:`drain` (the component's own
+        ``resume`` must be called separately if it was drained)."""
+        self._draining = False
 
     def stats(self) -> dict:
         if self._stats_fn is not None:
@@ -229,6 +313,11 @@ class ServingApp:
         self._h_http_ms.labels(transport, route).observe(duration_ms)
 
     def predict(self, payload: dict) -> Any:
+        if self._draining:
+            raise EngineUnavailable(
+                "serving app is draining and not accepting requests",
+                reason="draining", retry_after_s=1.0,
+            )
         if self.model.artifact is None:
             self.setup_model()
         inputs = payload.get("inputs")
@@ -253,6 +342,11 @@ class ServingApp:
         the reader-kwargs ``inputs`` form is not streamable because it
         runs the full predict workflow in one call.
         """
+        if self._draining:
+            raise EngineUnavailable(
+                "serving app is draining and not accepting requests",
+                reason="draining", retry_after_s=1.0,
+            )
         if self._stream_fn is None:
             raise ValueError(
                 "streaming is not enabled on this app — construct "
@@ -313,7 +407,8 @@ class ServingApp:
             def log_message(self, fmt, *args):
                 logger.info(f"http: {fmt % args}")
 
-            def _send(self, code: int, body: Any, content_type="application/json"):
+            def _send(self, code: int, body: Any, content_type="application/json",
+                      extra_headers: Optional[dict] = None):
                 data = (
                     body.encode() if isinstance(body, str) else json.dumps(body).encode()
                 )
@@ -322,6 +417,8 @@ class ServingApp:
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
                 self.send_header("X-Request-ID", self._rid)
+                for name, value in (extra_headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -349,7 +446,8 @@ class ServingApp:
                 if self.path == "/":
                     self._send(200, app.root(), content_type="text/html")
                 elif self.path == "/health":
-                    self._send(200, app.health())
+                    h = app.health()
+                    self._send(app.health_status(h), h)
                 elif self.path == "/stats":
                     self._send(200, app.stats())
                 elif self.path == "/metrics":
@@ -397,13 +495,33 @@ class ServingApp:
                     except json.JSONDecodeError as exc:
                         self._send(422, {"error": f"request body must be JSON: {exc}"})
                         return
-                    if self.path == "/predict/stream":
-                        # predict_stream_events validates (and pulls the
-                        # first chunk) BEFORE this point commits a 200 —
-                        # errors here still get a whole 422/500 response
-                        self._send_sse(app.predict_stream_events(payload))
-                    else:
-                        self._send(200, app.predict(payload))
+                    try:
+                        deadline_ms = parse_deadline_header(
+                            self.headers.get("X-Deadline-Ms")
+                        )
+                    except ValueError as exc:
+                        self._send(422, {"error": str(exc)})
+                        return
+                    # the scope makes the deadline visible to engine/
+                    # batcher submissions on this request thread without
+                    # threading a kwarg through every predictor wrapper
+                    with deadline_scope(deadline_ms):
+                        if self.path == "/predict/stream":
+                            # predict_stream_events validates (and pulls
+                            # the first chunk) BEFORE this point commits
+                            # a 200 — errors still get a whole 4xx/5xx
+                            self._send_sse(app.predict_stream_events(payload))
+                        else:
+                            self._send(200, app.predict(payload))
+                except (Overloaded, EngineUnavailable, DeadlineExceeded) as exc:
+                    # typed load shed: the faults.http_fault_response
+                    # contract (429/503 + Retry-After, 504) both
+                    # transports share
+                    status, extra = http_fault_response(exc)
+                    body = {"error": str(exc)}
+                    if isinstance(exc, EngineUnavailable):
+                        body["reason"] = exc.reason
+                    self._send(status, body, extra_headers=extra or None)
                 except (ValueError, KeyError, TypeError) as exc:
                     self._send(422, {"error": str(exc)})
                 except Exception as exc:  # unexpected: surface as 500
