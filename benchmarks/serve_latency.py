@@ -16,6 +16,10 @@ Usage::
     # ^ admission control under saturation: shed rate + accepted p99 on
     #   an over-admitted stream, and recovery time after an injected
     #   device fault (docs/robustness.md)
+    UNIONML_TPU_BENCH_PRESET=serve_introspection python benchmarks/serve_latency.py
+    # ^ program introspection: instrumentation-on vs -off wall delta
+    #   with token parity asserted, plus the decode program's measured
+    #   flops / recompiles / MFU (docs/observability.md)
 """
 
 from __future__ import annotations
@@ -546,6 +550,98 @@ def prefix_cache_engine_leg() -> None:
     }))
 
 
+def introspection_leg() -> None:
+    """Program-introspection overhead + hardware-truth report
+    (``UNIONML_TPU_BENCH_PRESET=serve_introspection``).
+
+    Runs the SAME request stream through a DecodeEngine with
+    introspection (cost-analysis tracker + MFU gauges + flight
+    recorder) OFF and ON, asserts the produced tokens are
+    bit-identical, and reports the wall-clock overhead delta — the
+    number that keeps the "introspection is off the steady-state hot
+    path" claim honest — plus the decode program's measured flops,
+    recompile count, and MFU/roofline ratios.
+    """
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu import telemetry
+    from unionml_tpu.models import Llama, LlamaConfig
+    from unionml_tpu.serving.engine import DecodeEngine
+
+    backend = jax.default_backend()
+    if backend == "cpu":
+        cfg = serving_config("tiny")
+        module = Llama(cfg)
+        tokens0 = jnp.zeros((1, 8), jnp.int32)
+        params = jax.jit(module.init)(jax.random.PRNGKey(0), tokens0)["params"]
+        n_req, new_tokens, bucket, slots, chunk_steps = 24, 8, 16, 4, 4
+    else:
+        cfg = serving_config("serve_1p5b")
+        qcfg = LlamaConfig(**{**cfg.__dict__, "quantized": True})
+        module = Llama(qcfg)
+        params = random_quantized_params(module)
+        n_req, new_tokens, bucket, slots, chunk_steps = 128, 32, 64, 8, 8
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, bucket // 2).tolist()
+        for _ in range(n_req)
+    ]
+    results = {}
+    for introspect in (False, True):
+        engine = DecodeEngine(
+            module, slots=slots, max_new_tokens=new_tokens,
+            prompt_buckets=(bucket,), chunk_steps=chunk_steps,
+            introspect=introspect,
+            # isolated sinks: the off leg must not even share a registry
+            registry=telemetry.MetricsRegistry(),
+            tracer=telemetry.TraceRecorder(),
+            flight=telemetry.FlightRecorder() if introspect else None,
+        )
+        try:
+            engine.warmup(params)
+            engine.reset_stats()
+            t0 = time.perf_counter()
+            outs = engine.generate(params, prompts)
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            results[introspect] = (outs, engine.stats(), wall_ms)
+        finally:
+            engine.close()
+    assert results[False][0] == results[True][0], (
+        "introspection changed produced tokens — parity violation"
+    )
+    off_ms, on_ms = results[False][2], results[True][2]
+    for introspect in (False, True):
+        print(json.dumps({
+            "metric": "serve_introspection_wall_ms",
+            "introspect": introspect,
+            "requests": n_req,
+            "new_tokens": new_tokens,
+            "value": round(results[introspect][2], 1),
+            "unit": "ms",
+        }))
+    programs = results[True][1]["programs"]
+    decode = programs["engine.decode"]
+    print(json.dumps({
+        "metric": "serve_introspection_summary",
+        "overhead_ms": round(on_ms - off_ms, 1),
+        "overhead_pct": round(100.0 * (on_ms - off_ms) / max(off_ms, 1e-9), 2),
+        "tokens_identical": True,
+        "decode_calls": decode["calls"],
+        "decode_compiles": decode["compiles"],
+        "decode_flops_per_call": decode["flops_per_call"],
+        "decode_bytes_per_call": decode["bytes_per_call"],
+        "decode_mfu": decode["mfu"],
+        "decode_hbm_utilization": decode["hbm_utilization"],
+        "device": programs["device"],
+        "unit": "ms",
+    }))
+
+
 def overload_leg() -> None:
     """Admission control + supervised recovery under saturation
     (``UNIONML_TPU_BENCH_PRESET=serve_overload``).
@@ -672,8 +768,8 @@ def overload_leg() -> None:
         ]
         for t in occ:
             t.start()
-        deadline = time.time() + 60
-        while time.time() < deadline:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
             with engine._lock:  # resident-count poll (bench-only peek)
                 if sum(r is not None for r in engine._occupant) == slots:
                     break
@@ -701,7 +797,18 @@ def overload_leg() -> None:
 
 
 if __name__ == "__main__":
-    if os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_overload":
+    if os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_introspection":
+        if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
+            os.environ.get("UNIONML_TPU_BENCH_PREFIX")
+        ):
+            # hardcoded workload, same rule as the other engine legs
+            raise SystemExit(
+                "UNIONML_TPU_BENCH_PRESET=serve_introspection takes no CLI "
+                f"flags or KV/PREFIX env legs (got {sys.argv[1:]}); its "
+                "workload is hardcoded in introspection_leg"
+            )
+        introspection_leg()
+    elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_overload":
         if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
             os.environ.get("UNIONML_TPU_BENCH_PREFIX")
         ):

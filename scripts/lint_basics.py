@@ -13,7 +13,12 @@ richer gate where installed):
 - f-strings without placeholders,
 - tabs in indentation and trailing whitespace,
 - lines over 110 columns (the codebase targets ~100; 110 is the hard
-  stop so URLs/tables don't nag).
+  stop so URLs/tables don't nag),
+- bare ``time.time()`` in the serving layer and the execution engine
+  (:data:`WALL_CLOCK_BANNED`): durations there MUST use
+  ``time.monotonic()``/``time.perf_counter()`` — wall clock steps under
+  NTP slew and breaks deadline/latency accounting. (``time.time()`` is
+  fine elsewhere, e.g. epoch timestamps in logs.)
 
 Usage: ``python scripts/lint_basics.py [paths...]`` (default: the
 package, tests, benchmarks, scripts). Exits non-zero on findings.
@@ -30,11 +35,16 @@ DEFAULT_PATHS = ["unionml_tpu", "tests", "benchmarks", "scripts", "bench.py",
                  "__graft_entry__.py"]
 MAX_LINE = 110
 
+# repo-relative prefixes where time.time() is banned (monotonic-clock
+# territory: queue deadlines, latency splits, drain timers)
+WALL_CLOCK_BANNED = ("unionml_tpu/serving/", "unionml_tpu/execution.py")
+
 
 class Checker(ast.NodeVisitor):
-    def __init__(self, path: Path, src: str):
+    def __init__(self, path: Path, src: str, ban_wall_clock: bool = False):
         self.path = path
         self.src = src
+        self.ban_wall_clock = ban_wall_clock
         self.problems: list = []
         self.imports: dict = {}       # name -> (lineno, spelled)
         self.used: set = set()
@@ -107,6 +117,22 @@ class Checker(ast.NodeVisitor):
                 )
         self.generic_visit(node)
 
+    def visit_Call(self, node: ast.Call):
+        if (
+            self.ban_wall_clock
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time"
+        ):
+            self.problem(
+                node.lineno,
+                "time.time() in serving/execution code — use "
+                "time.monotonic()/time.perf_counter() for durations "
+                "(wall clock steps under NTP)",
+            )
+        self.generic_visit(node)
+
     def visit_JoinedStr(self, node: ast.JoinedStr):
         if not any(isinstance(v, ast.FormattedValue) for v in node.values):
             self.problem(node.lineno, "f-string without placeholders")
@@ -152,7 +178,14 @@ def check_file(path: Path) -> list:
         tree = ast.parse(src, filename=str(path))
     except SyntaxError as e:
         return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
-    checker = Checker(path, src)
+    try:
+        rel = path.resolve().relative_to(ROOT).as_posix()
+    except ValueError:
+        rel = path.as_posix()
+    ban_wall_clock = any(
+        rel == p or rel.startswith(p) for p in WALL_CLOCK_BANNED
+    )
+    checker = Checker(path, src, ban_wall_clock=ban_wall_clock)
     checker.visit(tree)
     checker.report_unused_imports(tree)
     for i, line in enumerate(src.splitlines(), 1):

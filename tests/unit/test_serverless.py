@@ -41,12 +41,14 @@ def test_gateway_predict_and_validation(trained_model, dataset):
     assert resp["statusCode"] == 200
     preds = json.loads(resp["body"])
     assert len(preds) == 3
-    # both inputs and features -> 400, not a crash
+    # both inputs and features -> 422, the same status the HTTP
+    # transports answer for the identical payload (transport parity;
+    # this was 400 before the contract was unified)
     bad = handler({
         "httpMethod": "POST", "path": "/predict",
         "body": json.dumps({"features": features, "inputs": {}}),
     })
-    assert bad["statusCode"] == 400
+    assert bad["statusCode"] == 422
     assert "exactly one" in json.loads(bad["body"])["error"]
 
 
@@ -58,6 +60,88 @@ def test_gateway_http_api_v2_event_shape(trained_model):
         "rawPath": "/health",
     })
     assert resp["statusCode"] == 200
+
+
+def test_gateway_metrics_and_request_id(trained_model):
+    """Transport parity (PR-1 contract): GET /metrics serves the
+    Prometheus exposition and every response carries X-Request-ID —
+    echoed when the gateway forwarded one, minted otherwise."""
+    handler = gateway_handler(trained_model)
+    r = handler({"httpMethod": "GET", "path": "/health"})
+    rid = r["headers"]["X-Request-ID"]
+    assert rid and len(rid) == 16 and int(rid, 16) >= 0
+    # an incoming id is echoed back (gateways forward client ids)
+    r = handler({
+        "httpMethod": "GET", "path": "/health",
+        "headers": {"X-Request-Id": "trace-me-123"},
+    })
+    assert r["headers"]["X-Request-ID"] == "trace-me-123"
+    # /metrics: exposition body + content type + serverless series
+    handler({
+        "httpMethod": "POST", "path": "/predict",
+        "body": json.dumps({"features": [[0.1, 0.2]]}),
+    })
+    m = handler({"httpMethod": "GET", "path": "/metrics"})
+    assert m["statusCode"] == 200
+    assert m["headers"]["Content-Type"].startswith("text/plain")
+    assert "unionml_http_requests_total" in m["body"]
+    assert 'transport="serverless"' in m["body"]
+    assert 'path="/predict"' in m["body"]
+    # standard process gauges ride along (PR conventions)
+    assert "process_start_time_seconds" in m["body"]
+    assert "unionml_tpu_build_info" in m["body"]
+    # /stats parity with the HTTP transports
+    s = handler({"httpMethod": "GET", "path": "/stats"})
+    assert s["statusCode"] == 200
+    assert json.loads(s["body"])["engine"] == "direct"
+
+
+def test_gateway_health_non_ok_maps_503(trained_model):
+    """The PR-3 readiness contract: any non-ok health answers 503 so
+    gateway health checks stop routing here; draining predicts get the
+    typed 503 + Retry-After."""
+    handler = gateway_handler(trained_model)
+    app = handler.serving_app
+    assert handler({"httpMethod": "GET", "path": "/health"})["statusCode"] == 200
+    app.drain()
+    try:
+        h = handler({"httpMethod": "GET", "path": "/health"})
+        assert h["statusCode"] == 503
+        assert json.loads(h["body"])["status"] == "draining"
+        r = handler({
+            "httpMethod": "POST", "path": "/predict",
+            "body": json.dumps({"features": [[0.1, 0.2]]}),
+        })
+        assert r["statusCode"] == 503
+        assert json.loads(r["body"])["reason"] == "draining"
+        assert int(r["headers"]["Retry-After"]) >= 1
+    finally:
+        app.resume()
+    ok = handler({
+        "httpMethod": "POST", "path": "/predict",
+        "body": json.dumps({"features": [[0.1, 0.2]]}),
+    })
+    assert ok["statusCode"] == 200
+
+
+def test_gateway_deadline_header_contract(trained_model):
+    """X-Deadline-Ms flows through the shared parser: malformed values
+    are a 422 (not a silently-ignored no-deadline), valid ones open the
+    deadline scope around the predictor call."""
+    handler = gateway_handler(trained_model)
+    bad = handler({
+        "httpMethod": "POST", "path": "/predict",
+        "headers": {"X-Deadline-Ms": "banana"},
+        "body": json.dumps({"features": [[0.1, 0.2]]}),
+    })
+    assert bad["statusCode"] == 422
+    assert "X-Deadline-Ms" in json.loads(bad["body"])["error"]
+    ok = handler({
+        "httpMethod": "POST", "path": "/predict",
+        "headers": {"X-Deadline-Ms": "30000"},
+        "body": json.dumps({"features": [[0.1, 0.2]]}),
+    })
+    assert ok["statusCode"] == 200
 
 
 def test_object_event_batch_prediction(trained_model, tmp_path):

@@ -468,6 +468,49 @@ def test_predict_stream_validation():
         engine.close()
 
 
+def test_debug_surface_on_engine_backed_app():
+    """The introspection surface end to end over a live engine: the
+    flight recorder dump names real requests, /debug/memory reports the
+    CPU devices, and stats()["programs"] flows through GET /stats."""
+    app, engine = _lm_serving_app(stream=False)
+    host, port = app.serve(port=0, blocking=False)
+    base = f"http://{host}:{port}"
+    try:
+        r = httpx.post(
+            f"{base}/predict", json={"features": [[1, 2, 3]]}, timeout=120
+        )
+        assert r.status_code == 200
+        stats = httpx.get(f"{base}/stats", timeout=30).json()
+        assert "programs" in stats
+        assert stats["programs"]["engine.decode"]["flops_per_call"] > 0
+        fl = httpx.get(f"{base}/debug/flight?n=50", timeout=30).json()
+        kinds = {e["kind"] for e in fl["events"]}
+        assert {"submit", "prefill", "finish"} <= kinds
+        mem = httpx.get(f"{base}/debug/memory", timeout=60).json()
+        assert mem["devices"] and mem["devices"][0]["platform"] == "cpu"
+        assert mem["live_arrays"]["count"] >= 1  # engine params resident
+    finally:
+        app.shutdown()
+        engine.close()
+
+
+def test_fastapi_debug_route_parity(trained_model):
+    """The FastAPI adapter serves the same debug routes as the stdlib
+    transport (shared ServingApp methods — they cannot drift)."""
+    fastapi = pytest.importorskip("fastapi")
+    from fastapi.testclient import TestClient
+
+    app = fastapi.FastAPI()
+    trained_model.serve(app)
+    with TestClient(app) as client:
+        r = client.get("/debug/memory")
+        assert r.status_code == 200 and r.json()["devices"]
+        r = client.get("/debug/flight", params={"n": 3})
+        assert r.status_code == 200 and "events" in r.json()
+        r = client.post("/debug/profile?seconds=0.02")
+        assert r.status_code == 200 and "trace_dir" in r.json()
+
+
 def test_predict_stream_disabled_is_422():
     app, engine = _lm_serving_app(stream=False)
     host, port = app.serve(port=0, blocking=False)

@@ -382,6 +382,213 @@ def test_trainer_publishes_through_registry():
 # ------------------------------------------------------ /metrics smoke
 
 
+def validate_exposition_strict(text: str) -> dict:
+    """Line-by-line exposition-format validation (beyond the substring
+    checks this module started with): HELP precedes TYPE precedes
+    samples for every family, no family appears twice, labels parse
+    with escaping, every value parses, and histogram series are
+    internally consistent per labelset — cumulative bucket counts
+    nondecreasing, ``+Inf`` last and equal to ``_count``, ``_sum``
+    present. Returns the parsed families."""
+    families = parse_prometheus_text(text)  # raises on malformed lines
+    seen_help = []
+    state: dict = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            name = line.split(" ", 3)[2]
+            assert name not in seen_help, f"family {name} repeated"
+            seen_help.append(name)
+            state[name] = "help"
+            continue
+        if line.startswith("# TYPE "):
+            name = line.split(" ", 3)[2]
+            assert state.get(name) == "help", f"TYPE before HELP: {line!r}"
+            state[name] = "type"
+            continue
+        base = re.sub(r"_(bucket|sum|count)$", "", line.split("{")[0].split(" ")[0])
+        owner = base if base in state else line.split("{")[0].split(" ")[0]
+        assert state.get(owner) == "type", f"sample before TYPE: {line!r}"
+    for name, fam in families.items():
+        if fam["type"] != "histogram":
+            continue
+        # group by labelset minus 'le'
+        series: dict = {}
+        for sample, labels, value in fam["samples"]:
+            key = tuple(sorted(
+                (k, v) for k, v in labels.items() if k != "le"
+            ))
+            series.setdefault(key, {})[
+                (sample, labels.get("le"))
+            ] = float(value.replace("+Inf", "inf"))
+        for key, samples in series.items():
+            buckets = [
+                (float(le.replace("+Inf", "inf")), v)
+                for (s, le), v in samples.items()
+                if s == f"{name}_bucket"
+            ]
+            assert buckets, f"{name}{key}: no buckets"
+            buckets.sort()
+            counts = [v for _, v in buckets]
+            assert counts == sorted(counts), (
+                f"{name}{key}: non-monotonic buckets {counts}"
+            )
+            assert buckets[-1][0] == float("inf"), f"{name}{key}: no +Inf"
+            count = samples.get((f"{name}_count", None))
+            assert count == buckets[-1][1], (
+                f"{name}{key}: _count {count} != +Inf {buckets[-1][1]}"
+            )
+            assert (f"{name}_sum", None) in samples, f"{name}{key}: no _sum"
+    return families
+
+
+def test_exposition_strict_validation_catches_defects():
+    """The validator itself must reject broken expositions, or the
+    concurrency smoke below is vacuous."""
+    good = "# HELP a_total x\n# TYPE a_total counter\na_total 1\n"
+    validate_exposition_strict(good)
+    with pytest.raises(AssertionError):  # sample before TYPE
+        validate_exposition_strict("# HELP a_total x\na_total 1\n")
+    with pytest.raises(AssertionError):  # family repeated
+        validate_exposition_strict(good + good)
+    with pytest.raises(AssertionError):  # non-monotonic histogram
+        validate_exposition_strict(
+            "# HELP h_ms x\n# TYPE h_ms histogram\n"
+            'h_ms_bucket{le="1"} 5\nh_ms_bucket{le="+Inf"} 3\n'
+            "h_ms_sum 1\nh_ms_count 3\n"
+        )
+
+
+def test_metrics_scrape_under_concurrent_traffic():
+    """Concurrency smoke: scrape /metrics repeatedly while request
+    threads stream predicts, validating the exposition line-by-line
+    each time — a torn render (half-updated histogram, interleaved
+    family) must never reach a scraper."""
+    import urllib.request
+
+    from unionml_tpu import Dataset, Model
+    from unionml_tpu.model import ModelArtifact
+    from unionml_tpu.serving.http import ServingApp
+
+    dataset = Dataset(name="concurrency_smoke_data", targets=[])
+
+    @dataset.reader
+    def reader() -> list:
+        return []
+
+    stub = Model(name="concurrency_smoke", init=lambda: {"w": 1}, dataset=dataset)
+
+    @stub.trainer
+    def trainer(p: dict, features: list) -> dict:
+        return p
+
+    @stub.predictor
+    def predictor(p: dict, feats: list) -> list:
+        return [float(np.asarray(f).sum()) for f in feats]
+
+    stub.artifact = ModelArtifact({"w": 1}, {}, {})
+    app = ServingApp(stub, registry=MetricsRegistry())
+    host, port = app.serve(port=0, blocking=False)
+    base = f"http://{host}:{port}"
+    stop = threading.Event()
+    errors: list = []
+
+    def client(i):
+        body = json.dumps({"features": [[float(i), 1.0]]}).encode()
+        while not stop.is_set():
+            try:
+                req = urllib.request.Request(
+                    f"{base}/predict", data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                urllib.request.urlopen(req, timeout=30).read()
+            except Exception as exc:  # surfaced after the join
+                errors.append(f"client: {exc!r}")
+                return
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(4)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for _ in range(15):
+            with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+                text = resp.read().decode()
+            fams = validate_exposition_strict(text)
+            # the standard process gauges ride every scrape
+            assert "process_start_time_seconds" in fams
+            assert "unionml_tpu_build_info" in fams
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        app.shutdown()
+    assert not errors, errors
+    # traffic actually flowed while we scraped
+    rows = [
+        s for s in fams["unionml_http_requests_total"]["samples"]
+        if s[1].get("path") == "/predict"
+    ]
+    assert rows and float(rows[0][2]) > 0
+
+
+def test_process_and_build_info_gauges():
+    """Satellite: process_start_time_seconds + build_info on the
+    default registry (standard Prometheus conventions), and published
+    into isolated registries on demand."""
+    import time as _time
+
+    text = telemetry.get_registry().exposition()
+    row = next(
+        line for line in text.splitlines()
+        if line.startswith("process_start_time_seconds ")
+    )
+    start_s = float(row.split(" ", 1)[1])
+    assert 0 < start_s <= _time.time()
+
+    import jax  # noqa: F401 — backend label resolves once jax is loaded
+
+    reg = MetricsRegistry()
+    telemetry.publish_process_metrics(reg)
+    fams = parse_prometheus_text(reg.exposition())
+    assert fams["process_start_time_seconds"]["type"] == "gauge"
+    sample = fams["unionml_tpu_build_info"]["samples"][0]
+    assert set(sample[1]) == {"version", "jax_version", "backend"}
+    assert sample[2] == "1"
+    # jax is loaded in the test process: the backend label is real
+    assert sample[1]["backend"] == "cpu"
+    # republishing with the same labels never duplicates the child
+    telemetry.publish_process_metrics(reg)
+    fams = parse_prometheus_text(reg.exposition())
+    live = [
+        s for s in fams["unionml_tpu_build_info"]["samples"]
+        if s[2] == "1"
+    ]
+    assert len(live) == 1
+
+
+def test_percentile_summary_moved_to_telemetry_with_compat_shim():
+    """Satellite: percentile_summary lives in telemetry; the old
+    serving._stats import keeps working."""
+    from unionml_tpu.serving._stats import percentile_summary as compat
+    from unionml_tpu.telemetry import percentile_summary
+
+    assert compat is percentile_summary
+    s = percentile_summary([3.0, 1.0, 2.0])
+    assert s == {"p50": 2.0, "p95": 3.0, "p99": 3.0, "mean": 2.0, "n": 3}
+    # StepTimer shares it: summary() carries the full summary dict
+    from unionml_tpu.diagnostics import StepTimer
+
+    t = StepTimer(window=2)
+    for _ in range(7):
+        t.tick(4)
+    s = t.summary()
+    assert s["samples_per_sec"]["n"] == len(t.rates)
+    assert s["samples_per_sec_median"] == s["samples_per_sec"]["p50"]
+
+
 def test_metrics_smoke_servingapp_scrape():
     """CI smoke (tier-1-safe, JAX_PLATFORMS=cpu, no TPU): start a
     ServingApp over a stub predictor, scrape GET /metrics on a real

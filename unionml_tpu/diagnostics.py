@@ -29,6 +29,7 @@ from typing import Any, Dict, Iterator, Optional
 import numpy as np
 
 from unionml_tpu._logging import logger
+from unionml_tpu.telemetry import percentile_summary
 
 
 class StepTimer:
@@ -76,14 +77,19 @@ class StepTimer:
             self._steps = 0
             self._examples = 0
 
-    def summary(self) -> Dict[str, float]:
-        out: Dict[str, float] = {
+    def summary(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
             "steps": float(self.total_steps),
             "examples": float(self.total_examples),
         }
         if self.rates:
-            out["samples_per_sec_median"] = float(np.median(self.rates))
+            # the shared nearest-rank formula (telemetry.percentile
+            # _summary) — same percentile semantics as every serving
+            # stats() surface, so trainer and server numbers compare
+            s = percentile_summary(self.rates)
+            out["samples_per_sec_median"] = float(s["p50"])
             out["samples_per_sec_last"] = float(self.rates[-1])
+            out["samples_per_sec"] = s
         return out
 
 

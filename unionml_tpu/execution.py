@@ -349,6 +349,17 @@ def run_step_trainer(
         "unionml_trainer_examples_total", "Training examples consumed.",
     )
 
+    # program introspection (docs/observability.md): compile events on
+    # the step record XLA cost-analysis flops/bytes + compile time, and
+    # the unionml_program_mfu_ratio{component="trainer",
+    # program="trainer.step"} gauge reports live MFU against the device
+    # peak — the same scrape surface as the serving layers
+    from unionml_tpu.introspection import ProgramTracker
+
+    step = ProgramTracker(registry=reg, component="trainer").wrap(
+        "trainer.step", step
+    )
+
     timer = StepTimer()
     steps = 0
     metrics = None

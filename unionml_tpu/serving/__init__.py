@@ -23,7 +23,13 @@ predictor call). The TPU-native redesign has two layers:
 Both engines, both transports, and the step trainer publish through the
 :mod:`unionml_tpu.telemetry` registry — one ``GET /metrics`` scrape
 covers every layer, and engine requests record Perfetto-exportable
-trace spans (docs/observability.md).
+trace spans (docs/observability.md). The introspection layer
+(:mod:`unionml_tpu.introspection`) adds hardware truth on top: per-
+program XLA cost analysis with live MFU/roofline gauges, on-demand
+profiler capture (``POST /debug/profile``), a device-memory breakdown
+(``GET /debug/memory``), and a request flight recorder
+(``GET /debug/flight``) whose snapshots make recoveries explainable
+after the fact.
 
 Fault tolerance (:mod:`unionml_tpu.serving.faults`,
 docs/robustness.md): bounded queues and per-request deadlines shed load

@@ -1,27 +1,11 @@
-"""Shared percentile summary for serving observability surfaces."""
+"""Compat shim: :func:`percentile_summary` moved to
+:mod:`unionml_tpu.telemetry` (diagnostics and the program-introspection
+registry need it too, and telemetry is the layer everything already
+imports). Serving-internal and benchmark imports keep working through
+this re-export."""
 
 from __future__ import annotations
 
-import math
-from typing import Sequence
+from unionml_tpu.telemetry import percentile_summary
 
-
-def percentile_summary(values: Sequence[float]) -> dict:
-    """p50/p95/p99/mean/n of a non-empty sample.
-
-    Percentiles use nearest-rank ``ceil(q * n) - 1`` (the formula the
-    benchmarks share through this helper): for small windows
-    ``int(q * n)`` indexes the sample MAXIMUM — one cold-compile outlier
-    would be reported as the p95 and misdirect tail-latency attribution.
-    ``n`` is the sample count, so a consumer can tell a p99 computed
-    over 3 requests from one computed over 10k.
-    """
-    vals = sorted(values)
-    n = len(vals)
-    return {
-        "p50": round(vals[n // 2], 1),
-        "p95": round(vals[max(0, math.ceil(0.95 * n) - 1)], 1),
-        "p99": round(vals[max(0, math.ceil(0.99 * n) - 1)], 1),
-        "mean": round(sum(vals) / n, 1),
-        "n": n,
-    }
+__all__ = ["percentile_summary"]

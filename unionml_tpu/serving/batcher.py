@@ -121,6 +121,8 @@ class MicroBatcher:
         registry: Optional[telemetry.MetricsRegistry] = None,
         max_queue_depth: Optional[int] = None,
         fault_injector=None,
+        introspect: bool = True,
+        flight=None,
     ):
         """``row_lists=True``: features/results are plain Python lists of
         per-example rows (possibly ragged — LLM token-id prompts), so the
@@ -139,7 +141,16 @@ class MicroBatcher:
 
         ``fault_injector``: a :class:`~unionml_tpu.serving.faults
         .FaultInjector` whose ``batcher.predict`` point fires before
-        the shared device call (chaos tests; ``None`` is zero-cost)."""
+        the shared device call (chaos tests; ``None`` is zero-cost).
+
+        ``introspect``: wrap the predictor in a
+        :class:`~unionml_tpu.introspection.ProgramTracker` (compile
+        events record XLA cost-analysis flops/bytes; ``stats()
+        ["programs"]`` and the ``unionml_program_*`` series report
+        them) and record request lifecycle events into ``flight``
+        (default: the process-global
+        :class:`~unionml_tpu.telemetry.FlightRecorder` behind
+        ``GET /debug/flight``). ``False`` disables both."""
         self._predict_fn = predict_fn
         self.row_lists = row_lists
         self.max_batch_size = max_batch_size
@@ -163,6 +174,34 @@ class MicroBatcher:
         self._registry = registry if registry is not None else telemetry.get_registry()
         self.instance = telemetry.instance_label("batcher")
         self._build_instruments()
+        # program introspection + flight recording (docs/observability
+        # .md): the tracker detects compiles of a jitted predictor and
+        # records cost-analysis flops/bytes; a plain-Python predictor is
+        # tracked opaquely (calls only). introspect=False leaves the
+        # predictor unwrapped and every flight site a single None check.
+        self.introspect = bool(introspect)
+        self._programs = None
+        self._flight = None
+        if self.introspect:
+            from unionml_tpu.introspection import ProgramTracker
+
+            self._flight = (
+                flight if flight is not None
+                else telemetry.get_flight_recorder()
+            )
+            self._programs = ProgramTracker(
+                registry=self._registry, component=self.instance
+            )
+            self._predict_fn = self._programs.wrap(
+                "batcher.predict", self._predict_fn,
+                # cheap per-call signature: the padded bucket size (row
+                # lists) or the leading array shape; pytree features
+                # fall back to single-signature attribution
+                sig_fn=(
+                    (lambda feats: len(feats)) if row_lists
+                    else (lambda feats: getattr(feats, "shape", None))
+                ),
+            )
         self._worker = threading.Thread(target=self._run, daemon=True, name="unionml-tpu-batcher")
         self._worker.start()
 
@@ -225,6 +264,11 @@ class MicroBatcher:
             "Entries queued awaiting a batch.", ("batcher",),
         ).labels(**lbl)
 
+    def _flight_rec(self, kind: str, **fields) -> None:
+        """O(1) flight-recorder append (no-op when introspect=False)."""
+        if self._flight is not None:
+            self._flight.record(kind, batcher=self.instance, **fields)
+
     def _bucket(self, n: int) -> int:
         for b in self.buckets:
             if n <= b:
@@ -264,6 +308,7 @@ class MicroBatcher:
         with self._admit_lock:
             if self._draining:
                 self._m_rejected["draining"].inc()
+                self._flight_rec("reject", reason="draining")
                 raise EngineUnavailable(
                     "micro-batcher is draining and not accepting requests",
                     reason="draining", retry_after_s=1.0,
@@ -272,11 +317,22 @@ class MicroBatcher:
                 depth = self._queue.qsize()
                 if depth >= self.max_queue_depth:
                     self._m_rejected["queue_full"].inc()
+                    self._flight_rec(
+                        "reject", reason="queue_full", queue_depth=depth
+                    )
                     raise Overloaded(
                         f"micro-batcher queue is full ({depth} queued >= "
                         f"max_queue_depth {self.max_queue_depth})",
                         retry_after_s=max(0.1, self.max_wait_s),
                     )
+            # recorded BEFORE the put (the worker drains the queue
+            # without this lock): the entry's 'submit' flight event
+            # always precedes its 'batch'/'drop'. queue_depth = entries
+            # ahead of this one.
+            self._flight_rec(
+                "submit", rows=pending.rows,
+                queue_depth=self._queue.qsize(),
+            )
             self._queue.put(pending)
             self._pending += 1
         self._g_queue_depth.set(self._queue.qsize())
@@ -351,6 +407,8 @@ class MicroBatcher:
                 "draining": self._draining,
             },
         }
+        if self._programs is not None:
+            out["programs"] = self._programs.stats()
         for name, h in (
             ("queue_wait_ms", self._h_queue), ("device_ms", self._h_device)
         ):
@@ -370,6 +428,8 @@ class MicroBatcher:
             self._h_device,
         ):
             m.reset()
+        if self._programs is not None:
+            self._programs.reset()
 
     def close(self):
         self._stop.set()
@@ -394,6 +454,7 @@ class MicroBatcher:
         contract). Returns True when the entry was shed."""
         if p.abandoned:
             self._m_abandoned.inc()
+            self._flight_rec("drop", cause="abandoned", rows=p.rows)
             self._dispose()
             return True
         if p.deadline is not None and time.perf_counter() > p.deadline:
@@ -404,6 +465,10 @@ class MicroBatcher:
                 deadline_ms=(p.deadline - p.submitted) * 1e3,
             )
             self._m_deadline_shed.inc()
+            self._flight_rec(
+                "drop", cause="deadline_shed", rows=p.rows,
+                waited_ms=round(waited_ms, 3),
+            )
             p.event.set()
             self._dispose()
             return True
@@ -492,9 +557,16 @@ class MicroBatcher:
                     self._h_queue.observe(p.queue_wait_ms)
                     self._h_device.observe(p.device_ms)
                 self._m_requests.inc(len(batch))
+                self._flight_rec(
+                    "batch", rows=total, entries=len(batch),
+                    device_ms=round(device_ms, 3),
+                )
             except BaseException as exc:  # surface errors to every waiter
                 logger.info(f"micro-batcher error: {exc!r}")
                 self._m_errors.inc(len(batch))
+                self._flight_rec(
+                    "error", entries=len(batch), error=repr(exc)[:200]
+                )
                 for p in batch:
                     p.error = exc
             finally:

@@ -327,6 +327,22 @@ class DecodeEngine:
             harness that makes recovery, shedding, and breaker behavior
             deterministically reproducible in CPU-only tests. ``None``
             (production default) is zero-cost.
+        introspect: program introspection + flight recording
+            (docs/observability.md). When True (default), every
+            compiled program (prefill, decode chunk, splice/extract) is
+            wrapped by a :class:`~unionml_tpu.introspection
+            .ProgramTracker` — compile events record XLA
+            ``cost_analysis()`` flops/bytes and compile time, live MFU/
+            roofline gauges land in ``/metrics``, and
+            ``stats()["programs"]`` reports per-program hardware truth
+            — and request lifecycle events stream into the flight
+            recorder. Steady-state overhead is a cache-size read plus
+            counter increments per *chunk* dispatch (measured by the
+            ``serve_introspection`` bench preset); ``False`` disables
+            both for an instrumentation-free engine.
+        flight: explicit :class:`~unionml_tpu.telemetry.FlightRecorder`
+            for lifecycle events; defaults to the process-global one
+            (``GET /debug/flight``). Ignored when ``introspect=False``.
     """
 
     def __init__(
@@ -357,6 +373,8 @@ class DecodeEngine:
         breaker_window_s: float = 30.0,
         breaker_cooldown_s: float = 5.0,
         fault_injector=None,
+        introspect: bool = True,
+        flight=None,
     ):
         import jax
 
@@ -445,6 +463,15 @@ class DecodeEngine:
         self._registry = registry if registry is not None else telemetry.get_registry()
         self._tracer = tracer if tracer is not None else telemetry.get_tracer()
         self.instance = telemetry.instance_label("engine")
+        # introspection sinks (None when introspect=False: every record
+        # site is a single attr-is-None check — the bench-measured
+        # instrumentation-off path)
+        self.introspect = bool(introspect)
+        self._flight = (
+            (flight if flight is not None else telemetry.get_flight_recorder())
+            if self.introspect else None
+        )
+        self._programs = None
         # shared system prefix (back-compat shim over the prefix cache):
         # the tokens are PREPENDED to every request's prompt and their
         # KV blocks pinned in the cache — the first admission prefills
@@ -573,6 +600,8 @@ class DecodeEngine:
         # (harvester thread only), read by _finish_if_done under the lock
         self._harvest_t0 = 0.0
         self._build_programs()
+        if self.introspect:
+            self._instrument_programs()
         self._stop = threading.Event()
         self._worker = threading.Thread(
             target=self._run, daemon=True, name="unionml-tpu-decode-engine"
@@ -699,6 +728,56 @@ class DecodeEngine:
             "drain() wall time: stop-admissions to queue+slots idle.",
         )
 
+    def _instrument_programs(self):
+        """Wrap the compiled hot-path programs in a cost-analysis
+        tracker (docs/observability.md): compile events record XLA
+        flops/bytes + compile time per program key, dispatches feed the
+        MFU/roofline gauges, and ``stats()["programs"]`` becomes the
+        hardware-truth view. The sig lambdas are deliberately ONE shape
+        attribute each — they run per dispatch and exist only to tell a
+        program's bucketed executables apart."""
+        from unionml_tpu.introspection import ProgramTracker
+
+        tr = ProgramTracker(registry=self._registry, component=self.instance)
+        self._programs = tr
+        self._init_state = tr.wrap("engine.init_state", self._init_state)
+        self._prefill = tr.wrap(
+            "engine.prefill", self._prefill,
+            sig_fn=lambda p, st, slot, toks, *a, **k: toks.shape,
+        )
+        self._prefill_step = tr.wrap(
+            "engine.prefill_chunk", self._prefill_step,
+            sig_fn=lambda p, fresh, toks, start: toks.shape,
+        )
+        self._prefill_final = tr.wrap(
+            "engine.prefill_final", self._prefill_final,
+            sig_fn=lambda p, st, fresh, slot, toks, *a, **k: toks.shape,
+        )
+        self._decode_chunk = tr.wrap("engine.decode", self._decode_chunk)
+        self._init_fresh = tr.wrap(
+            "engine.init_fresh", self._init_fresh,
+            sig_fn=lambda **k: k.get("bucket"),
+        )
+        if self.prefix_cache is not None:
+            self._splice_block = tr.wrap(
+                "engine.splice_block", self._splice_block,
+                sig_fn=lambda fresh, rows, start: rows[0][0].shape,
+            )
+            self._extract_rows = tr.wrap(
+                "engine.extract_rows", self._extract_rows,
+                sig_fn=lambda cache, slot, **k: k.get("n"),
+            )
+
+    def _flight_rec(self, kind: str, **fields) -> None:
+        """O(1) flight-recorder append (no-op when introspect=False).
+        numpy scalars (slot indices from mask walks) become plain ints
+        so a dumped event is always JSON-safe."""
+        if self._flight is not None:
+            self._flight.record(kind, engine=self.instance, **{
+                k: (v.item() if isinstance(v, np.generic) else v)
+                for k, v in fields.items()
+            })
+
     def _slots_in_use_locked(self) -> int:
         """Occupied-slot count; call with the lock held."""
         return sum(1 for r in self._occupant if r is not None)
@@ -728,12 +807,20 @@ class DecodeEngine:
         with self._lock:
             self._admission_gate_locked(len(reqs))
             for req in reqs:
+                # recorded BEFORE the put, inside the lock: a request's
+                # 'submit' flight event can never land after its
+                # 'prefill' in the trail. queue_depth = requests ahead.
+                self._flight_rec(
+                    "submit", rid=req.rid, prompt_tokens=len(req.prompt),
+                    queue_depth=self._queue.qsize(),
+                )
                 self._queue.put(req)
         self._g_queue_depth.set(self._queue.qsize())
 
     def _admission_gate_locked(self, n_new: int) -> None:
         if self._draining:
             self._m_rejected["draining"].inc(n_new)
+            self._flight_rec("reject", reason="draining", n=n_new)
             raise EngineUnavailable(
                 "decode engine is draining and not accepting requests",
                 reason="draining", retry_after_s=1.0,
@@ -741,6 +828,7 @@ class DecodeEngine:
         remaining = self._breaker_open_until - time.monotonic()
         if remaining > 0:
             self._m_rejected["breaker_open"].inc(n_new)
+            self._flight_rec("reject", reason="breaker_open", n=n_new)
             raise EngineUnavailable(
                 "decode engine circuit breaker is open "
                 f"({len(self._recovery_times)} recent recovery failures); "
@@ -751,6 +839,10 @@ class DecodeEngine:
             depth = self._queue.qsize()
             if depth + n_new > self.max_queue_depth:
                 self._m_rejected["queue_full"].inc(n_new)
+                self._flight_rec(
+                    "reject", reason="queue_full", n=n_new,
+                    queue_depth=depth,
+                )
                 raise Overloaded(
                     f"decode engine queue is full ({depth} queued + "
                     f"{n_new} new > max_queue_depth "
@@ -1489,6 +1581,10 @@ class DecodeEngine:
             }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
+        if self._programs is not None:
+            # hardware truth per compiled program: flops/bytes, compile
+            # counts, MFU/roofline ratios (docs/observability.md)
+            out["programs"] = self._programs.stats()
         out["robustness"] = {
             "queue_depth": self._queue.qsize(),
             "rejected": {
@@ -1527,6 +1623,8 @@ class DecodeEngine:
             m.reset()
         if self.prefix_cache is not None:
             self.prefix_cache.reset_stats()
+        if self._programs is not None:
+            self._programs.reset()
 
     def close(self):
         self._stop.set()
@@ -1630,6 +1728,10 @@ class DecodeEngine:
             self._slot_gen[slot] += 1
             req._expected = 1
             self._m_slots_busy.set(self._slots_in_use_locked())
+        self._flight_rec(
+            "prefill", rid=req.rid, slot=slot, bucket=_bucket,
+            tokens=req._prefilled_tokens, cached_tokens=req._saved_tokens,
+        )
         self._inflight.put(("prefill", ep0, slot, req, first))
         self._schedule_insert(req, slot, ep0)
 
@@ -1726,6 +1828,12 @@ class DecodeEngine:
             self._m_slots_busy.set(self._slots_in_use_locked())
             self._tracer.record_span(req.rid, "harvest", self._harvest_t0, now)
             self._tracer.finish_request(req.rid)
+            self._flight_rec(
+                "finish", rid=req.rid, slot=slot,
+                tokens=len(req.tokens), abandoned=req.abandoned,
+                ttft_ms=round(req.ttft_ms, 3),
+                decode_ms=round(req.decode_ms, 3),
+            )
             req.event.set()
             req.finish_stream()
         return done
@@ -1825,6 +1933,10 @@ class DecodeEngine:
                     req.rid, f"decode-chunk[{req._chunk_i}]", dispatched, now,
                     tokens=len(chunk),
                 )
+                self._flight_rec(
+                    "decode", rid=req.rid, slot=slot,
+                    chunk=req._chunk_i, tokens=len(chunk),
+                )
                 req._chunk_i += 1
                 req.emit(chunk)
                 self._finish_if_done(slot, chunk[-1])
@@ -1865,6 +1977,10 @@ class DecodeEngine:
                 self._tracer.record_span(
                     req.rid, f"decode-chunk[{req._chunk_i}]", dispatched, now,
                     tokens=len(chunk),
+                )
+                self._flight_rec(
+                    "decode", rid=req.rid, slot=slot,
+                    chunk=req._chunk_i, tokens=len(chunk),
                 )
                 req._chunk_i += 1
                 req.emit(chunk)
@@ -1965,10 +2081,14 @@ class DecodeEngine:
         self._release_lease(req)
         if req.abandoned:
             self._m_abandoned.inc()
+            cause = "abandoned"
         elif isinstance(exc, DeadlineExceeded):
             self._m_deadline_shed.inc()
+            cause = "deadline_shed"
         else:
             self._m_errors.inc()
+            cause = f"error:{type(exc).__name__}"
+        self._flight_rec("drop", rid=req.rid, cause=cause)
         self._tracer.finish_request(req.rid)
         req.event.set()
         req.finish_stream()
@@ -2160,6 +2280,11 @@ class DecodeEngine:
                 req._expected = 1
                 self._admitting -= 1
                 self._m_slots_busy.set(self._slots_in_use_locked())
+            self._flight_rec(
+                "prefill", rid=req.rid, slot=adm.slot, bucket=adm.bucket,
+                tokens=req._prefilled_tokens,
+                cached_tokens=req._saved_tokens, chunks=adm.n_chunks,
+            )
             self._inflight.put(("prefill", ep0, adm.slot, req, first))
             self._schedule_insert(req, adm.slot, ep0)
             if self.prefix_cache is not None and req._saved_tokens:
@@ -2237,14 +2362,17 @@ class DecodeEngine:
             f"decode engine error: {exc!r} — failing the poisoned batch "
             "and rebuilding decode state"
         )
+        poisoned: List[str] = []
         with self._lock:
             adm, self._admission = self._admission, None
         if adm is not None:
+            poisoned.append(adm.req.rid)
             self._drop_admission(adm.req, exc)
         with self._lock:
             self._epoch += 1
             for slot, req in enumerate(self._occupant):
                 if req is not None:
+                    poisoned.append(req.rid)
                     req.error = exc
                     self._m_errors.inc()
                     self._tracer.finish_request(req.rid)
@@ -2273,7 +2401,20 @@ class DecodeEngine:
                 )
         # the recovery itself is a traceable event (spans are how the
         # PR-1 telemetry narrates a request timeline; recoveries get
-        # their own synthetic timeline)
+        # their own synthetic timeline) — with the flight-recorder
+        # snapshot of the poisoned requests' lifecycle attached, so the
+        # postmortem names WHO died and what they were doing when the
+        # device program failed
+        span_args: dict = {
+            "error": repr(exc)[:200], "poisoned": list(poisoned),
+        }
+        if self._flight is not None:
+            self._flight_rec(
+                "recovery", rids=list(poisoned), error=repr(exc)[:200],
+            )
+            span_args["flight"] = self._flight.snapshot(poisoned)
         rid = self._tracer.new_request("recovery")
-        self._tracer.record_span(rid, "recover", t0, time.perf_counter())
+        self._tracer.record_span(
+            rid, "recover", t0, time.perf_counter(), **span_args
+        )
         self._tracer.finish_request(rid)

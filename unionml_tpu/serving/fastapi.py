@@ -139,6 +139,33 @@ def serving_app(
             media_type=telemetry.EXPOSITION_CONTENT_TYPE,
         )
 
+    # debug/introspection surface (docs/observability.md) — same
+    # ServingApp methods as the stdlib transport, so the two cannot
+    # drift. Sync `def` for the profiler capture: it blocks for the
+    # capture window and must not freeze the event loop.
+    @app.post("/debug/profile")
+    def debug_profile(seconds: float = 2.0):
+        from unionml_tpu.introspection import ProfileInProgress
+
+        try:
+            return core.debug_profile(seconds)
+        except ProfileInProgress as exc:
+            raise HTTPException(status_code=409, detail=str(exc))
+        except (ValueError, TypeError) as exc:
+            raise HTTPException(status_code=422, detail=str(exc))
+
+    @app.get("/debug/memory")
+    async def debug_memory():
+        return core.debug_memory()
+
+    @app.get("/debug/flight")
+    async def debug_flight(
+        n: Optional[int] = None,
+        kind: Optional[str] = None,
+        rid: Optional[str] = None,
+    ):
+        return core.debug_flight(n=n, kind=kind, rid=rid)
+
     # one middleware gives every route the X-Request-ID header and the
     # per-endpoint request/error/latency series, through the SAME
     # ServingApp.observe_request the stdlib transport uses

@@ -29,7 +29,17 @@ Same endpoint surface as the reference's FastAPI app
   between transport queueing and device time),
 - ``GET /metrics`` — Prometheus text exposition of the shared
   :mod:`unionml_tpu.telemetry` registry (engine, batcher, prefix-cache,
-  HTTP-layer, and trainer series in one scrape surface).
+  HTTP-layer, trainer, and per-program cost-analysis/MFU series in one
+  scrape surface, plus the standard ``process_start_time_seconds`` /
+  ``unionml_tpu_build_info`` gauges),
+- ``POST /debug/profile?seconds=N`` — on-demand ``jax.profiler``
+  capture; returns the trace artifact directory (409 while another
+  capture runs),
+- ``GET /debug/memory`` — per-device memory stats + live-buffer census,
+- ``GET /debug/flight?n=K`` — the request flight recorder's newest
+  events (admissions, decode chunks, sheds, recoveries) for
+  after-the-fact explanation of a 429/504/recovery
+  (docs/observability.md).
 
 Every response carries an ``X-Request-ID`` header (a generated
 telemetry request id) and lands in the per-endpoint
@@ -59,6 +69,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
+from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
@@ -75,7 +86,10 @@ from unionml_tpu.serving.faults import (
 
 # bound HTTP label cardinality: unknown paths share one series instead
 # of letting a scanner mint a metric per probed URL
-KNOWN_ROUTES = ("/", "/predict", "/predict/stream", "/health", "/stats", "/metrics")
+KNOWN_ROUTES = (
+    "/", "/predict", "/predict/stream", "/health", "/stats", "/metrics",
+    "/debug/profile", "/debug/memory", "/debug/flight",
+)
 
 LANDING_HTML = """<html><head><title>unionml-tpu</title></head>
 <body><h1>unionml-tpu serving: {name}</h1>
@@ -119,6 +133,7 @@ class ServingApp:
         registry: Optional[telemetry.MetricsRegistry] = None,
         health: Optional[Any] = None,
         drain: Optional[Any] = None,
+        flight: Optional[telemetry.FlightRecorder] = None,
         **batcher_kwargs,
     ):
         """``warmup``: optional callable invoked with the loaded model
@@ -156,7 +171,13 @@ class ServingApp:
         ``drain``: optional callable (accepting one optional timeout
         argument) invoked by :meth:`drain` — wire
         ``DecodeEngine.drain`` so the app-level drain also finishes the
-        engine's in-flight streams; defaults to the micro-batcher's."""
+        engine's in-flight streams; defaults to the micro-batcher's.
+
+        ``flight``: explicit :class:`~unionml_tpu.telemetry
+        .FlightRecorder` served at ``GET /debug/flight``; defaults to
+        the process-global recorder, where engines and batchers record
+        by default — so the postmortem surface covers them without
+        extra wiring."""
         self.model = model
         self.remote = remote
         self.app_version = app_version
@@ -174,6 +195,9 @@ class ServingApp:
         self._batcher_kwargs = batcher_kwargs
         self._server: Optional[ThreadingHTTPServer] = None
         self.registry = registry if registry is not None else telemetry.get_registry()
+        self._flight = (
+            flight if flight is not None else telemetry.get_flight_recorder()
+        )
         self._m_http_requests = self.registry.counter(
             "unionml_http_requests_total",
             "HTTP requests served, by transport/path/status.",
@@ -219,9 +243,13 @@ class ServingApp:
                 predictor = jit_predictor(predictor)
             self._batcher = MicroBatcher(
                 lambda feats: predictor(model_object, feats),
-                # the app's scrape must cover its own batcher even when
-                # the app was built with an isolated registry
-                **{"registry": self.registry, **self._batcher_kwargs},
+                # the app's scrape and /debug/flight must cover its own
+                # batcher even when the app was built with isolated sinks
+                **{
+                    "registry": self.registry,
+                    "flight": self._flight,
+                    **self._batcher_kwargs,
+                },
             )
         if self.warmup is not None:
             n = self.warmup(self.model.artifact.model_object)
@@ -299,7 +327,43 @@ class ServingApp:
         """The ``GET /metrics`` body: Prometheus text exposition of the
         app's registry (shared by both transports so they cannot drift).
         Serve with ``telemetry.EXPOSITION_CONTENT_TYPE``."""
+        # refresh the standard process gauges (process_start_time_
+        # seconds, unionml_tpu_build_info) so every scraped registry —
+        # isolated ones included — carries them
+        telemetry.publish_process_metrics(self.registry)
         return self.registry.exposition()
+
+    # -- debug/introspection surface (shared by both transports) ----------
+
+    def debug_profile(self, seconds: float = 2.0) -> dict:
+        """``POST /debug/profile?seconds=N``: capture an on-demand
+        ``jax.profiler`` trace and return its artifact directory
+        (docs/observability.md). Raises
+        :class:`~unionml_tpu.introspection.ProfileInProgress` (→ 409)
+        when a capture is already running, ``ValueError`` (→ 422) for a
+        non-positive duration."""
+        from unionml_tpu.introspection import capture_profile
+
+        return capture_profile(seconds)
+
+    def debug_memory(self) -> dict:
+        """``GET /debug/memory``: per-device ``memory_stats()`` plus a
+        live-buffer census (count/bytes by dtype and top shapes)."""
+        from unionml_tpu.introspection import device_memory_breakdown
+
+        return device_memory_breakdown()
+
+    def debug_flight(
+        self, n: Optional[int] = None, kind: Optional[str] = None,
+        rid: Optional[str] = None,
+    ) -> dict:
+        """``GET /debug/flight?n=K``: the newest ``K`` request
+        lifecycle events from the flight recorder (all retained when
+        unset), optionally filtered by event kind / request id."""
+        return {
+            **self._flight.stats(),
+            "events": self._flight.dump(n=n, kind=kind, rid=rid),
+        }
 
     def observe_request(
         self, transport: str, path: str, status: int, duration_ms: float
@@ -422,6 +486,13 @@ class ServingApp:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _route(self):
+                """``(path, query)`` with the query string split off —
+                ``/debug/flight?n=5`` must route as ``/debug/flight``
+                (and land in that metric series, not ``<other>``)."""
+                parts = urlsplit(self.path)
+                return parts.path, parse_qs(parts.query)
+
             def _observed(self, handler):
                 """Wrap one request: mint the X-Request-ID, time the
                 dispatch, land the per-endpoint series."""
@@ -432,7 +503,7 @@ class ServingApp:
                     handler()
                 finally:
                     app.observe_request(
-                        "stdlib", self.path, self._status or 500,
+                        "stdlib", self._route()[0], self._status or 500,
                         (time.perf_counter() - t0) * 1e3,
                     )
 
@@ -443,20 +514,37 @@ class ServingApp:
                 self._observed(self._post)
 
             def _get(self):
-                if self.path == "/":
+                path, query = self._route()
+                if path == "/":
                     self._send(200, app.root(), content_type="text/html")
-                elif self.path == "/health":
+                elif path == "/health":
                     h = app.health()
                     self._send(app.health_status(h), h)
-                elif self.path == "/stats":
+                elif path == "/stats":
                     self._send(200, app.stats())
-                elif self.path == "/metrics":
+                elif path == "/metrics":
                     self._send(
                         200, app.metrics_text(),
                         content_type=telemetry.EXPOSITION_CONTENT_TYPE,
                     )
+                elif path == "/debug/memory":
+                    try:
+                        self._send(200, app.debug_memory())
+                    except Exception as exc:
+                        self._send(500, {"error": str(exc)})
+                elif path == "/debug/flight":
+                    try:
+                        n = (
+                            int(query["n"][0]) if "n" in query else None
+                        )
+                        kind = query.get("kind", [None])[0]
+                        rid = query.get("rid", [None])[0]
+                    except (ValueError, IndexError) as exc:
+                        self._send(422, {"error": f"bad query: {exc}"})
+                        return
+                    self._send(200, app.debug_flight(n=n, kind=kind, rid=rid))
                 else:
-                    self._send(404, {"error": f"no route {self.path}"})
+                    self._send(404, {"error": f"no route {path}"})
 
             def _send_sse(self, frames):
                 """Stream pre-framed SSE strings; the connection closes
@@ -485,8 +573,12 @@ class ServingApp:
                     self.close_connection = True
 
             def _post(self):
-                if self.path not in ("/predict", "/predict/stream"):
-                    self._send(404, {"error": f"no route {self.path}"})
+                path, query = self._route()
+                if path == "/debug/profile":
+                    self._debug_profile(query)
+                    return
+                if path not in ("/predict", "/predict/stream"):
+                    self._send(404, {"error": f"no route {path}"})
                     return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
@@ -506,7 +598,7 @@ class ServingApp:
                     # batcher submissions on this request thread without
                     # threading a kwarg through every predictor wrapper
                     with deadline_scope(deadline_ms):
-                        if self.path == "/predict/stream":
+                        if path == "/predict/stream":
                             # predict_stream_events validates (and pulls
                             # the first chunk) BEFORE this point commits
                             # a 200 — errors still get a whole 4xx/5xx
@@ -526,6 +618,35 @@ class ServingApp:
                     self._send(422, {"error": str(exc)})
                 except Exception as exc:  # unexpected: surface as 500
                     logger.info(f"predict error: {exc!r}")
+                    self._send(500, {"error": str(exc)})
+
+            def _debug_profile(self, query):
+                """POST /debug/profile?seconds=N (or a {"seconds": N}
+                JSON body): blocking on-demand profiler capture. 409
+                while another capture runs — the profiler is a
+                process-global singleton."""
+                from unionml_tpu.introspection import ProfileInProgress
+
+                try:
+                    seconds = None
+                    if "seconds" in query:
+                        seconds = float(query["seconds"][0])
+                    else:
+                        length = int(self.headers.get("Content-Length", 0))
+                        if length:
+                            body = json.loads(self.rfile.read(length))
+                            if "seconds" in body:
+                                seconds = float(body["seconds"])
+                    result = app.debug_profile(
+                        **({} if seconds is None else {"seconds": seconds})
+                    )
+                    self._send(200, result)
+                except ProfileInProgress as exc:
+                    self._send(409, {"error": str(exc)})
+                except (ValueError, TypeError, json.JSONDecodeError) as exc:
+                    self._send(422, {"error": str(exc)})
+                except Exception as exc:
+                    logger.info(f"profile capture error: {exc!r}")
                     self._send(500, {"error": str(exc)})
 
         return Handler

@@ -9,8 +9,12 @@ Mangum/boto3, the adapters speak the event *shapes* directly and route to
 the transport-agnostic :class:`~unionml_tpu.serving.http.ServingApp`:
 
 - :func:`gateway_handler` — API-Gateway-style ``{httpMethod, path, body}``
-  events → ``{statusCode, body}`` responses (GET /, GET /health,
-  POST /predict). Works as an AWS Lambda handler as-is.
+  events → ``{statusCode, headers, body}`` responses (GET /,
+  GET /health with the non-ok→503 readiness contract, GET /stats,
+  Prometheus GET /metrics, POST /predict with the shared
+  429/503/504 fault mapping and ``X-Deadline-Ms`` propagation; every
+  response carries ``X-Request-ID``). Works as an AWS Lambda handler
+  as-is, with the same serving contract as the HTTP transports.
 - :func:`object_event_handler` — S3-style ``{Records: [{s3: {bucket,
   object}}]}`` events: read the uploaded feature file from an
   :class:`ObjectStore`, predict, write ``<key>.predictions.json`` back.
@@ -22,10 +26,20 @@ the transport-agnostic :class:`~unionml_tpu.serving.http.ServingApp`:
 from __future__ import annotations
 
 import json
+import time
 from pathlib import Path
 from typing import Any, Callable, Dict, Optional
 from urllib.parse import unquote_plus
 
+from unionml_tpu import telemetry
+from unionml_tpu.serving.faults import (
+    DeadlineExceeded,
+    EngineUnavailable,
+    Overloaded,
+    deadline_scope,
+    http_fault_response,
+    parse_deadline_header,
+)
 from unionml_tpu.serving.http import ServingApp
 
 
@@ -65,33 +79,103 @@ class LocalObjectStore(ObjectStore):
         path.write_bytes(data)
 
 
+def _event_headers(event: Dict[str, Any]) -> Dict[str, str]:
+    """Case-folded request headers from a gateway event (API-Gateway
+    forwards client headers lowercased in v2 events, mixed-case in v1)."""
+    raw = event.get("headers") or {}
+    return {str(k).lower(): str(v) for k, v in raw.items()}
+
+
 def gateway_handler(
     model,
     *,
     batch: bool = False,
     **serving_kwargs,
 ) -> Callable[[Dict[str, Any], Any], Dict[str, Any]]:
-    """Build a ``handler(event, context)`` for API-Gateway-style events."""
+    """Build a ``handler(event, context)`` for API-Gateway-style events.
+
+    Same serving contract as the HTTP transports
+    (:mod:`unionml_tpu.serving.http` / ``fastapi``):
+
+    - ``GET /metrics`` — Prometheus exposition of the app's registry,
+    - every response carries ``X-Request-ID`` (the incoming header is
+      echoed when the gateway forwarded one, else a fresh id is
+      minted) and lands in the ``transport="serverless"`` request
+      series,
+    - ``GET /health`` answers **503** for any non-``ok`` status
+      (draining / circuit breaker), so gateway health checks stop
+      routing here,
+    - typed serving faults map to the shared HTTP contract:
+      ``Overloaded`` → 429 + ``Retry-After``, ``EngineUnavailable`` →
+      503 + ``Retry-After``, ``DeadlineExceeded`` → 504; an
+      ``X-Deadline-Ms`` request header opens the same
+      :func:`~unionml_tpu.serving.faults.deadline_scope`,
+    - validation errors answer **422** (parity with both HTTP
+      transports; this was 400 before the contract was unified).
+    """
     app = ServingApp(model, batch=batch, **serving_kwargs)
 
     def handler(event: Dict[str, Any], context: Any = None) -> Dict[str, Any]:
         method = (event.get("httpMethod") or event.get("requestContext", {})
                   .get("http", {}).get("method", "GET")).upper()
         path = event.get("path") or event.get("rawPath") or "/"
+        headers = _event_headers(event)
+        rid = headers.get("x-request-id") or telemetry.new_request_id()
+        t0 = time.perf_counter()
+
+        def respond(
+            status: int, body: str, content_type: str = "application/json",
+            extra: Optional[Dict[str, str]] = None,
+        ) -> Dict[str, Any]:
+            app.observe_request(
+                "serverless", path, status,
+                (time.perf_counter() - t0) * 1e3,
+            )
+            return {
+                "statusCode": status,
+                "headers": {
+                    "Content-Type": content_type,
+                    "X-Request-ID": rid,
+                    **(extra or {}),
+                },
+                "body": body,
+            }
+
         try:
             if method == "GET" and path == "/":
-                return {"statusCode": 200, "headers": {"Content-Type": "text/html"},
-                        "body": app.root()}
+                return respond(200, app.root(), content_type="text/html")
             if method == "GET" and path == "/health":
-                return {"statusCode": 200, "body": json.dumps(app.health())}
+                h = app.health()
+                # non-ok => 503, the readiness contract the HTTP
+                # transports already serve (docs/robustness.md)
+                return respond(app.health_status(h), json.dumps(h))
+            if method == "GET" and path == "/stats":
+                return respond(200, json.dumps(app.stats()))
+            if method == "GET" and path == "/metrics":
+                return respond(
+                    200, app.metrics_text(),
+                    content_type=telemetry.EXPOSITION_CONTENT_TYPE,
+                )
             if method == "POST" and path == "/predict":
                 payload = json.loads(event.get("body") or "{}")
-                return {"statusCode": 200, "body": json.dumps(app.predict(payload))}
-            return {"statusCode": 404, "body": json.dumps({"error": f"no route {method} {path}"})}
-        except ValueError as e:
-            return {"statusCode": 400, "body": json.dumps({"error": str(e)})}
+                deadline_ms = parse_deadline_header(
+                    headers.get("x-deadline-ms")
+                )
+                with deadline_scope(deadline_ms):
+                    return respond(200, json.dumps(app.predict(payload)))
+            return respond(
+                404, json.dumps({"error": f"no route {method} {path}"})
+            )
+        except (Overloaded, EngineUnavailable, DeadlineExceeded) as e:
+            status, extra = http_fault_response(e)
+            body: Dict[str, Any] = {"error": str(e)}
+            if isinstance(e, EngineUnavailable):
+                body["reason"] = e.reason
+            return respond(status, json.dumps(body), extra=extra or None)
+        except (ValueError, KeyError, TypeError) as e:
+            return respond(422, json.dumps({"error": str(e)}))
         except Exception as e:  # pragma: no cover - defensive 500 surface
-            return {"statusCode": 500, "body": json.dumps({"error": str(e)})}
+            return respond(500, json.dumps({"error": str(e)}))
 
     handler.serving_app = app  # test/introspection seam
     return handler

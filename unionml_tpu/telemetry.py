@@ -23,11 +23,24 @@ them:
   trace-event JSON (loads in Perfetto / ``chrome://tracing``) and as
   structured JSON lines.
 
-Process-global defaults (:func:`get_registry`, :func:`get_tracer`) make
-independently-constructed components (an engine built outside the
-``ServingApp``, a trainer loop in the same process) land in the one
-scrape surface; pass explicit instances for isolation. Everything here
-is stdlib-only and safe to import before jax.
+- :class:`FlightRecorder` — a bounded ring buffer of per-request
+  lifecycle events (submit, prefill, decode chunks, sheds, recoveries)
+  the engine and batcher record into; dumped at ``GET /debug/flight``
+  and snapshotted into recovery trace spans for postmortems
+  (docs/observability.md).
+- :func:`percentile_summary` — the shared nearest-rank percentile
+  formula every stats surface uses (moved here from
+  ``serving._stats``, which re-exports it).
+- :func:`publish_process_metrics` — the standard
+  ``process_start_time_seconds`` and ``unionml_tpu_build_info`` gauges,
+  published into every scraped registry.
+
+Process-global defaults (:func:`get_registry`, :func:`get_tracer`,
+:func:`get_flight_recorder`) make independently-constructed components
+(an engine built outside the ``ServingApp``, a trainer loop in the same
+process) land in the one scrape surface; pass explicit instances for
+isolation. Everything here is stdlib-only and safe to import before
+jax.
 """
 
 from __future__ import annotations
@@ -36,24 +49,57 @@ import bisect
 import itertools
 import json
 import math
+import os
 import re
+import sys
 import threading
 import time
 import uuid
+from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 __all__ = [
     "DEFAULT_MS_BUCKETS",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "TraceRecorder",
+    "get_flight_recorder",
     "get_registry",
     "get_tracer",
     "instance_label",
     "new_request_id",
+    "percentile_summary",
+    "publish_process_metrics",
 ]
+
+
+def percentile_summary(values: Sequence[float]) -> dict:
+    """p50/p95/p99/mean/n of a non-empty sample.
+
+    Percentiles use nearest-rank ``ceil(q * n) - 1`` (the formula the
+    benchmarks, histogram summaries, StepTimer, and the program
+    registry all share through this helper): for small windows
+    ``int(q * n)`` indexes the sample MAXIMUM — one cold-compile outlier
+    would be reported as the p95 and misdirect tail-latency attribution.
+    ``n`` is the sample count, so a consumer can tell a p99 computed
+    over 3 requests from one computed over 10k.
+
+    (Moved here from ``unionml_tpu.serving._stats``, which re-exports
+    it: non-serving modules — diagnostics, introspection — need it too,
+    and telemetry is the layer they all already import.)
+    """
+    vals = sorted(values)
+    n = len(vals)
+    return {
+        "p50": round(vals[n // 2], 1),
+        "p95": round(vals[max(0, math.ceil(0.95 * n) - 1)], 1),
+        "p99": round(vals[max(0, math.ceil(0.99 * n) - 1)], 1),
+        "mean": round(sum(vals) / n, 1),
+        "n": n,
+    }
 
 # log-spaced ms buckets (1 / 2.5 / 5 per decade, 100 µs .. 1 min): wide
 # enough for a fused decode step (~2 ms) and a cold XLA compile (~20 s)
@@ -245,8 +291,6 @@ class Histogram(_Child):
             window = list(self._window)
         if not window:
             return {}
-        from unionml_tpu.serving._stats import percentile_summary
-
         return percentile_summary(window)
 
     def reset(self) -> None:
@@ -632,11 +676,186 @@ class _SpanContext:
 
 
 # --------------------------------------------------------------------- #
+# flight recorder
+# --------------------------------------------------------------------- #
+
+
+class FlightRecorder:
+    """Bounded ring buffer of per-request lifecycle events — the
+    postmortem record behind ``GET /debug/flight``.
+
+    The engine and batcher :meth:`record` structured events (submit,
+    prefill, decode chunks, sheds with their cause, recoveries) as they
+    happen; appends are O(1) on a preallocated deque under one lock, so
+    the recorder is safe on the dispatcher/harvester hot paths. When a
+    recovery fires, the events for the poisoned requests are
+    :meth:`snapshot`-ted into the recovery trace span, so a production
+    429/504/recovery is explainable after the fact.
+
+    Timestamps are monotonic-clock ms (offsets meaningful, absolutes
+    not) — the same clock every other telemetry surface uses.
+    """
+
+    def __init__(self, capacity: int = 4096):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._events: "deque[dict]" = deque(maxlen=capacity)
+        self._seq = 0
+
+    def record(self, kind: str, **fields: Any) -> None:
+        """Append one event (O(1)); ``fields`` must be JSON-safe."""
+        with self._lock:
+            self._seq += 1
+            self._events.append({
+                "seq": self._seq,
+                "t_ms": round(time.monotonic() * 1e3, 3),
+                "kind": kind,
+                **fields,
+            })
+
+    def dump(
+        self,
+        n: Optional[int] = None,
+        kind: Optional[str] = None,
+        rid: Optional[str] = None,
+    ) -> List[dict]:
+        """The newest ``n`` retained events (all when ``None``), oldest
+        first; optionally filtered by ``kind`` and/or request id."""
+        with self._lock:
+            events = list(self._events)
+        if kind is not None:
+            events = [e for e in events if e["kind"] == kind]
+        if rid is not None:
+            events = [
+                e for e in events
+                if e.get("rid") == rid or rid in e.get("rids", ())
+            ]
+        if n is not None:
+            n = int(n)
+            events = events[-n:] if n > 0 else []
+        return events
+
+    def snapshot(self, rids: Sequence[str], limit: int = 100) -> List[dict]:
+        """Events belonging to ``rids`` (newest ``limit``), for
+        attaching to a recovery trace span."""
+        wanted = set(rids)
+        with self._lock:
+            events = list(self._events)
+        hits = [
+            e for e in events
+            if e.get("rid") in wanted or wanted & set(e.get("rids", ()))
+        ]
+        limit = int(limit)
+        return hits[-limit:] if limit > 0 else []
+
+    @property
+    def total_recorded(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def stats(self) -> dict:
+        with self._lock:
+            retained = len(self._events)
+            total = self._seq
+        return {
+            "capacity": self.capacity,
+            "retained": retained,
+            "total_recorded": total,
+            "dropped": total - retained,
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._seq = 0
+
+
+# --------------------------------------------------------------------- #
+# process-level gauges (standard Prometheus conventions)
+# --------------------------------------------------------------------- #
+
+
+def _process_start_time_s() -> float:
+    """Epoch seconds this process started: /proc arithmetic on Linux
+    (field 22 of /proc/self/stat is start-after-boot in clock ticks;
+    btime in /proc/stat is boot epoch), falling back to this module's
+    import time — close enough, telemetry imports early."""
+    try:
+        with open("/proc/self/stat") as f:
+            # comm (field 2) may contain spaces/parens: split after it
+            stat = f.read().rsplit(")", 1)[1].split()
+        ticks = float(stat[19])  # field 22 overall; 20th after comm
+        with open("/proc/stat") as f:
+            btime = next(
+                float(line.split()[1])
+                for line in f
+                if line.startswith("btime ")
+            )
+        return btime + ticks / os.sysconf("SC_CLK_TCK")
+    except Exception:
+        return _IMPORT_WALL_S
+
+
+_IMPORT_WALL_S = time.time()
+
+# one published build-info labelset per registry: a late jax import
+# must not leave a second, stale child in the scrape
+_build_info_published: Dict[int, Tuple[Tuple[str, ...], Any]] = {}
+_build_info_lock = threading.Lock()
+
+
+def publish_process_metrics(registry: Optional[MetricsRegistry] = None) -> None:
+    """Register the standard process-level gauges on ``registry``
+    (default: the process-global one): ``process_start_time_seconds``
+    and ``unionml_tpu_build_info{version, jax_version, backend}`` = 1.
+
+    Called by ``ServingApp.metrics_text()`` before every exposition, so
+    any scraped registry carries them; label values resolve WITHOUT
+    importing jax (``backend="unloaded"`` until something else loads
+    it — this module must stay safe to import before jax), and a later
+    resolution replaces the earlier child rather than leaving two."""
+    reg = registry if registry is not None else _REGISTRY
+    reg.gauge(
+        "process_start_time_seconds",
+        "Start time of the process since unix epoch in seconds.",
+    ).set(_process_start_time_s())
+    try:
+        from unionml_tpu import __version__ as version
+    except Exception:
+        version = "unknown"
+    jax_version, backend = "unloaded", "unloaded"
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        jax_version = str(getattr(jax_mod, "__version__", "unknown"))
+        try:
+            backend = str(jax_mod.default_backend())
+        except Exception:
+            backend = "unknown"
+    labels = (str(version), jax_version, backend)
+    family = reg.gauge(
+        "unionml_tpu_build_info",
+        "Build/runtime identity; value is always 1. Labels carry the "
+        "package version, jax version, and active backend.",
+        ("version", "jax_version", "backend"),
+    )
+    with _build_info_lock:
+        prev = _build_info_published.get(id(reg))
+        if prev is not None and prev[0] != labels:
+            prev[1].set(0.0)  # supersede the pre-jax "unloaded" child
+        child = family.labels(*labels)
+        child.set(1.0)
+        _build_info_published[id(reg)] = (labels, child)
+
+
+# --------------------------------------------------------------------- #
 # process-global defaults
 # --------------------------------------------------------------------- #
 
 _REGISTRY = MetricsRegistry()
 _TRACER = TraceRecorder()
+_FLIGHT = FlightRecorder()
 
 
 def get_registry() -> MetricsRegistry:
@@ -648,3 +867,15 @@ def get_registry() -> MetricsRegistry:
 def get_tracer() -> TraceRecorder:
     """The process-global default trace recorder."""
     return _TRACER
+
+
+def get_flight_recorder() -> FlightRecorder:
+    """The process-global default flight recorder (what
+    ``GET /debug/flight`` serves, and where engines/batchers record by
+    default)."""
+    return _FLIGHT
+
+
+# the default registry always carries the process gauge, even for
+# consumers that call exposition() directly without a ServingApp
+publish_process_metrics(_REGISTRY)
