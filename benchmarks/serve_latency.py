@@ -20,6 +20,11 @@ Usage::
     # ^ program introspection: instrumentation-on vs -off wall delta
     #   with token parity asserted, plus the decode program's measured
     #   flops / recompiles / MFU (docs/observability.md)
+    UNIONML_TPU_BENCH_PRESET=serve_tracing python benchmarks/serve_latency.py
+    # ^ distributed tracing: W3C traceparent propagation + OTLP export
+    #   (against the in-process collector stub) on vs off — token
+    #   parity asserted, per-request p50/p99 overhead delta reported
+    #   (docs/observability.md "Distributed tracing & SLOs")
 """
 
 from __future__ import annotations
@@ -642,6 +647,153 @@ def introspection_leg() -> None:
     }))
 
 
+def tracing_leg() -> None:
+    """Distributed-tracing overhead report
+    (``UNIONML_TPU_BENCH_PRESET=serve_tracing``).
+
+    Runs the SAME request stream through a DecodeEngine twice — once
+    bare, once with W3C trace-context propagation (every request
+    submitted inside a ``trace_scope`` carrying a synthetic inbound
+    ``traceparent``) AND a live OTLP exporter shipping every finished
+    request's span tree plus metric snapshots to an in-process
+    collector stub — asserts the produced tokens are bit-identical,
+    and reports the per-request p50/p99 overhead delta. This is the
+    number that keeps the "propagation + push export stay off the
+    decode hot path" claim honest (the acceptance bar is ≤ 2% p99 on
+    the CPU smoke configuration).
+    """
+    import threading
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu import telemetry
+    from unionml_tpu.exporters import OtlpCollectorStub, OtlpExporter
+    from unionml_tpu.models import Llama, LlamaConfig
+    from unionml_tpu.serving._stats import percentile_summary
+    from unionml_tpu.serving.engine import DecodeEngine
+
+    backend = jax.default_backend()
+    if backend == "cpu":
+        cfg = serving_config("tiny")
+        module = Llama(cfg)
+        tokens0 = jnp.zeros((1, 8), jnp.int32)
+        params = jax.jit(module.init)(jax.random.PRNGKey(0), tokens0)["params"]
+        n_req, clients, new_tokens, bucket, slots, chunk_steps = 48, 4, 8, 16, 4, 4
+    else:
+        cfg = serving_config("serve_1p5b")
+        qcfg = LlamaConfig(**{**cfg.__dict__, "quantized": True})
+        module = Llama(qcfg)
+        params = random_quantized_params(module)
+        n_req, clients, new_tokens, bucket, slots, chunk_steps = 128, 8, 32, 64, 8, 8
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, bucket // 2).tolist()
+        for _ in range(n_req)
+    ]
+    results = {}
+    for traced in (False, True):
+        registry = telemetry.MetricsRegistry()
+        tracer = telemetry.TraceRecorder(registry=registry)
+        stub = exporter = None
+        if traced:
+            stub = OtlpCollectorStub()
+            exporter = OtlpExporter(
+                stub.endpoint, registry=registry, tracer=tracer,
+                interval_s=0.25, seed=0,
+            )
+        engine = DecodeEngine(
+            module, slots=slots, max_new_tokens=new_tokens,
+            prompt_buckets=(bucket,), chunk_steps=chunk_steps,
+            registry=registry, tracer=tracer,
+            flight=telemetry.FlightRecorder(),
+        )
+        try:
+            engine.warmup(params)
+            engine.reset_stats()
+            outs = [None] * n_req
+            lat, lock = [], threading.Lock()
+
+            def client(idx0):
+                for i in range(idx0, n_req, clients):
+                    ctx = telemetry.TraceContext(
+                        telemetry.new_trace_id(), telemetry.new_span_id()
+                    )
+                    t0 = time.perf_counter()
+                    if traced:
+                        with telemetry.trace_scope(ctx):
+                            out = engine.generate(params, [prompts[i]])
+                    else:
+                        out = engine.generate(params, [prompts[i]])
+                    dt = (time.perf_counter() - t0) * 1e3
+                    outs[i] = out[0]
+                    with lock:
+                        lat.append(dt)
+
+            threads = [
+                threading.Thread(target=client, args=(c,))
+                for c in range(clients)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            exported = dropped = 0
+            if exporter is not None:
+                exporter.flush()
+                exported = int(exporter._m_exported.value)
+                dropped = int(exporter._m_dropped.value)
+            results[traced] = {
+                "outs": outs,
+                "summary": percentile_summary(lat),
+                "wall_ms": wall_ms,
+                "exported_spans": exported,
+                "dropped": dropped,
+            }
+        finally:
+            engine.close()
+            if exporter is not None:
+                exporter.close(flush=False)
+            if stub is not None:
+                stub.close()
+    assert results[False]["outs"] == results[True]["outs"], (
+        "tracing + OTLP export changed produced tokens — parity violation"
+    )
+    for traced in (False, True):
+        r = results[traced]
+        print(json.dumps({
+            "metric": "serve_tracing_latency_ms",
+            "traced": traced,
+            "requests": n_req,
+            "clients": clients,
+            "new_tokens": new_tokens,
+            "p50_ms": r["summary"]["p50"],
+            "value": r["summary"]["p99"],
+            "wall_ms": round(r["wall_ms"], 1),
+            "unit": "ms",
+        }))
+    off, on = results[False]["summary"], results[True]["summary"]
+    print(json.dumps({
+        "metric": "serve_tracing_summary",
+        "tokens_identical": True,
+        "p50_delta_pct": round(
+            100.0 * (on["p50"] - off["p50"]) / max(off["p50"], 1e-9), 2
+        ),
+        "p99_delta_pct": round(
+            100.0 * (on["p99"] - off["p99"]) / max(off["p99"], 1e-9), 2
+        ),
+        "exported_spans": results[True]["exported_spans"],
+        "export_dropped": results[True]["dropped"],
+        "unit": "pct",
+    }))
+
+
 def overload_leg() -> None:
     """Admission control + supervised recovery under saturation
     (``UNIONML_TPU_BENCH_PRESET=serve_overload``).
@@ -797,7 +949,18 @@ def overload_leg() -> None:
 
 
 if __name__ == "__main__":
-    if os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_introspection":
+    if os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_tracing":
+        if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
+            os.environ.get("UNIONML_TPU_BENCH_PREFIX")
+        ):
+            # hardcoded workload, same rule as the other engine legs
+            raise SystemExit(
+                "UNIONML_TPU_BENCH_PRESET=serve_tracing takes no CLI "
+                f"flags or KV/PREFIX env legs (got {sys.argv[1:]}); its "
+                "workload is hardcoded in tracing_leg"
+            )
+        tracing_leg()
+    elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_introspection":
         if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
             os.environ.get("UNIONML_TPU_BENCH_PREFIX")
         ):

@@ -19,6 +19,11 @@ richer gate where installed):
   ``time.monotonic()``/``time.perf_counter()`` — wall clock steps under
   NTP slew and breaks deadline/latency accounting. (``time.time()`` is
   fine elsewhere, e.g. epoch timestamps in logs.)
+- metrics-doc drift (repo-wide, when the default paths are linted):
+  every ``unionml_*`` metric registered under ``unionml_tpu/`` must be
+  documented in ``docs/observability.md``, and every full metric name
+  the doc mentions must exist in code — the by-hand doc table
+  accumulated drift across PRs 1–4; this closes the loop both ways.
 
 Usage: ``python scripts/lint_basics.py [paths...]`` (default: the
 package, tests, benchmarks, scripts). Exits non-zero on findings.
@@ -27,6 +32,7 @@ package, tests, benchmarks, scripts). Exits non-zero on findings.
 from __future__ import annotations
 
 import ast
+import re
 import sys
 from pathlib import Path
 
@@ -198,6 +204,80 @@ def check_file(path: Path) -> list:
     return checker.problems
 
 
+METRICS_DOC = "docs/observability.md"
+# a registration call looks like registry.counter("name", ...) /
+# .gauge(...) / .histogram(...) — or the engine/batcher's local helper
+# shorthands counter("name", ...) / hist("name", ...); the first
+# positional string is the name either way
+_METRIC_FACTORIES = ("counter", "gauge", "histogram", "hist")
+# doc tokens that LOOK like metric names: the unionml_ prefix plus at
+# least two more underscore-separated words (filters out module-ish
+# mentions like `unionml_tpu.telemetry` → token "unionml_tpu" — while
+# real metric names, `unionml_tpu_build_info` included, always qualify)
+_DOC_METRIC_RE = re.compile(r"\bunionml(?:_[a-z0-9]+){2,}\b")
+# histogram/counter exposition suffixes a doc may legitimately mention
+_SERIES_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def registered_metric_names(package_root: Path) -> dict:
+    """``{metric_name: "file:line"}`` for every ``unionml_*`` metric
+    registered under the package (AST walk: the first string argument
+    of a ``.counter/.gauge/.histogram(...)`` call)."""
+    names: dict = {}
+    for path in sorted(package_root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"))
+        except SyntaxError:
+            continue  # reported by the per-file checker
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            func = node.func
+            factory = (
+                func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else None
+            )
+            if factory not in _METRIC_FACTORIES or not (
+                isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            name = node.args[0].value
+            if name.startswith("unionml_"):
+                names.setdefault(name, f"{path}:{node.args[0].lineno}")
+    return names
+
+
+def check_metrics_doc(root: Path) -> list:
+    """Both directions of the metrics/doc contract: registered names
+    must be documented; documented full names must be registered."""
+    doc_path = root / METRICS_DOC
+    if not doc_path.exists():
+        return [f"{METRICS_DOC}: missing (metric drift check needs it)"]
+    doc_text = doc_path.read_text(encoding="utf-8")
+    registered = registered_metric_names(root / "unionml_tpu")
+    problems = []
+    for name, where in sorted(registered.items()):
+        if name not in doc_text:
+            problems.append(
+                f"{where}: metric {name} is not documented in "
+                f"{METRICS_DOC}"
+            )
+    known = set(registered)
+    for name in known.copy():
+        known.update(name + suffix for suffix in _SERIES_SUFFIXES)
+    for lineno, line in enumerate(doc_text.splitlines(), 1):
+        for token in _DOC_METRIC_RE.findall(line):
+            if token not in known:
+                problems.append(
+                    f"{METRICS_DOC}:{lineno}: documented metric {token} "
+                    "is not registered anywhere under unionml_tpu/"
+                )
+    return problems
+
+
 def main(argv) -> int:
     paths = argv or DEFAULT_PATHS
     files: list = []
@@ -216,6 +296,10 @@ def main(argv) -> int:
         if "__pycache__" in f.parts:
             continue
         problems.extend(check_file(f))
+    if paths is DEFAULT_PATHS or "unionml_tpu" in paths:
+        # repo-wide contract, meaningful only when the package is in
+        # scope (a single-file lint must not fail on doc drift)
+        problems.extend(check_metrics_doc(ROOT))
     for p in problems:
         print(p)
     print(f"lint_basics: {len(files)} files, {len(problems)} problem(s)")

@@ -23,7 +23,14 @@ predictor call). The TPU-native redesign has two layers:
 Both engines, both transports, and the step trainer publish through the
 :mod:`unionml_tpu.telemetry` registry — one ``GET /metrics`` scrape
 covers every layer, and engine requests record Perfetto-exportable
-trace spans (docs/observability.md). The introspection layer
+trace spans (docs/observability.md). The distributed half: transports
+parse/echo W3C ``traceparent`` headers and open a
+:func:`~unionml_tpu.telemetry.trace_scope` so engine/batcher spans
+join the caller's trace, an OTLP/HTTP exporter
+(:mod:`unionml_tpu.exporters`) pushes spans + metric snapshots to a
+collector, and an SLO watchdog (:mod:`unionml_tpu.slo`) evaluates
+burn-rate objectives against the live registry, feeding
+``GET /health`` → ``degraded``. The introspection layer
 (:mod:`unionml_tpu.introspection`) adds hardware truth on top: per-
 program XLA cost analysis with live MFU/roofline gauges, on-demand
 profiler capture (``POST /debug/profile``), a device-memory breakdown

@@ -105,6 +105,9 @@ class _Pending:
     # absolute perf_counter deadline (None = none): enforced at drain,
     # so an expired entry is shed before joining a device batch
     deadline: Optional[float] = None
+    # telemetry trace timeline (created on the submitting thread, so it
+    # inherits the transport's W3C trace scope): queue + predict spans
+    rid: str = ""
 
 
 class MicroBatcher:
@@ -119,6 +122,7 @@ class MicroBatcher:
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         row_lists: bool = False,
         registry: Optional[telemetry.MetricsRegistry] = None,
+        tracer: Optional[telemetry.TraceRecorder] = None,
         max_queue_depth: Optional[int] = None,
         fault_injector=None,
         introspect: bool = True,
@@ -132,6 +136,14 @@ class MicroBatcher:
         ``registry``: explicit telemetry sink; defaults to the
         process-global registry so ``GET /metrics`` covers this batcher
         (series isolated per instance by the ``batcher`` label).
+
+        ``tracer``: explicit :class:`~unionml_tpu.telemetry
+        .TraceRecorder`; defaults to the process-global one. Every
+        ``submit()`` opens a request timeline on the SUBMITTING thread
+        — so it joins the transport's W3C
+        :func:`~unionml_tpu.telemetry.trace_scope` when one is open —
+        and records ``queue`` and ``predict`` spans around the shared
+        device call.
 
         ``max_queue_depth``: admission control — a ``submit()`` that
         would push the not-yet-batched queue past this many entries
@@ -172,6 +184,7 @@ class MicroBatcher:
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
         self._stop = threading.Event()
         self._registry = registry if registry is not None else telemetry.get_registry()
+        self._tracer = tracer if tracer is not None else telemetry.get_tracer()
         self.instance = telemetry.instance_label("batcher")
         self._build_instruments()
         # program introspection + flight recording (docs/observability
@@ -329,6 +342,12 @@ class MicroBatcher:
             # without this lock): the entry's 'submit' flight event
             # always precedes its 'batch'/'drop'. queue_depth = entries
             # ahead of this one.
+            # created on the submitting thread INSIDE admission: it
+            # inherits the transport's ambient trace scope, and a
+            # rejected submit never opens a timeline to leak
+            pending.rid = self._tracer.new_request(
+                "batch", batcher=self.instance, rows=pending.rows
+            )
             self._flight_rec(
                 "submit", rows=pending.rows,
                 queue_depth=self._queue.qsize(),
@@ -442,6 +461,7 @@ class MicroBatcher:
             except queue.Empty:
                 break
             pending.error = RuntimeError("micro-batcher closed")
+            self._tracer.finish_request(pending.rid)
             pending.event.set()
             self._dispose()
 
@@ -455,6 +475,7 @@ class MicroBatcher:
         if p.abandoned:
             self._m_abandoned.inc()
             self._flight_rec("drop", cause="abandoned", rows=p.rows)
+            self._tracer.finish_request(p.rid)
             self._dispose()
             return True
         if p.deadline is not None and time.perf_counter() > p.deadline:
@@ -469,6 +490,7 @@ class MicroBatcher:
                 "drop", cause="deadline_shed", rows=p.rows,
                 waited_ms=round(waited_ms, 3),
             )
+            self._tracer.finish_request(p.rid)
             p.event.set()
             self._dispose()
             return True
@@ -510,6 +532,9 @@ class MicroBatcher:
             batch = self._drain()
             # belt: a submit may time out between drain and dispatch
             still_live = [p for p in batch if not p.abandoned]
+            for p in batch:
+                if p.abandoned:
+                    self._tracer.finish_request(p.rid)
             self._m_abandoned.inc(len(batch) - len(still_live))
             self._dispose(len(batch) - len(still_live))
             batch = still_live
@@ -544,7 +569,19 @@ class MicroBatcher:
                         out = np.asarray(out)
                     parts.append(_slice_rows(out, 0, stop - start, rl))
                 result = _concat(parts, rl) if len(parts) > 1 else parts[0]
-                device_ms = (time.perf_counter() - t_start) * 1e3
+                t_end = time.perf_counter()
+                device_ms = (t_end - t_start) * 1e3
+                for p in batch:
+                    # queue → predict, mirroring the engine's span
+                    # vocabulary; the shared device call is one span
+                    # per entry so each request's tree is self-complete
+                    self._tracer.record_span(
+                        p.rid, "queue", p.submitted, t_start
+                    )
+                    self._tracer.record_span(
+                        p.rid, "predict", t_start, t_end, rows=p.rows,
+                        batch_rows=total,
+                    )
                 offset = 0
                 for p in batch:
                     p.result = _slice_rows(result, offset, offset + p.rows, rl)
@@ -571,5 +608,6 @@ class MicroBatcher:
                     p.error = exc
             finally:
                 for p in batch:
+                    self._tracer.finish_request(p.rid)
                     p.event.set()
                 self._dispose(len(batch))

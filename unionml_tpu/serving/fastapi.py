@@ -51,7 +51,7 @@ def serving_app(
         return core
 
     try:
-        from fastapi import FastAPI, HTTPException, Request  # gated optional import
+        from fastapi import FastAPI, HTTPException, Request, Response  # gated optional import
         from fastapi.responses import HTMLResponse
     except ImportError as exc:
         raise ImportError(
@@ -89,13 +89,22 @@ def serving_app(
 
     # sync `def` (here and on /predict/stream), not `async def`: FastAPI
     # then runs the blocking predictor call in the threadpool instead of
-    # freezing the event loop — and the thread-local deadline_scope
-    # stays on the thread that performs the engine/batcher submission.
+    # freezing the event loop — and the thread-local deadline_scope AND
+    # trace_scope stay on the thread that performs the engine/batcher
+    # submission (the middleware's thread is the event loop's, so the
+    # traceparent must be parsed HERE, like the deadline header).
     @app.post("/predict")
-    def predict(payload: dict, request: Request):  # reference: fastapi.py:50-64
+    def predict(payload: dict, request: Request, response: Response):
+        # reference: fastapi.py:50-64
         try:
-            with deadline_scope(_parse_deadline(request)):
-                return core.predict(payload)
+            with core.traced_request(
+                "/predict", request.headers.get("traceparent")
+            ) as ctx:
+                response.headers["traceparent"] = (
+                    telemetry.format_traceparent(ctx)
+                )
+                with deadline_scope(_parse_deadline(request)):
+                    return core.predict(payload)
         except _FAULTS as exc:
             raise _fault_http(exc)
         except (ValueError, KeyError, TypeError) as exc:
@@ -109,14 +118,40 @@ def serving_app(
     def predict_stream(payload: dict, request: Request):  # SSE token streaming
         from fastapi.responses import StreamingResponse
 
+        # the open/finish seam, not the context manager: the response
+        # body outlives this handler frame, and the server span must
+        # cover the WHOLE stream (parity with the stdlib transport),
+        # so the timeline closes when the frame generator does. The
+        # trace_scope itself only needs to cover the validating
+        # first-chunk pull — that is where the engine timeline is
+        # created and parented.
+        ctx, finish = core.open_traced_request(
+            "/predict/stream", request.headers.get("traceparent")
+        )
         try:
-            with deadline_scope(_parse_deadline(request)):
-                frames = core.predict_stream_events(payload)
+            with telemetry.trace_scope(ctx):
+                with deadline_scope(_parse_deadline(request)):
+                    frames = core.predict_stream_events(payload)
         except _FAULTS as exc:
+            finish()
             raise _fault_http(exc)
         except (ValueError, KeyError, TypeError) as exc:
+            finish()
             raise HTTPException(status_code=422, detail=str(exc))
-        return StreamingResponse(frames, media_type="text/event-stream")
+        except BaseException:
+            finish()
+            raise
+
+        def stream_then_finish():
+            try:
+                yield from frames
+            finally:
+                finish()
+
+        return StreamingResponse(
+            stream_then_finish(), media_type="text/event-stream",
+            headers={"traceparent": telemetry.format_traceparent(ctx)},
+        )
 
     @app.get("/health")
     async def health():  # reference: fastapi.py:66-70
@@ -166,8 +201,29 @@ def serving_app(
     ):
         return core.debug_flight(n=n, kind=kind, rid=rid)
 
-    # one middleware gives every route the X-Request-ID header and the
-    # per-endpoint request/error/latency series, through the SAME
+    @app.get("/debug/trace")
+    async def debug_trace(format: str = "chrome"):
+        from fastapi.responses import Response as RawResponse
+
+        try:
+            body, content_type = core.debug_trace(format)
+        except ValueError as exc:
+            raise HTTPException(status_code=422, detail=str(exc))
+        if isinstance(body, str):
+            return RawResponse(body, media_type=content_type)
+        return body  # chrome: plain JSON
+
+    @app.get("/debug/slo")
+    async def debug_slo():
+        try:
+            return core.debug_slo()
+        except ValueError as exc:
+            raise HTTPException(status_code=422, detail=str(exc))
+
+    # one middleware gives every route the X-Request-ID header, the
+    # traceparent echo (predict endpoints already set their recorded
+    # server context — setdefault keeps it), and the per-endpoint
+    # request/error/latency series, through the SAME
     # ServingApp.observe_request the stdlib transport uses
     @app.middleware("http")
     async def telemetry_middleware(request, call_next):
@@ -185,6 +241,12 @@ def serving_app(
             )
             raise
         response.headers["X-Request-ID"] = rid
+        if "traceparent" not in response.headers:
+            response.headers["traceparent"] = telemetry.format_traceparent(
+                telemetry.server_trace_context(
+                    request.headers.get("traceparent")
+                )
+            )
         core.observe_request(
             "fastapi", request.url.path, response.status_code,
             (time.perf_counter() - t0) * 1e3,

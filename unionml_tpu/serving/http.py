@@ -39,11 +39,29 @@ Same endpoint surface as the reference's FastAPI app
 - ``GET /debug/flight?n=K`` — the request flight recorder's newest
   events (admissions, decode chunks, sheds, recoveries) for
   after-the-fact explanation of a 429/504/recovery
-  (docs/observability.md).
+  (docs/observability.md),
+- ``GET /debug/trace?format=chrome|jsonl`` — the trace recorder's
+  Chrome-trace / JSON-lines export over HTTP (no shelling into the
+  process to pull a trace),
+- ``GET /debug/slo`` — the SLO watchdog's burn-rate report when the
+  app was built with one (``ServingApp(slo=...)``).
 
 Every response carries an ``X-Request-ID`` header (a generated
 telemetry request id) and lands in the per-endpoint
 ``unionml_http_requests_total`` / ``unionml_http_request_ms`` series.
+
+Distributed tracing (docs/observability.md): every request parses an
+inbound W3C ``traceparent`` header (a fresh root is minted when absent
+or malformed — tracing metadata can never 5xx a request) and the
+response echoes a ``traceparent`` carrying the same trace id, so
+callers can stitch the full request tree. ``POST /predict`` and
+``/predict/stream`` additionally open a recorded server timeline and a
+:func:`~unionml_tpu.telemetry.trace_scope` around the predictor call,
+so engine/batcher spans join the caller's trace with connected parent
+links. ``ServingApp(otlp_endpoint=...)`` (or
+``UNIONML_TPU_OTLP_ENDPOINT``) starts a background
+:class:`~unionml_tpu.exporters.OtlpExporter` pushing spans and metric
+snapshots to an OTLP/HTTP collector.
 
 Fault tolerance at the transport boundary (docs/robustness.md): an
 ``X-Deadline-Ms`` request header opens a :func:`~unionml_tpu.serving
@@ -67,8 +85,9 @@ import json
 import os
 import threading
 import time
+from contextlib import contextmanager
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Optional
+from typing import Any, Iterator, Optional
 from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
@@ -88,8 +107,15 @@ from unionml_tpu.serving.faults import (
 # of letting a scanner mint a metric per probed URL
 KNOWN_ROUTES = (
     "/", "/predict", "/predict/stream", "/health", "/stats", "/metrics",
-    "/debug/profile", "/debug/memory", "/debug/flight",
+    "/debug/profile", "/debug/memory", "/debug/flight", "/debug/trace",
+    "/debug/slo",
 )
+
+# the routes that open a RECORDED trace timeline (a server span the
+# engine/batcher spans parent to); every other route still parses and
+# echoes traceparent, but health probes and scrapes must not churn the
+# trace ring or the OTLP export queue
+TRACED_ROUTES = ("/predict", "/predict/stream")
 
 LANDING_HTML = """<html><head><title>unionml-tpu</title></head>
 <body><h1>unionml-tpu serving: {name}</h1>
@@ -134,6 +160,9 @@ class ServingApp:
         health: Optional[Any] = None,
         drain: Optional[Any] = None,
         flight: Optional[telemetry.FlightRecorder] = None,
+        tracer: Optional[telemetry.TraceRecorder] = None,
+        otlp_endpoint: Optional[str] = None,
+        slo: Optional[Any] = None,
         **batcher_kwargs,
     ):
         """``warmup``: optional callable invoked with the loaded model
@@ -177,7 +206,26 @@ class ServingApp:
         .FlightRecorder` served at ``GET /debug/flight``; defaults to
         the process-global recorder, where engines and batchers record
         by default — so the postmortem surface covers them without
-        extra wiring."""
+        extra wiring.
+
+        ``tracer``: explicit :class:`~unionml_tpu.telemetry
+        .TraceRecorder` for the transport's server spans and
+        ``GET /debug/trace``; defaults to the process-global recorder
+        (where engines record), so the exported trace holds the
+        transport AND engine spans of each request in one tree.
+
+        ``otlp_endpoint``: an OTLP/HTTP collector base URL (e.g.
+        ``http://collector:4318``) — when set (or via the
+        ``UNIONML_TPU_OTLP_ENDPOINT`` env var), the app runs a
+        background :class:`~unionml_tpu.exporters.OtlpExporter`
+        pushing finished request spans and periodic metric snapshots;
+        :meth:`shutdown` closes it.
+
+        ``slo``: a :class:`~unionml_tpu.slo.SloWatchdog` — evaluated on
+        every ``GET /health`` (the probe cadence is the sampling
+        cadence) and served at ``GET /debug/slo``; a breached
+        objective flips health to ``degraded`` → 503, so load
+        balancers react to objective burn, not just crash loops."""
         self.model = model
         self.remote = remote
         self.app_version = app_version
@@ -198,6 +246,16 @@ class ServingApp:
         self._flight = (
             flight if flight is not None else telemetry.get_flight_recorder()
         )
+        self._tracer = tracer if tracer is not None else telemetry.get_tracer()
+        self._slo = slo
+        self._otlp = None
+        endpoint = otlp_endpoint or os.getenv("UNIONML_TPU_OTLP_ENDPOINT")
+        if endpoint:
+            from unionml_tpu.exporters import OtlpExporter
+
+            self._otlp = OtlpExporter(
+                endpoint, registry=self.registry, tracer=self._tracer
+            )
         self._m_http_requests = self.registry.counter(
             "unionml_http_requests_total",
             "HTTP requests served, by transport/path/status.",
@@ -243,11 +301,13 @@ class ServingApp:
                 predictor = jit_predictor(predictor)
             self._batcher = MicroBatcher(
                 lambda feats: predictor(model_object, feats),
-                # the app's scrape and /debug/flight must cover its own
-                # batcher even when the app was built with isolated sinks
+                # the app's scrape, /debug/flight, and /debug/trace must
+                # cover its own batcher even when the app was built with
+                # isolated sinks
                 **{
                     "registry": self.registry,
                     "flight": self._flight,
+                    "tracer": self._tracer,
                     **self._batcher_kwargs,
                 },
             )
@@ -276,6 +336,14 @@ class ServingApp:
             src = self._batcher.health
         if src is not None:
             out.update(src())
+        if self._slo is not None:
+            # the watchdog samples on the health-probe cadence; a
+            # breached objective degrades an otherwise-ok replica so
+            # the balancer reacts to objective burn, not just crashes
+            breached = self._slo.evaluate().get("breached", [])
+            out["slo_breached"] = breached
+            if breached and out["status"] == "ok":
+                out["status"] = "degraded"
         if self._draining:
             # app-level drain overrides the component view: this
             # process is going away even if the engine itself is idle
@@ -364,6 +432,81 @@ class ServingApp:
             **self._flight.stats(),
             "events": self._flight.dump(n=n, kind=kind, rid=rid),
         }
+
+    def debug_trace(self, format: str = "chrome"):
+        """``GET /debug/trace?format=chrome|jsonl``: the trace
+        recorder's retained requests — ``(body, content_type)``.
+        ``chrome`` (default) is the Perfetto-loadable trace-event JSON;
+        ``jsonl`` one span per line for log shippers. Raises
+        ``ValueError`` (→ 422) for any other format."""
+        if format == "chrome":
+            return self._tracer.export_chrome(), "application/json"
+        if format == "jsonl":
+            return self._tracer.export_jsonl(), "application/x-ndjson"
+        raise ValueError(
+            f"unknown trace format {format!r} (use chrome or jsonl)"
+        )
+
+    def debug_slo(self) -> dict:
+        """``GET /debug/slo``: a fresh SLO watchdog evaluation (burn
+        rates per objective and window, breach flags). Raises
+        ``ValueError`` (→ 422) when the app has no watchdog."""
+        if self._slo is None:
+            raise ValueError(
+                "no SLO watchdog on this app — construct "
+                "ServingApp(slo=SloWatchdog([...]))"
+            )
+        return self._slo.evaluate()
+
+    def open_traced_request(self, path: str, raw_traceparent: Optional[str]):
+        """``(ctx, finish)`` — the non-context-manager seam for
+        transports whose response outlives the handler frame (the
+        FastAPI streaming route hands its body to the event loop):
+        opens the recorded server timeline parented to the inbound
+        ``traceparent`` and returns its context plus an idempotent
+        ``finish()`` that records the server span and closes the
+        timeline — callable exactly-once-effective from any thread.
+        Prefer :meth:`traced_request` where the handler frame spans
+        the response."""
+        inbound = telemetry.parse_traceparent(raw_traceparent)
+        rid = self._tracer.new_request("http", trace_ctx=inbound, path=path)
+        ctx = self._tracer.trace_context(rid)
+        t0 = time.perf_counter()
+        finished = threading.Event()
+
+        def finish() -> None:
+            if finished.is_set():
+                return
+            finished.set()
+            # the server span makes the transport visible in the
+            # chrome/jsonl exports (which emit recorded spans only; the
+            # OTLP export additionally synthesizes the timeline root)
+            self._tracer.record_span(
+                rid, f"http {path}", t0, time.perf_counter()
+            )
+            self._tracer.finish_request(rid)
+
+        return ctx, finish
+
+    @contextmanager
+    def traced_request(
+        self, path: str, raw_traceparent: Optional[str]
+    ) -> Iterator[telemetry.TraceContext]:
+        """One traced transport request (shared by all three
+        transports so the propagation contract cannot drift): opens a
+        recorded server timeline parented to the inbound
+        ``traceparent`` (minting a root when absent/malformed — never
+        an error), exposes its context to engine/batcher submissions
+        on this thread via :func:`~unionml_tpu.telemetry.trace_scope`,
+        and yields the context whose
+        :func:`~unionml_tpu.telemetry.format_traceparent` the response
+        must echo."""
+        ctx, finish = self.open_traced_request(path, raw_traceparent)
+        try:
+            with telemetry.trace_scope(ctx):
+                yield ctx
+        finally:
+            finish()
 
     def observe_request(
         self, transport: str, path: str, status: int, duration_ms: float
@@ -467,6 +610,7 @@ class ServingApp:
             # per-request telemetry, set by the do_* wrappers
             _rid = ""
             _status = 0
+            _trace_ctx: Optional[telemetry.TraceContext] = None
 
             def log_message(self, fmt, *args):
                 logger.info(f"http: {fmt % args}")
@@ -481,6 +625,11 @@ class ServingApp:
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
                 self.send_header("X-Request-ID", self._rid)
+                if self._trace_ctx is not None:
+                    self.send_header(
+                        "traceparent",
+                        telemetry.format_traceparent(self._trace_ctx),
+                    )
                 for name, value in (extra_headers or {}).items():
                     self.send_header(name, value)
                 self.end_headers()
@@ -494,16 +643,29 @@ class ServingApp:
                 return parts.path, parse_qs(parts.query)
 
             def _observed(self, handler):
-                """Wrap one request: mint the X-Request-ID, time the
-                dispatch, land the per-endpoint series."""
+                """Wrap one request: mint the X-Request-ID, resolve the
+                W3C trace context (predict routes open a recorded
+                server timeline; everything else just echoes), time
+                the dispatch, land the per-endpoint series."""
                 self._rid = telemetry.new_request_id()
                 self._status = 0
+                path = self._route()[0]
+                raw_tp = self.headers.get("traceparent")
                 t0 = time.perf_counter()
                 try:
-                    handler()
+                    # method-checked: a GET probe/scan of /predict 404s
+                    # without opening a recorded timeline, so probes
+                    # can never churn the trace ring or the OTLP queue
+                    if path in TRACED_ROUTES and self.command == "POST":
+                        with app.traced_request(path, raw_tp) as ctx:
+                            self._trace_ctx = ctx
+                            handler()
+                    else:
+                        self._trace_ctx = telemetry.server_trace_context(raw_tp)
+                        handler()
                 finally:
                     app.observe_request(
-                        "stdlib", self._route()[0], self._status or 500,
+                        "stdlib", path, self._status or 500,
                         (time.perf_counter() - t0) * 1e3,
                     )
 
@@ -543,6 +705,19 @@ class ServingApp:
                         self._send(422, {"error": f"bad query: {exc}"})
                         return
                     self._send(200, app.debug_flight(n=n, kind=kind, rid=rid))
+                elif path == "/debug/trace":
+                    fmt = query.get("format", ["chrome"])[0]
+                    try:
+                        body, content_type = app.debug_trace(fmt)
+                    except ValueError as exc:
+                        self._send(422, {"error": str(exc)})
+                        return
+                    self._send(200, body, content_type=content_type)
+                elif path == "/debug/slo":
+                    try:
+                        self._send(200, app.debug_slo())
+                    except ValueError as exc:
+                        self._send(422, {"error": str(exc)})
                 else:
                     self._send(404, {"error": f"no route {path}"})
 
@@ -559,6 +734,11 @@ class ServingApp:
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
                 self.send_header("X-Request-ID", self._rid)
+                if self._trace_ctx is not None:
+                    self.send_header(
+                        "traceparent",
+                        telemetry.format_traceparent(self._trace_ctx),
+                    )
                 self.end_headers()
                 try:
                     for frame in frames:
@@ -678,6 +858,11 @@ class ServingApp:
         if self._batcher is not None:
             self._batcher.close()
             self._batcher = None
+        if self._otlp is not None:
+            self._otlp.close()
+            self._otlp = None
+        if self._slo is not None:
+            self._slo.stop()
 
 
 def create_app(model, **kwargs) -> ServingApp:

@@ -98,10 +98,19 @@ def gateway_handler(
     (:mod:`unionml_tpu.serving.http` / ``fastapi``):
 
     - ``GET /metrics`` — Prometheus exposition of the app's registry,
+    - ``GET /debug/trace?format=chrome|jsonl`` and ``GET /debug/slo``
+      — the trace export and SLO burn-rate report, same contract as
+      the HTTP transports,
     - every response carries ``X-Request-ID`` (the incoming header is
       echoed when the gateway forwarded one, else a fresh id is
       minted) and lands in the ``transport="serverless"`` request
       series,
+    - W3C trace propagation: an inbound ``traceparent`` header is
+      parsed (a root is minted when absent/malformed), ``POST
+      /predict`` opens the shared
+      :meth:`~unionml_tpu.serving.http.ServingApp.traced_request`
+      timeline so engine/batcher spans join the caller's trace, and
+      every response echoes a ``traceparent``,
     - ``GET /health`` answers **503** for any non-``ok`` status
       (draining / circuit breaker), so gateway health checks stop
       routing here,
@@ -121,6 +130,10 @@ def gateway_handler(
         path = event.get("path") or event.get("rawPath") or "/"
         headers = _event_headers(event)
         rid = headers.get("x-request-id") or telemetry.new_request_id()
+        raw_traceparent = headers.get("traceparent")
+        # echoed on every response; /predict swaps in its recorded
+        # server-span context below so callers stitch the full tree
+        trace_ctx = telemetry.server_trace_context(raw_traceparent)
         t0 = time.perf_counter()
 
         def respond(
@@ -136,6 +149,7 @@ def gateway_handler(
                 "headers": {
                     "Content-Type": content_type,
                     "X-Request-ID": rid,
+                    "traceparent": telemetry.format_traceparent(trace_ctx),
                     **(extra or {}),
                 },
                 "body": body,
@@ -156,13 +170,25 @@ def gateway_handler(
                     200, app.metrics_text(),
                     content_type=telemetry.EXPOSITION_CONTENT_TYPE,
                 )
+            if method == "GET" and path == "/debug/trace":
+                fmt = (event.get("queryStringParameters") or {}).get(
+                    "format", "chrome"
+                )
+                body_out, content_type = app.debug_trace(fmt)
+                if not isinstance(body_out, str):
+                    body_out = json.dumps(body_out)
+                return respond(200, body_out, content_type=content_type)
+            if method == "GET" and path == "/debug/slo":
+                return respond(200, json.dumps(app.debug_slo()))
             if method == "POST" and path == "/predict":
                 payload = json.loads(event.get("body") or "{}")
                 deadline_ms = parse_deadline_header(
                     headers.get("x-deadline-ms")
                 )
-                with deadline_scope(deadline_ms):
-                    return respond(200, json.dumps(app.predict(payload)))
+                with app.traced_request("/predict", raw_traceparent) as ctx:
+                    trace_ctx = ctx
+                    with deadline_scope(deadline_ms):
+                        return respond(200, json.dumps(app.predict(payload)))
             return respond(
                 404, json.dumps({"error": f"no route {method} {path}"})
             )

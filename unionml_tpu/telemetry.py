@@ -21,7 +21,14 @@ them:
   clock (``queue → prefill → decode-chunk[i] → harvest`` in the decode
   engine), keyed by a generated request id, exportable as Chrome
   trace-event JSON (loads in Perfetto / ``chrome://tracing``) and as
-  structured JSON lines.
+  structured JSON lines. Every request timeline carries a real **W3C
+  trace context** (128-bit trace id, 64-bit span ids, parent links):
+  the transports parse an inbound ``traceparent`` header
+  (:func:`parse_traceparent`), open a :func:`trace_scope` around the
+  predictor call, and the recorder picks the ambient context up in
+  :meth:`~TraceRecorder.new_request` — so engine/batcher spans join
+  the caller's distributed trace, and the OTLP exporter
+  (:mod:`unionml_tpu.exporters`) can ship a connected span tree.
 
 - :class:`FlightRecorder` — a bounded ring buffer of per-request
   lifecycle events (submit, prefill, decode chunks, sheds, recoveries)
@@ -56,6 +63,8 @@ import threading
 import time
 import uuid
 from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 __all__ = [
@@ -65,14 +74,22 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "TraceContext",
     "TraceRecorder",
+    "current_trace_context",
+    "format_traceparent",
     "get_flight_recorder",
     "get_registry",
     "get_tracer",
     "instance_label",
     "new_request_id",
+    "new_span_id",
+    "new_trace_id",
+    "parse_traceparent",
     "percentile_summary",
     "publish_process_metrics",
+    "server_trace_context",
+    "trace_scope",
 ]
 
 
@@ -507,6 +524,108 @@ EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 # --------------------------------------------------------------------- #
+# W3C trace context (https://www.w3.org/TR/trace-context/)
+# --------------------------------------------------------------------- #
+
+# version 00: `00-<32 hex trace-id>-<16 hex parent-id>-<2 hex flags>`;
+# all-zero trace/span ids are invalid per spec and treated as absent
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """One W3C trace-context position: the trace a request belongs to
+    (``trace_id``, 32 hex chars) and the span new children should
+    parent to (``span_id``, 16 hex chars). ``sampled`` mirrors the
+    ``traceparent`` sampled flag (recording here never depends on it;
+    it is echoed so downstream samplers see the caller's decision)."""
+
+    trace_id: str
+    span_id: str
+    sampled: bool = True
+
+
+def new_trace_id() -> str:
+    """A 32-hex-char (128-bit) W3C trace id (never all-zero: uuid4's
+    version bits are fixed)."""
+    return uuid.uuid4().hex
+
+
+def new_span_id() -> str:
+    """A 16-hex-char (64-bit) W3C span id (never all-zero: the uuid4
+    version nibble lands inside the first 16 chars)."""
+    return uuid.uuid4().hex[:16]
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[TraceContext]:
+    """Parse a ``traceparent`` header into a :class:`TraceContext`.
+
+    Returns ``None`` for an absent OR malformed header — the transport
+    contract is to mint a fresh root in that case, never to 5xx a
+    request over its tracing metadata (a broken upstream proxy must not
+    take serving down). Rejected per spec: bad shape/hex, version
+    ``ff``, all-zero trace or span id. Future versions (``01``+) parse
+    leniently as version-00, as the spec requires."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if m is None:
+        return None
+    version, trace_id, span_id, flags = m.groups()
+    if version == "ff":
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return TraceContext(trace_id, span_id, sampled=bool(int(flags, 16) & 1))
+
+
+def format_traceparent(ctx: TraceContext) -> str:
+    """Render a :class:`TraceContext` as a version-00 ``traceparent``
+    header value (what transports echo on responses)."""
+    return f"00-{ctx.trace_id}-{ctx.span_id}-{'01' if ctx.sampled else '00'}"
+
+
+def server_trace_context(raw_header: Optional[str]) -> TraceContext:
+    """The context a transport should echo for routes that do not open
+    a recorded server timeline (health, metrics, debug): the caller's
+    trace id when a valid ``traceparent`` arrived (else a minted root),
+    with a fresh span id — enough for the caller to correlate the
+    response with its trace."""
+    inbound = parse_traceparent(raw_header)
+    return TraceContext(
+        trace_id=inbound.trace_id if inbound else new_trace_id(),
+        span_id=new_span_id(),
+        sampled=inbound.sampled if inbound else True,
+    )
+
+
+_trace_tls = threading.local()
+
+
+@contextmanager
+def trace_scope(ctx: Optional[TraceContext]) -> Iterator[None]:
+    """Expose ``ctx`` to :meth:`TraceRecorder.new_request` calls made on
+    this thread (``None`` is a no-op scope). The transports parse the
+    inbound ``traceparent``, open this scope around the predictor call,
+    and the engine/batcher timelines created inside it join the
+    caller's trace — deadline-scope-style thread-local plumbing, so no
+    predictor wrapper has to thread a context kwarg through."""
+    prev = getattr(_trace_tls, "ctx", None)
+    _trace_tls.ctx = ctx
+    try:
+        yield
+    finally:
+        _trace_tls.ctx = prev
+
+
+def current_trace_context() -> Optional[TraceContext]:
+    """The innermost :func:`trace_scope` context on this thread."""
+    return getattr(_trace_tls, "ctx", None)
+
+
+# --------------------------------------------------------------------- #
 # trace spans
 # --------------------------------------------------------------------- #
 
@@ -520,18 +639,35 @@ class TraceRecorder:
     :meth:`span` context manager. ``finish_request`` moves the request
     to a bounded completed ring (newest ``max_requests`` kept).
 
+    Distributed context: every request timeline carries a W3C trace id,
+    a root span id, and (when created inside a :func:`trace_scope`, or
+    with an explicit ``trace_ctx``) a parent span id linking it to the
+    caller's span — so the exported spans form a connected tree across
+    services. Each recorded span gets its own span id, parented to the
+    request's root span. A request whose span cap was hit is marked
+    ``truncated`` in its meta and counted in
+    ``unionml_trace_spans_dropped_total``, so a postmortem reader knows
+    the trace is partial rather than silently short.
+
     Exports:
 
     - :meth:`export_chrome` — Chrome trace-event JSON (``ph: "X"``
       complete events, µs timestamps), loads in Perfetto and
       ``chrome://tracing``; one virtual thread row per request.
-    - :meth:`export_jsonl` — one JSON object per span per line, for
-      log shippers.
+    - :meth:`export_jsonl` — one JSON object per span per line
+      (including the trace/span/parent ids), for log shippers.
+    - listeners (:meth:`add_listener`) see each finished request once —
+      the push seam the OTLP exporter
+      (:mod:`unionml_tpu.exporters`) subscribes to.
     """
 
     MAX_SPANS_PER_REQUEST = 4096
 
-    def __init__(self, max_requests: int = 1024):
+    def __init__(
+        self,
+        max_requests: int = 1024,
+        registry: Optional["MetricsRegistry"] = None,
+    ):
         self.max_requests = max_requests
         self._lock = threading.Lock()
         self._live: Dict[str, List[dict]] = {}
@@ -539,14 +675,84 @@ class TraceRecorder:
         self._done: List[Tuple[str, dict, List[dict]]] = []
         self._tids: Dict[str, int] = {}
         self._next_tid = itertools.count(1)
+        # resolved lazily: the process-global recorder is constructed
+        # alongside the process-global registry at module init
+        self._registry = registry
+        self._m_dropped: Optional[Counter] = None
+        self._listeners: List[Callable[[str, dict, List[dict]], None]] = []
 
-    def new_request(self, kind: str = "request", **meta: Any) -> str:
+    def add_listener(
+        self, fn: Callable[[str, dict, List[dict]], None]
+    ) -> None:
+        """Subscribe ``fn(rid, meta, spans)`` to every finished request
+        (called outside the recorder lock, exceptions swallowed) — the
+        push-export seam."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def remove_listener(
+        self, fn: Callable[[str, dict, List[dict]], None]
+    ) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    def _count_dropped(self, n: int = 1) -> None:
+        if self._m_dropped is None:
+            reg = self._registry if self._registry is not None else get_registry()
+            self._m_dropped = reg.counter(
+                "unionml_trace_spans_dropped_total",
+                "Spans dropped past MAX_SPANS_PER_REQUEST; the affected "
+                "request's meta carries truncated=true.",
+            )
+        self._m_dropped.inc(n)
+
+    def new_request(
+        self,
+        kind: str = "request",
+        trace_ctx: Optional[TraceContext] = None,
+        **meta: Any,
+    ) -> str:
+        """Open a request timeline. ``trace_ctx`` (explicit, or the
+        ambient :func:`trace_scope` one on this thread) is the PARENT
+        context: the timeline joins its trace and its root span parents
+        to ``trace_ctx.span_id``; with neither, a fresh root trace is
+        minted."""
         rid = new_request_id()
+        parent = trace_ctx if trace_ctx is not None else current_trace_context()
         with self._lock:
             self._live[rid] = []
-            self._meta[rid] = {"kind": kind, **meta}
+            self._meta[rid] = {
+                "kind": kind,
+                "trace_id": parent.trace_id if parent else new_trace_id(),
+                "span_id": new_span_id(),
+                "parent_span_id": parent.span_id if parent else None,
+                # the caller's sampling decision rides along so the
+                # response echo carries it back (-00 stays -00)
+                "sampled": parent.sampled if parent else True,
+                "start_s": time.perf_counter(),
+                **meta,
+            }
             self._tids[rid] = next(self._next_tid)
         return rid
+
+    def trace_context(self, rid: str) -> Optional[TraceContext]:
+        """The (trace id, root span id) position of ``rid`` — what a
+        child scope or a response ``traceparent`` echo should carry.
+        ``None`` for unknown rids."""
+        with self._lock:
+            meta = self._meta.get(rid)
+            if meta is None:
+                for done_rid, done_meta, _ in reversed(self._done):
+                    if done_rid == rid:
+                        meta = done_meta
+                        break
+            if meta is None or "trace_id" not in meta:
+                return None
+            return TraceContext(
+                meta["trace_id"], meta["span_id"],
+                sampled=meta.get("sampled", True),
+            )
 
     def record_span(
         self,
@@ -558,19 +764,31 @@ class TraceRecorder:
     ) -> None:
         """Attach one completed span (``time.perf_counter()`` seconds).
         Unknown/finished rids are ignored — a late harvest for an
-        already-exported request must not KeyError the engine."""
+        already-exported request must not KeyError the engine. A live
+        request past the span cap drops the span, counts it, and flags
+        the request ``truncated``."""
         span = {
             "name": name,
             "start_s": float(start_s),
             "end_s": float(end_s),
+            "span_id": new_span_id(),
         }
         if args:
             span["args"] = args
         with self._lock:
             spans = self._live.get(rid)
-            if spans is None or len(spans) >= self.MAX_SPANS_PER_REQUEST:
+            if spans is None:
                 return
-            spans.append(span)
+            if len(spans) >= self.MAX_SPANS_PER_REQUEST:
+                meta = self._meta.get(rid)
+                if meta is not None:
+                    meta["truncated"] = True
+                dropped = True
+            else:
+                spans.append(span)
+                dropped = False
+        if dropped:
+            self._count_dropped()
 
     def span(self, rid: str, name: str, **args: Any):
         """Context manager measuring one span around its body."""
@@ -582,12 +800,19 @@ class TraceRecorder:
             meta = self._meta.pop(rid, {"kind": "request"})
             if spans is None:
                 return
+            meta.setdefault("end_s", time.perf_counter())
             self._done.append((rid, meta, spans))
             if len(self._done) > self.max_requests:
                 dropped = self._done[: -self.max_requests]
                 del self._done[: -self.max_requests]
                 for old_rid, _, _ in dropped:
                     self._tids.pop(old_rid, None)
+            listeners = list(self._listeners)
+        for fn in listeners:  # outside the lock: listeners may be slow
+            try:
+                fn(rid, meta, list(spans))
+            except Exception:
+                pass  # an exporter bug must never fail the request path
 
     def _all_requests(self) -> List[Tuple[str, dict, List[dict]]]:
         with self._lock:
@@ -632,7 +857,16 @@ class TraceRecorder:
 
     def export_jsonl(self) -> str:
         """One span per line: ``{"request_id", "name", "start_ms",
-        "duration_ms", ...}`` (monotonic-clock ms)."""
+        "duration_ms", "trace_id", "span_id", "parent_span_id", ...}``
+        (monotonic-clock ms). The W3C ids let a log pipeline join these
+        lines with upstream services' spans: a request's lines share
+        ``parent_span_id`` — its root span id, whose own parent (the
+        upstream caller's span, when one was propagated) rides along as
+        ``request_parent_span_id`` — so the chain
+        upstream → request root → span is reconstructible from the
+        lines alone. (The root span itself has no line; its timing is
+        the min/max of its children, exactly how the OTLP exporter
+        synthesizes it.)"""
         lines = []
         for rid, meta, spans in self._all_requests():
             for span in spans:
@@ -645,6 +879,16 @@ class TraceRecorder:
                         (span["end_s"] - span["start_s"]) * 1e3, 3
                     ),
                 }
+                if "trace_id" in meta:
+                    record["trace_id"] = meta["trace_id"]
+                    record["span_id"] = span.get("span_id")
+                    record["parent_span_id"] = meta["span_id"]
+                    if meta.get("parent_span_id"):
+                        record["request_parent_span_id"] = (
+                            meta["parent_span_id"]
+                        )
+                if meta.get("truncated"):
+                    record["truncated"] = True
                 record.update(span.get("args", {}))
                 lines.append(json.dumps(record))
         return "\n".join(lines) + "\n" if lines else ""
