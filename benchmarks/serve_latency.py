@@ -25,6 +25,11 @@ Usage::
     #   (against the in-process collector stub) on vs off — token
     #   parity asserted, per-request p50/p99 overhead delta reported
     #   (docs/observability.md "Distributed tracing & SLOs")
+    UNIONML_TPU_BENCH_PRESET=serve_paged python benchmarks/serve_latency.py
+    # ^ paged KV attention: contiguous vs block-paged device cache at a
+    #   FIXED HBM byte budget under a long-tail prompt mix — effective
+    #   max batch ratio (target >= 1.5x), decode tokens/s at equal
+    #   batch, token parity asserted (docs/performance.md)
 """
 
 from __future__ import annotations
@@ -794,6 +799,192 @@ def tracing_leg() -> None:
     }))
 
 
+def paged_leg() -> None:
+    """Block-paged device KV at a fixed HBM byte budget
+    (``UNIONML_TPU_BENCH_PRESET=serve_paged``).
+
+    The workload paging exists for: a LONG-TAIL prompt mix (75% short
+    prompts at 1/8 of the bucket, 25% at the full bucket) where the
+    contiguous engine reserves every slot's worst case — bucket +
+    max_new + pipeline spare — and the byte budget therefore caps the
+    slot count. The paged engine spends the SAME budget on a global
+    block pool; short prompts charge only their own blocks, so more
+    sequences fit.
+
+    Phase 1 — **effective batch at fixed budget**: the budget is what a
+    ``contig_slots``-slot contiguous engine costs; both engines serve
+    the same saturating stream while a sampler records peak concurrent
+    residents. Acceptance: paged peak >= 1.5x contiguous peak, tokens
+    bit-identical (the reference paged kernel).
+
+    Phase 2 — **decode tokens/s at equal batch**: both engines at the
+    SAME slot count, decode throughput recorded (paged must not
+    regress when the layout is the only change); PR 4's per-program
+    MFU/HBM gauges attribute where the time goes.
+    """
+    import threading
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu import telemetry
+    from unionml_tpu.models import Llama, LlamaConfig
+    from unionml_tpu.serving.engine import DecodeEngine
+
+    backend = jax.default_backend()
+    if backend == "cpu":
+        cfg = serving_config("tiny")
+        # the parity assert is defined on the REFERENCE paged kernel
+        # (bit-identical to the contiguous path by construction); the
+        # Pallas kernel matches only up to float reduction order, so a
+        # near-tie argmax could flip a greedy token and fail the bench
+        # spuriously on TPU. Kernel speed is measured by the paged leg
+        # of benchmarks/attn_kernels.py instead.
+        module = Llama(
+            LlamaConfig(**{**cfg.__dict__, "paged_impl": "reference"})
+        )
+        tokens0 = jnp.zeros((1, 8), jnp.int32)
+        params = jax.jit(module.init)(jax.random.PRNGKey(0), tokens0)["params"]
+        # new_tokens long enough that residents ACCUMULATE (the peak
+        # must be memory-limited, not admission-rate-limited, for the
+        # effective-batch comparison to measure the layout)
+        n_req, new_tokens, bucket, chunk_steps = 24, 32, 64, 4
+        blk, contig_slots, paged_slots = 16, 2, 8
+    else:
+        cfg = serving_config("serve_1p5b")
+        qcfg = LlamaConfig(**{
+            **cfg.__dict__, "quantized": True, "paged_impl": "reference",
+        })
+        module = Llama(qcfg)
+        params = random_quantized_params(module)
+        n_req, new_tokens, bucket, chunk_steps = 128, 32, 512, 8
+        blk, contig_slots, paged_slots = 16, 4, 16
+    rng = np.random.default_rng(0)
+    prompts = []
+    for i in range(n_req):
+        # the long-tail mix: 75% short (bucket/8), 25% full-bucket
+        n = bucket // 8 if i % 4 < 3 else bucket - 1
+        prompts.append(rng.integers(1, cfg.vocab_size, n).tolist())
+
+    def engine_for(paged: bool, slots: int, budget=None):
+        kw = dict(
+            slots=slots, max_new_tokens=new_tokens,
+            prompt_buckets=(bucket,), chunk_steps=chunk_steps,
+            registry=telemetry.MetricsRegistry(),
+        )
+        if paged:
+            kw.update(paged=True, kv_block_size=blk)
+            if budget is not None:
+                kw.update(kv_pool_bytes=budget)
+        return DecodeEngine(module, **kw)
+
+    def run_stream(engine):
+        """Serve the whole stream; sample peak concurrent residents."""
+        peak = [0]
+        stop = threading.Event()
+
+        def sampler():
+            while not stop.is_set():
+                peak[0] = max(peak[0], int(engine._m_slots_busy.value))
+                time.sleep(0.001)
+
+        t = threading.Thread(target=sampler, daemon=True)
+        engine.warmup(params)
+        engine.reset_stats()
+        t.start()
+        t0 = time.perf_counter()
+        outs = engine.generate(params, prompts)
+        wall_s = time.perf_counter() - t0
+        stop.set()
+        t.join(timeout=5)
+        stats = engine.stats()
+        decode_tokens = sum(len(o) for o in outs)
+        return {
+            "outs": outs,
+            "peak_batch": peak[0],
+            "wall_s": wall_s,
+            "tokens_per_s": decode_tokens / wall_s,
+            "decode": stats.get("programs", {}).get("engine.decode", {}),
+            "kv_pool": stats.get("kv_pool"),
+        }
+
+    # ---- phase 1: effective batch at a FIXED byte budget ----
+    contig = engine_for(False, contig_slots)
+    try:
+        row_bytes = contig._kv_block_nbytes(1)
+        budget = contig_slots * contig.cache_len * row_bytes
+        r_contig = run_stream(contig)
+    finally:
+        contig.close()
+    paged = engine_for(True, paged_slots, budget=budget)
+    try:
+        pool_blocks = paged.kv_pool.capacity
+        r_paged = run_stream(paged)
+    finally:
+        paged.close()
+    assert r_paged["outs"] == r_contig["outs"], (
+        "paged KV changed produced tokens — parity violation"
+    )
+    assert r_paged["kv_pool"]["blocks_in_use"] == 0, (
+        f"leaked pool blocks: {r_paged['kv_pool']}"
+    )
+    ratio = r_paged["peak_batch"] / max(1, r_contig["peak_batch"])
+    for name, r in (("contiguous", r_contig), ("paged", r_paged)):
+        print(json.dumps({
+            "metric": "serve_paged_effective_batch",
+            "layout": name,
+            "budget_bytes": budget,
+            "requests": n_req,
+            "bucket": bucket,
+            "new_tokens": new_tokens,
+            "value": r["peak_batch"],
+            "wall_s": round(r["wall_s"], 2),
+            "decode_tokens_per_s": round(r["tokens_per_s"], 1),
+            "decode_mfu": r["decode"].get("mfu"),
+            "decode_hbm_utilization": r["decode"].get("hbm_utilization"),
+            "unit": "concurrent residents",
+        }))
+    print(json.dumps({
+        "metric": "serve_paged_summary",
+        "effective_batch_ratio": round(ratio, 2),
+        "block_size": blk,
+        "pool_blocks": pool_blocks,
+        "budget_bytes": budget,
+        "tokens_identical": True,
+        "pool_alloc_failures": r_paged["kv_pool"]["alloc_failures"],
+        "unit": "x",
+    }))
+    assert ratio >= 1.5, (
+        f"paged effective batch {r_paged['peak_batch']} < 1.5x contiguous "
+        f"{r_contig['peak_batch']} at the same byte budget"
+    )
+
+    # ---- phase 2: decode tokens/s at EQUAL batch (layout-only delta) --
+    equal = {}
+    for is_paged in (False, True):
+        e = engine_for(is_paged, contig_slots)
+        try:
+            equal[is_paged] = run_stream(e)
+        finally:
+            e.close()
+    assert equal[True]["outs"] == equal[False]["outs"]
+    for name, r in (("contiguous", equal[False]), ("paged", equal[True])):
+        print(json.dumps({
+            "metric": "serve_paged_equal_batch_tokens_per_s",
+            "layout": name,
+            "slots": contig_slots,
+            "value": round(r["tokens_per_s"], 1),
+            "wall_s": round(r["wall_s"], 2),
+            "decode_mfu": r["decode"].get("mfu"),
+            "decode_hbm_utilization": r["decode"].get("hbm_utilization"),
+            "unit": "tokens/s",
+        }))
+
+
 def overload_leg() -> None:
     """Admission control + supervised recovery under saturation
     (``UNIONML_TPU_BENCH_PRESET=serve_overload``).
@@ -971,6 +1162,17 @@ if __name__ == "__main__":
                 "workload is hardcoded in introspection_leg"
             )
         introspection_leg()
+    elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_paged":
+        if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
+            os.environ.get("UNIONML_TPU_BENCH_PREFIX")
+        ):
+            # hardcoded workload, same rule as the other engine legs
+            raise SystemExit(
+                "UNIONML_TPU_BENCH_PRESET=serve_paged takes no CLI "
+                f"flags or KV/PREFIX env legs (got {sys.argv[1:]}); its "
+                "workload is hardcoded in paged_leg"
+            )
+        paged_leg()
     elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_overload":
         if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
             os.environ.get("UNIONML_TPU_BENCH_PREFIX")

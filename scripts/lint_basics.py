@@ -19,6 +19,14 @@ richer gate where installed):
   ``time.monotonic()``/``time.perf_counter()`` — wall clock steps under
   NTP slew and breaks deadline/latency accounting. (``time.time()`` is
   fine elsewhere, e.g. epoch timestamps in logs.)
+- direct ``cache[...]`` subscripts in ``unionml_tpu/serving/`` outside
+  the block allocator module (:data:`CACHE_INDEX_BANNED` /
+  :data:`CACHE_INDEX_EXEMPT`): since the paged-KV refactor
+  (docs/performance.md), device KV rows are addressed through block
+  tables — contiguous-row indexing of a cache object in serving code
+  bypasses the allocator and silently breaks the paged layout. Route
+  through the block-table API (``kv_pool.py`` + the engine's
+  scatter/extract programs) instead.
 - metrics-doc drift (repo-wide, when the default paths are linted):
   every ``unionml_*`` metric registered under ``unionml_tpu/`` must be
   documented in ``docs/observability.md``, and every full metric name
@@ -45,12 +53,21 @@ MAX_LINE = 110
 # territory: queue deadlines, latency splits, drain timers)
 WALL_CLOCK_BANNED = ("unionml_tpu/serving/", "unionml_tpu/execution.py")
 
+# where direct `cache[...]` / `<expr>.cache[...]` subscripts are banned:
+# serving-layer device KV goes through the block-table API so the paged
+# and contiguous layouts cannot silently diverge. The allocator module
+# itself is the one legitimate home for raw block addressing.
+CACHE_INDEX_BANNED = ("unionml_tpu/serving/",)
+CACHE_INDEX_EXEMPT = ("unionml_tpu/serving/kv_pool.py",)
+
 
 class Checker(ast.NodeVisitor):
-    def __init__(self, path: Path, src: str, ban_wall_clock: bool = False):
+    def __init__(self, path: Path, src: str, ban_wall_clock: bool = False,
+                 ban_cache_index: bool = False):
         self.path = path
         self.src = src
         self.ban_wall_clock = ban_wall_clock
+        self.ban_cache_index = ban_cache_index
         self.problems: list = []
         self.imports: dict = {}       # name -> (lineno, spelled)
         self.used: set = set()
@@ -139,6 +156,24 @@ class Checker(ast.NodeVisitor):
             )
         self.generic_visit(node)
 
+    def visit_Subscript(self, node: ast.Subscript):
+        if self.ban_cache_index:
+            target = node.value
+            name = (
+                target.id if isinstance(target, ast.Name)
+                else target.attr if isinstance(target, ast.Attribute)
+                else None
+            )
+            if name == "cache":
+                self.problem(
+                    node.lineno,
+                    "direct cache[...] indexing in serving code — device "
+                    "KV rows are block-paged; go through the block-table "
+                    "API (serving/kv_pool.py + the engine's "
+                    "scatter/extract programs)",
+                )
+        self.generic_visit(node)
+
     def visit_JoinedStr(self, node: ast.JoinedStr):
         if not any(isinstance(v, ast.FormattedValue) for v in node.values):
             self.problem(node.lineno, "f-string without placeholders")
@@ -191,7 +226,13 @@ def check_file(path: Path) -> list:
     ban_wall_clock = any(
         rel == p or rel.startswith(p) for p in WALL_CLOCK_BANNED
     )
-    checker = Checker(path, src, ban_wall_clock=ban_wall_clock)
+    ban_cache_index = any(
+        rel == p or rel.startswith(p) for p in CACHE_INDEX_BANNED
+    ) and rel not in CACHE_INDEX_EXEMPT
+    checker = Checker(
+        path, src, ban_wall_clock=ban_wall_clock,
+        ban_cache_index=ban_cache_index,
+    )
     checker.visit(tree)
     checker.report_unused_imports(tree)
     for i, line in enumerate(src.splitlines(), 1):

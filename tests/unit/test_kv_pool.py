@@ -1,0 +1,137 @@
+"""KV block-pool allocator semantics (host-side, no jax).
+
+The reservation contract is what makes paged serving fail CLEANLY:
+admission reserves a request's worst case up front, so mid-decode table
+growth can never fail — exhaustion surfaces as :class:`PoolExhausted`
+at reservation time (the engine parks or sheds), never as a corrupted
+decode. Block 0 is the trash block and must never be allocated.
+"""
+
+import pytest
+
+from unionml_tpu import telemetry
+from unionml_tpu.serving.kv_pool import TRASH_BLOCK, KVBlockPool, PoolExhausted
+
+
+def make_pool(num_blocks=8, block_size=16, block_nbytes=1024):
+    return KVBlockPool(
+        num_blocks=num_blocks, block_size=block_size,
+        block_nbytes=block_nbytes, registry=telemetry.MetricsRegistry(),
+    )
+
+
+def test_capacity_excludes_trash_block():
+    pool = make_pool(num_blocks=8)
+    assert pool.capacity == 7
+    assert pool.available == 7
+    taken = []
+    pool.reserve(7)
+    for _ in range(7):
+        taken.append(pool.take())
+    assert TRASH_BLOCK not in taken
+    assert sorted(taken) == list(range(1, 8))
+
+
+def test_reserve_take_give_roundtrip():
+    pool = make_pool()
+    pool.reserve(3)
+    assert pool.reserved == 3
+    assert pool.available == pool.capacity - 3
+    a, b = pool.take(), pool.take()
+    assert pool.in_use == 2
+    assert pool.reserved == 1
+    pool.give([a, b], unreserve=1)
+    assert pool.in_use == 0
+    assert pool.reserved == 0
+    assert pool.available == pool.capacity
+    stats = pool.stats()
+    assert stats["allocated_blocks"] == 2
+    assert stats["freed_blocks"] == 2
+
+
+def test_exhaustion_raises_and_counts():
+    pool = make_pool(num_blocks=4)  # capacity 3
+    pool.reserve(2)
+    with pytest.raises(PoolExhausted) as exc:
+        pool.reserve(2)
+    assert exc.value.needed == 2
+    assert exc.value.available == 1
+    assert pool.stats()["alloc_failures"] == 1
+    # the failed reservation committed nothing
+    assert pool.reserved == 2
+    pool.reserve(1)  # the remaining block still reservable
+
+
+def test_take_without_reservation_refused():
+    pool = make_pool()
+    with pytest.raises(RuntimeError):
+        pool.take()
+
+
+def test_reservation_makes_growth_infallible():
+    """Once reserved, every take() succeeds even if another caller
+    drains the unreserved remainder first."""
+    pool = make_pool(num_blocks=6)  # capacity 5
+    pool.reserve(2)                 # request A
+    pool.reserve(3)                 # request B takes everything else
+    b_ids = [pool.take() for _ in range(3)]
+    a_ids = [pool.take() for _ in range(2)]
+    assert len(set(a_ids + b_ids)) == 5
+    with pytest.raises(PoolExhausted):
+        pool.reserve(1)
+
+
+def test_give_validates_ids_and_unreserve():
+    pool = make_pool(num_blocks=4)
+    pool.reserve(1)
+    bid = pool.take()
+    with pytest.raises(ValueError):
+        pool.give([0])          # trash block is not allocatable
+    with pytest.raises(ValueError):
+        pool.give([99])         # outside the pool
+    with pytest.raises(ValueError):
+        pool.give([], unreserve=1)  # nothing reserved anymore
+    pool.give([bid])
+
+
+def test_reset_returns_everything():
+    pool = make_pool(num_blocks=6)
+    pool.reserve(4)
+    ids = [pool.take() for _ in range(3)]
+    assert ids
+    pool.reset()
+    assert pool.in_use == 0
+    assert pool.reserved == 0
+    assert pool.available == pool.capacity
+
+
+def test_occupancy_and_fragmentation_gauges():
+    pool = make_pool(num_blocks=5, block_size=16)  # capacity 4
+    pool.reserve(3)
+    pool.take(), pool.take()
+    st = pool.stats()
+    # 2 in use + 1 reserved over capacity 4
+    assert st["occupancy"] == pytest.approx(0.75)
+    # 20 used rows over 2 blocks x 16 rows
+    pool.note_used_rows(20)
+    st = pool.stats()
+    assert st["fragmentation"] == pytest.approx(1 - 20 / 32, abs=1e-3)
+    assert st["bytes_in_use"] == 2 * 1024
+
+
+def test_blocks_for_rows():
+    pool = make_pool(block_size=16)
+    assert pool.blocks_for_rows(0) == 0
+    assert pool.blocks_for_rows(1) == 1
+    assert pool.blocks_for_rows(16) == 1
+    assert pool.blocks_for_rows(17) == 2
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        make_pool(num_blocks=1)  # only the trash block
+    with pytest.raises(ValueError):
+        KVBlockPool(
+            num_blocks=4, block_size=0,
+            registry=telemetry.MetricsRegistry(),
+        )

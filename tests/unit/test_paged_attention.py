@@ -1,0 +1,142 @@
+"""Paged-attention op tests: reference parity + Pallas kernel numerics.
+
+The reference path must be BIT-identical to the contiguous cached
+attention on the same rows (that is the engine's paged-vs-contiguous
+parity anchor); the Pallas kernel matches the reference within float
+reduction order (the flash-kernel numerics contract), in interpreter
+mode on CPU.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from unionml_tpu.ops.attention import cached_attention, quantized_cache_attention
+from unionml_tpu.ops.paged_attention import (
+    paged_attention,
+    paged_attention_reference,
+)
+
+B, H, KVH, D, BS, W, N = 3, 4, 2, 16, 8, 4, 12
+
+
+def _setup(dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), dtype)
+    k = jnp.asarray(rng.standard_normal((N, BS, KVH, D)), dtype)
+    v = jnp.asarray(rng.standard_normal((N, BS, KVH, D)), dtype)
+    table = jnp.asarray(rng.integers(1, N, (B, W)), jnp.int32)
+    lengths = jnp.asarray([1, 13, W * BS], jnp.int32)
+    return q, k, v, table, lengths
+
+
+def _contiguous(pool, table):
+    return jnp.take(pool, table.reshape(-1), axis=0).reshape(
+        (B, W * BS) + pool.shape[2:]
+    )
+
+
+def _bias(lengths):
+    kv_pos = jnp.arange(W * BS)[None, :]
+    visible = kv_pos[None] <= (lengths - 1)[:, None, None]
+    return jnp.where(visible, 0.0, -1e30)[:, None]
+
+
+def test_reference_bit_identical_to_contiguous():
+    q, k, v, table, lengths = _setup()
+    ref = paged_attention_reference(q, k, v, table, lengths)
+    contig = cached_attention(
+        q[:, None], _contiguous(k, table), _contiguous(v, table),
+        bias=_bias(lengths),
+    )[:, 0]
+    assert bool(jnp.all(ref == contig))
+
+
+def test_reference_bit_identical_int8():
+    rng = np.random.default_rng(1)
+    q, _, _, table, lengths = _setup(seed=1)
+    kq = jnp.asarray(rng.integers(-127, 128, (N, BS, KVH, D)), jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, (N, BS, KVH, D)), jnp.int8)
+    ks = jnp.asarray(rng.random((N, BS, KVH)) * 0.02 + 1e-3, jnp.float32)
+    vs = jnp.asarray(rng.random((N, BS, KVH)) * 0.02 + 1e-3, jnp.float32)
+    ref = paged_attention_reference(
+        q, kq, vq, table, lengths, k_scale=ks, v_scale=vs
+    )
+    contig = quantized_cache_attention(
+        q[:, None], _contiguous(kq, table), _contiguous(vq, table),
+        _contiguous(ks, table), _contiguous(vs, table), bias=_bias(lengths),
+    )[:, 0]
+    assert bool(jnp.all(ref == contig))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_pallas_matches_reference(dtype):
+    q, k, v, table, lengths = _setup(dtype=dtype)
+    ref = paged_attention(q, k, v, table, lengths, impl="reference")
+    pal = paged_attention(q, k, v, table, lengths, impl="pallas")
+    tol = 1e-6 if dtype == jnp.float32 else 2e-2
+    assert float(
+        jnp.max(jnp.abs(pal.astype(jnp.float32) - ref.astype(jnp.float32)))
+    ) < tol
+
+
+def test_pallas_matches_reference_int8():
+    rng = np.random.default_rng(2)
+    q, _, _, table, lengths = _setup(seed=2)
+    kq = jnp.asarray(rng.integers(-127, 128, (N, BS, KVH, D)), jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, (N, BS, KVH, D)), jnp.int8)
+    ks = jnp.asarray(rng.random((N, BS, KVH)) * 0.02 + 1e-3, jnp.float32)
+    vs = jnp.asarray(rng.random((N, BS, KVH)) * 0.02 + 1e-3, jnp.float32)
+    ref = paged_attention(
+        q, kq, vq, table, lengths, k_scale=ks, v_scale=vs, impl="reference"
+    )
+    pal = paged_attention(
+        q, kq, vq, table, lengths, k_scale=ks, v_scale=vs, impl="pallas"
+    )
+    assert float(jnp.max(jnp.abs(pal - ref))) < 1e-5
+
+
+def test_zero_length_rows_are_finite():
+    """Dead slots decode with length 0 (everything masked): the output
+    is garbage by contract but must be FINITE — NaN would poison the
+    residual stream of live slots through layer norms."""
+    q, k, v, table, _ = _setup()
+    lengths = jnp.zeros((B,), jnp.int32)
+    for impl in ("reference", "pallas"):
+        out = paged_attention(q, k, v, table, lengths, impl=impl)
+        assert bool(jnp.all(jnp.isfinite(out.astype(jnp.float32))))
+
+
+def test_gqa_groups_share_kv_head():
+    """A pool whose two kv heads hold identical rows must produce
+    identical outputs across the full q-head width (group mapping)."""
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(
+        np.tile(rng.standard_normal((B, 1, D)), (1, H, 1)), jnp.float32
+    )
+    one = rng.standard_normal((N, BS, 1, D))
+    k = jnp.asarray(np.tile(one, (1, 1, KVH, 1)), jnp.float32)
+    v = jnp.asarray(np.tile(one, (1, 1, KVH, 1)), jnp.float32)
+    table = jnp.asarray(rng.integers(1, N, (B, W)), jnp.int32)
+    lengths = jnp.asarray([5, 17, 30], jnp.int32)
+    for impl in ("reference", "pallas"):
+        out = paged_attention(q, k, v, table, lengths, impl=impl)
+        spread = jnp.max(jnp.abs(out - out[:, :1]))
+        assert float(spread) < 1e-5
+
+
+def test_shape_validation():
+    q, k, v, table, lengths = _setup()
+    with pytest.raises(ValueError):
+        paged_attention(q[0], k, v, table, lengths)  # q rank
+    with pytest.raises(ValueError):
+        paged_attention(q, k, v, table[:1], lengths)  # batch mismatch
+    with pytest.raises(ValueError):
+        paged_attention(q, k, v, table, lengths[:1])  # lengths shape
+    with pytest.raises(ValueError):
+        paged_attention(
+            q, k, v, table, lengths, k_scale=jnp.ones((N, BS, KVH))
+        )  # k_scale without v_scale
+    with pytest.raises(ValueError):
+        paged_attention(q, k, v, table, lengths, impl="nope")

@@ -286,6 +286,12 @@ class Attention(nn.Module):
     # left-pad mask (no score buffer, the long-prefill memory/speed
     # lever). Only consulted when the caller passes full_prefill=True.
     prefill_impl: str = "cached"
+    # decode attention impl for block-paged KV pools (the engine's
+    # paged mode; only consulted when the caller passes block_table=):
+    # "reference" = jnp.take gather, bit-identical to the contiguous
+    # cache path; "pallas" = the scalar-prefetch gather kernel; "auto"
+    # = pallas on TPU, reference elsewhere (ops/paged_attention.py).
+    paged_impl: str = "auto"
     sequence_axis: Optional[str] = None
     quantized: bool = False  # weight-only quantized projections (serving)
     weight_bits: int = 8     # 8 = int8; 4 = packed-int4 (decode bandwidth)
@@ -309,9 +315,23 @@ class Attention(nn.Module):
         cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
         cache_index: Optional[jnp.ndarray] = None,
         kv_mask: Optional[jnp.ndarray] = None,
+        block_table: Optional[jnp.ndarray] = None,
         full_prefill: bool = False,
     ):
         """Returns ``out`` or ``(out, new_cache)`` when a cache is given.
+
+        ``block_table``: int32 [batch, table_width] — marks ``cache`` as
+        a BLOCK-PAGED pool (per buffer [num_blocks, block, kv_heads,
+        head_dim]; int8 pools carry [num_blocks, block, kv_heads] scale
+        planes) addressed through the table (entries past a row's
+        coverage point at the trash block). Decode-step only: requires
+        ``seq == 1`` and a vector ``cache_index`` (per-row fills); the
+        step's k/v row scatters into pool block ``table[b, fill //
+        block]`` at offset ``fill % block``, and attention reads
+        through :func:`~unionml_tpu.ops.paged_attention.paged_attention`
+        (``paged_impl`` picks the kernel) with ``lengths = fill + 1``
+        (the just-written row sees itself). ``kv_mask`` must be None —
+        visibility is derived from the fills.
 
         ``full_prefill``: STATIC caller promise that this multi-token
         cached call covers the entire visible history — the cache is
@@ -394,13 +414,37 @@ class Attention(nn.Module):
         new_cache = None
         if cache is not None:
             index = jnp.asarray(cache_index)
+            if block_table is not None:
+                # block-paged pool: decode-step writes scatter into the
+                # table-addressed block row. The engine masks retired
+                # slots' table rows to the trash block per step, so a
+                # dead slot's write can never corrupt a recycled block.
+                if seq != 1 or index.ndim != 1:
+                    raise ValueError(
+                        "block-paged caches support vector-index decode "
+                        f"steps only (seq == 1), got seq={seq}, "
+                        f"cache_index ndim {index.ndim}"
+                    )
+                if kv_mask is not None:
+                    raise ValueError(
+                        "kv_mask is incompatible with block_table — "
+                        "paged visibility derives from the fills"
+                    )
+                blk = cache[0].shape[1]
+                pid = jnp.take_along_axis(
+                    block_table, (index // blk)[:, None], axis=1
+                )[:, 0]
+                off = index % blk
 
             def upd(buf, new, idx=index):
+                # paged: one advanced-index scatter at (block, offset);
                 # scalar index: one dynamic_update_slice at [_, idx, ...];
                 # vector [batch] index: a vmapped slice-update (one scatter)
                 # — the continuous-batching decode step where each slot
                 # writes at its own depth
                 new = new.astype(buf.dtype)
+                if block_table is not None:
+                    return buf.at[pid, off].set(new[:, 0])
                 if idx.ndim == 1:
                     one = lambda c, n, i: jax.lax.dynamic_update_slice(  # noqa: E731
                         c, n, (i,) + (0,) * (c.ndim - 1)
@@ -437,6 +481,24 @@ class Attention(nn.Module):
                 ck, cv = upd(ck, k), upd(cv, v)
                 new_cache = (ck, cv)
             out = None
+            if block_table is not None:
+                # paged decode read: gather-attend through the block
+                # table (no contiguous cache view is ever materialized
+                # on the kernel path); lengths = fill + 1 exposes the
+                # row this step just wrote, matching the contiguous
+                # path's self-visible kv_mask row
+                from unionml_tpu.ops.paged_attention import paged_attention
+
+                if len(cache) == 4:
+                    out = paged_attention(
+                        q[:, 0], ck, cv, block_table, index + 1,
+                        k_scale=ks, v_scale=vs, impl=self.paged_impl,
+                    )[:, None]
+                else:
+                    out = paged_attention(
+                        q[:, 0], ck, cv, block_table, index + 1,
+                        impl=self.paged_impl,
+                    )[:, None]
             if full_prefill and seq > 1 and self.prefill_impl == "flash":
                 # full-history prefill: attention over the FRESH post-RoPE
                 # k/v through the Pallas flash kernel — no [B,H,S,max_len]

@@ -54,6 +54,12 @@ class LlamaConfig:
     # prefill memory/speed lever (see Attention.prefill_impl). "cached"
     # keeps the masked cached-attention path everywhere.
     prefill_impl: str = "cached"
+    # decode attention over a BLOCK-PAGED KV pool (the engine's paged
+    # mode; consulted only when block_table= is passed): "reference" =
+    # jnp.take gather (bit-identical to the contiguous path — the
+    # CPU/parity anchor), "pallas" = the scalar-prefetch gather kernel,
+    # "auto" = pallas on TPU / reference elsewhere.
+    paged_impl: str = "auto"
     # "fused" = Pallas RMSNorm kernel pair (ops/fused_norm.py)
     norm_impl: str = "xla"
     sequence_axis: Optional[str] = None
@@ -130,7 +136,7 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, positions=None, cache=None, cache_index=None,
-                 kv_mask=None, full_prefill=False):
+                 kv_mask=None, block_table=None, full_prefill=False):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         attn = Attention(
@@ -143,6 +149,7 @@ class LlamaBlock(nn.Module):
             causal=True,
             attn_impl=cfg.attn_impl,
             prefill_impl=cfg.prefill_impl,
+            paged_impl=cfg.paged_impl,
             sequence_axis=cfg.sequence_axis,
             quantized=cfg.quantized,
             weight_bits=cfg.weight_bits,
@@ -157,7 +164,8 @@ class LlamaBlock(nn.Module):
         if cache is not None:
             a, new_cache = attn(
                 h, positions=positions, cache=cache, cache_index=cache_index,
-                kv_mask=kv_mask, full_prefill=full_prefill,
+                kv_mask=kv_mask, block_table=block_table,
+                full_prefill=full_prefill,
             )
         else:
             if kv_mask is not None:
@@ -204,10 +212,17 @@ class Llama(nn.Module):
         cache: Optional[Cache] = None,
         cache_index: Optional[jnp.ndarray] = None,
         kv_mask: Optional[jnp.ndarray] = None,
+        block_table: Optional[jnp.ndarray] = None,
         logit_index: Optional[jnp.ndarray] = None,
         full_prefill: bool = False,
     ):
         """logits [B,S,V]; with ``cache`` returns (logits, new_cache).
+
+        ``block_table``: int32 [B, table_width] — marks ``cache`` as a
+        block-paged pool (per layer [num_blocks, block, kv_heads,
+        head_dim]) addressed through the table; decode steps only
+        (``seq == 1``, vector ``cache_index``). See
+        :class:`~unionml_tpu.models.layers.Attention`.
 
         ``kv_mask``: bool (batch, max_len) — False cache slots are never
         attended to (left-padded prompts in generation).
@@ -243,7 +258,8 @@ class Llama(nn.Module):
             layer_cache = cache[i] if cache is not None else None
             x, c = block_cls(cfg, name=f"block_{i}")(
                 x, positions=positions, cache=layer_cache, cache_index=cache_index,
-                kv_mask=kv_mask, full_prefill=full_prefill,
+                kv_mask=kv_mask, block_table=block_table,
+                full_prefill=full_prefill,
             )
             new_cache.append(c)
         if logit_index is not None:

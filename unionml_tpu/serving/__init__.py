@@ -59,10 +59,12 @@ from unionml_tpu.serving.faults import (
     deadline_scope,
 )
 from unionml_tpu.serving.http import ServingApp, create_app
+from unionml_tpu.serving.kv_pool import KVBlockPool, PoolExhausted
 from unionml_tpu.serving.prefix_cache import RadixPrefixCache
 
 __all__ = [
     "DeadlineExceeded", "DecodeEngine", "EngineUnavailable",
-    "FaultInjector", "MicroBatcher", "Overloaded", "RadixPrefixCache",
-    "ServingApp", "create_app", "deadline_scope",
+    "FaultInjector", "KVBlockPool", "MicroBatcher", "Overloaded",
+    "PoolExhausted", "RadixPrefixCache", "ServingApp", "create_app",
+    "deadline_scope",
 ]

@@ -107,6 +107,7 @@ from unionml_tpu.serving.faults import (
     Overloaded,
     current_deadline_ms,
 )
+from unionml_tpu.serving.kv_pool import KVBlockPool, PoolExhausted
 
 __all__ = ["DecodeEngine"]
 
@@ -169,6 +170,9 @@ class _Admission:
     n_chunks: int                   # total programs incl. the final
     padded: np.ndarray              # [bucket] right-padded prompt
     fresh: Any                      # [1, bucket] cache being filled
+    # paged mode: the slot's pool block ids for the final scatter
+    # ([bucket/block] int32; uncovered tail entries = trash block)
+    pool_ids: Optional[np.ndarray] = None
     next_chunk: int = 0
     # prefix-cache hit: one entry per chunk-sized splice unit (a tuple
     # of cached host block trees covering rows [i*chunk, (i+1)*chunk)),
@@ -212,6 +216,12 @@ class _Request:
     _matched_blocks: int = 0            # radix-tree blocks found at admission
     _prefilled_tokens: int = 0          # prompt tokens actually prefilled
     _saved_tokens: int = 0              # prompt tokens spliced from cache
+    # paged mode: device pool bookkeeping (engine lock guards all three)
+    _block_ids: List[int] = field(default_factory=list)  # taken pool blocks
+    _resv_blocks: int = 0               # reserved, not yet taken
+    _rows_cap: int = 0                  # prompt + max_new (block budget)
+    _park_logged: bool = False          # one pool_pressure event per park
+    _pool_gen: int = 0                  # pool generation at reservation
 
     def emit(self, chunk: List[int]) -> None:
         if self.stream is not None and chunk:
@@ -343,6 +353,35 @@ class DecodeEngine:
         flight: explicit :class:`~unionml_tpu.telemetry.FlightRecorder`
             for lifecycle events; defaults to the process-global one
             (``GET /debug/flight``). Ignored when ``introspect=False``.
+        paged/kv_pool_bytes/kv_pool_blocks/kv_block_size: BLOCK-PAGED
+            device KV (docs/performance.md "Paged KV attention";
+            PagedAttention lineage). Instead of ``slots`` contiguous
+            ``cache_len``-row caches, device KV lives in one global
+            pool of ``kv_block_size``-token blocks sized by an HBM
+            byte budget (``kv_pool_bytes``) or a block count
+            (``kv_pool_blocks``; default: the contiguous equivalent,
+            a pure layout change), with a per-slot int32 block table
+            grown one block at a time as decode proceeds — a short
+            prompt in a long bucket charges HBM for its own tokens,
+            not the bucket's, so the effective batch at a fixed byte
+            budget rises with the traffic's long-tail (the
+            ``serve_paged`` bench preset measures it). Admission
+            RESERVES a request's worst-case blocks up front (prompt +
+            ``max_new_tokens``), so growth can never fail mid-decode:
+            a transiently full pool parks the admission until blocks
+            free (queued behind it, admission control sheds the
+            overflow), and a request that can NEVER fit is rejected
+            ``Overloaded`` at submit. Decode attention runs through
+            :mod:`~unionml_tpu.ops.paged_attention` (the module
+            config's ``paged_impl`` picks kernel vs reference; the
+            reference path is bit-identical to the contiguous
+            layout). Block size defaults to the prefix cache's (the
+            two MUST share one block unit — mismatches raise), else
+            16; buckets round to ``lcm(block, prefill_chunk)`` via
+            the same ``_block_geometry()`` the prefix cache uses.
+            Pool telemetry: ``unionml_kv_pool_*``. Not composable
+            with ``draft_module`` (the draft would need its own
+            pool).
     """
 
     def __init__(
@@ -375,6 +414,10 @@ class DecodeEngine:
         fault_injector=None,
         introspect: bool = True,
         flight=None,
+        paged: bool = False,
+        kv_pool_bytes: Optional[int] = None,
+        kv_pool_blocks: Optional[int] = None,
+        kv_block_size: Optional[int] = None,
     ):
         import jax
 
@@ -500,6 +543,23 @@ class DecodeEngine:
         self.prefix_cache = prefix_cache or None
         if self._prefix_tokens is not None and self.prefix_cache is not None:
             self.prefix_cache.pin(self._prefix_tokens)
+        # block-paged device KV: pool geometry resolves through
+        # _block_geometry() so the device pool and the prefix cache's
+        # host store can never disagree on the block unit
+        self.paged = bool(
+            paged or kv_pool_bytes is not None or kv_pool_blocks is not None
+        )
+        if self.paged and self.draft is not None:
+            raise ValueError(
+                "the speculative engine does not compose with the paged "
+                "KV pool yet — the draft model would need a mirrored "
+                "pool; drop paged/kv_pool_* or draft_module"
+            )
+        self._kv_block_size_arg = (
+            None if kv_block_size is None else int(kv_block_size)
+        )
+        if self._kv_block_size_arg is not None and self._kv_block_size_arg < 1:
+            raise ValueError("kv_block_size must be >= 1")
         # device-resident LRU of recently-spliced units (dispatcher
         # thread only): a hot prefix — the pinned system_prefix above
         # all — uploads host→device ONCE, not per admission. Entries
@@ -509,19 +569,15 @@ class DecodeEngine:
         self._dev_splice: "OrderedDict" = OrderedDict()
         self._dev_splice_cap = 8
         # bucket set: the prefix shim widens every bucket by the prefix
-        # length (prompts now INCLUDE the prefix), and a prefix cache
-        # rounds buckets up to lcm(block, prefill_chunk) so cached
-        # admissions (block-granularity chunks) and chunked prefill both
-        # keep static, evenly-covered shapes
+        # length (prompts now INCLUDE the prefix), and a shared block
+        # unit (prefix cache and/or paged pool — ONE geometry, resolved
+        # by _block_geometry) rounds buckets up to lcm(block,
+        # prefill_chunk) so cached admissions (block-granularity
+        # chunks), paged block scatters, and chunked prefill all keep
+        # static, evenly-covered shapes
+        self._kv_block_size, align = self._block_geometry()
         raw = sorted(set(int(b) for b in prompt_buckets))
-        if self.prefix_len or self.prefix_cache is not None:
-            align = (
-                self.prefix_cache.block_size
-                if self.prefix_cache is not None
-                else 1
-            )
-            if self.prefill_chunk is not None:
-                align = math.lcm(align, self.prefill_chunk)
+        if self.prefix_len or self.prefix_cache is not None or self.paged:
             raw = sorted(set(
                 -(-(b + self.prefix_len) // align) * align for b in raw
             ))
@@ -553,6 +609,14 @@ class DecodeEngine:
             # a speculative round writes k rows past its counted advance
             + (self._round_stride - 1)
         )
+        if self.paged:
+            # the logical row space maps exactly onto whole pool blocks
+            # (table width = cache_len / block); overshoot rows past a
+            # request's reserved blocks write the trash block instead
+            self.cache_len = (
+                -(-self.cache_len // self._kv_block_size)
+                * self._kv_block_size
+            )
         max_lens = [self.cfg.max_len] + (
             [self.draft.config.max_len] if self.draft is not None else []
         )
@@ -566,6 +630,37 @@ class DecodeEngine:
                 f"model max_len {min(max_lens)}; lower pipeline_depth/"
                 "chunk_steps or raise max_len"
             )
+        # device block pool (paged mode): host-side free-list allocator
+        # + per-slot block tables; the device arrays live in _state
+        self.kv_pool: Optional[KVBlockPool] = None
+        self._table: Optional[np.ndarray] = None
+        self._dispatch_seq = 0      # decode chunks dispatched (fence clock)
+        self._harvest_seq = 0       # decode chunks harvested
+        # (fence, block ids): freed only once every chunk dispatched
+        # before the retirement has been harvested — an in-flight chunk
+        # may still write a just-retired slot's rows, and a recycled
+        # block must never see them
+        self._deferred_free: List = []
+        self._parked: Optional[_Request] = None
+        if self.paged:
+            blk = self._kv_block_size
+            self._table_width = self.cache_len // blk
+            block_nbytes = self._kv_block_nbytes(blk)
+            if kv_pool_blocks is not None:
+                num_blocks = int(kv_pool_blocks)
+            elif kv_pool_bytes is not None:
+                num_blocks = max(2, int(kv_pool_bytes) // block_nbytes)
+            else:
+                # default: the contiguous layout's worst case — a pure
+                # layout change until a byte budget tightens it
+                num_blocks = 1 + slots * self._table_width
+            self.kv_pool = KVBlockPool(
+                num_blocks=num_blocks, block_size=blk,
+                block_nbytes=block_nbytes, registry=self._registry,
+            )
+            self._table = np.zeros((slots, self._table_width), np.int32)
+            self._slot_covered = [0] * slots   # taken blocks per slot row
+            self._slot_rows = [0] * slots      # dispatched-rows upper bound
         self._sample = make_sampler(
             temperature=temperature, top_k=top_k, top_p=top_p
         )
@@ -702,7 +797,9 @@ class DecodeEngine:
         )
         self._m_rejected = {
             reason: rejected.labels(engine=self.instance, reason=reason)
-            for reason in ("queue_full", "breaker_open", "draining")
+            for reason in (
+                "queue_full", "breaker_open", "draining", "pool_full",
+            )
         }
         self._m_deadline_shed = counter(
             "unionml_engine_deadline_shed_total",
@@ -741,17 +838,30 @@ class DecodeEngine:
         tr = ProgramTracker(registry=self._registry, component=self.instance)
         self._programs = tr
         self._init_state = tr.wrap("engine.init_state", self._init_state)
-        self._prefill = tr.wrap(
-            "engine.prefill", self._prefill,
-            sig_fn=lambda p, st, slot, toks, *a, **k: toks.shape,
-        )
+        if self.paged:
+            # paged programs carry the block-id vector before the
+            # tokens, and extraction is table-addressed
+            self._prefill = tr.wrap(
+                "engine.prefill", self._prefill,
+                sig_fn=lambda p, st, slot, ids, toks, *a, **k: toks.shape,
+            )
+            self._prefill_final = tr.wrap(
+                "engine.prefill_final", self._prefill_final,
+                sig_fn=lambda p, st, fresh, slot, ids, toks, *a, **k:
+                    toks.shape,
+            )
+        else:
+            self._prefill = tr.wrap(
+                "engine.prefill", self._prefill,
+                sig_fn=lambda p, st, slot, toks, *a, **k: toks.shape,
+            )
+            self._prefill_final = tr.wrap(
+                "engine.prefill_final", self._prefill_final,
+                sig_fn=lambda p, st, fresh, slot, toks, *a, **k: toks.shape,
+            )
         self._prefill_step = tr.wrap(
             "engine.prefill_chunk", self._prefill_step,
             sig_fn=lambda p, fresh, toks, start: toks.shape,
-        )
-        self._prefill_final = tr.wrap(
-            "engine.prefill_final", self._prefill_final,
-            sig_fn=lambda p, st, fresh, slot, toks, *a, **k: toks.shape,
         )
         self._decode_chunk = tr.wrap("engine.decode", self._decode_chunk)
         self._init_fresh = tr.wrap(
@@ -763,10 +873,16 @@ class DecodeEngine:
                 "engine.splice_block", self._splice_block,
                 sig_fn=lambda fresh, rows, start: rows[0][0].shape,
             )
-            self._extract_rows = tr.wrap(
-                "engine.extract_rows", self._extract_rows,
-                sig_fn=lambda cache, slot, **k: k.get("n"),
-            )
+            if self.paged:
+                self._extract_blocks = tr.wrap(
+                    "engine.extract_blocks", self._extract_blocks,
+                    sig_fn=lambda pool, ids: ids.shape,
+                )
+            else:
+                self._extract_rows = tr.wrap(
+                    "engine.extract_rows", self._extract_rows,
+                    sig_fn=lambda cache, slot, **k: k.get("n"),
+                )
 
     def _flight_rec(self, kind: str, **fields) -> None:
         """O(1) flight-recorder append (no-op when introspect=False).
@@ -805,7 +921,7 @@ class DecodeEngine:
         pass a depth check and push the queue past ``max_queue_depth``
         (the exact overload the bound exists for)."""
         with self._lock:
-            self._admission_gate_locked(len(reqs))
+            self._admission_gate_locked(reqs)
             for req in reqs:
                 # recorded BEFORE the put, inside the lock: a request's
                 # 'submit' flight event can never land after its
@@ -817,7 +933,32 @@ class DecodeEngine:
                 self._queue.put(req)
         self._g_queue_depth.set(self._queue.qsize())
 
-    def _admission_gate_locked(self, n_new: int) -> None:
+    def _admission_gate_locked(self, reqs: List[_Request]) -> None:
+        n_new = len(reqs)
+        if self.paged:
+            # a request whose worst case exceeds the WHOLE pool can
+            # never be admitted — reject now (transient fullness parks
+            # at admission instead; the queue bound sheds the backlog)
+            for req in reqs:
+                needed = self.kv_pool.blocks_for_rows(
+                    min(len(req.prompt) + req.max_new_tokens,
+                        self.cache_len)
+                )
+                if needed > self.kv_pool.capacity:
+                    self._m_rejected["pool_full"].inc(n_new)
+                    self._flight_rec(
+                        "reject", reason="pool_full", n=n_new,
+                        needed_blocks=needed,
+                        capacity_blocks=self.kv_pool.capacity,
+                    )
+                    raise Overloaded(
+                        f"kv pool can never fit this request: "
+                        f"{needed} blocks needed "
+                        f"({len(req.prompt)} prompt + "
+                        f"{req.max_new_tokens} new tokens), pool "
+                        f"capacity {self.kv_pool.capacity} blocks",
+                        retry_after_s=60.0,
+                    )
         if self._draining:
             self._m_rejected["draining"].inc(n_new)
             self._flight_rec("reject", reason="draining", n=n_new)
@@ -905,6 +1046,53 @@ class DecodeEngine:
         that drain, swap weights via :meth:`bind`, and serve again)."""
         self._draining = False
 
+    def _block_geometry(self):
+        """The SINGLE home for KV block geometry: ``(block, align)``.
+
+        ``block`` is the shared block unit of the paged device pool AND
+        the prefix cache's host store — the two must agree (splice and
+        extract are per-block copies addressed by table entries), so an
+        explicit ``kv_block_size`` that contradicts the attached prefix
+        cache raises instead of silently desyncing. ``align`` is the
+        bucket rounding unit, ``lcm(block, prefill_chunk)`` — applied
+        whenever ANY block consumer is configured (prefix cache, paged
+        pool, or the prefix shim), so paged and prefix-cache bucket
+        geometry can never disagree either."""
+        cache_blk = (
+            self.prefix_cache.block_size
+            if self.prefix_cache is not None else None
+        )
+        pool_blk = self._kv_block_size_arg if self.paged else None
+        if (
+            pool_blk is not None
+            and cache_blk is not None
+            and pool_blk != cache_blk
+        ):
+            raise ValueError(
+                f"kv_block_size {pool_blk} != prefix cache block_size "
+                f"{cache_blk} — the device pool and the host block store "
+                "share one block unit (admission splice and harvest "
+                "extract are per-block copies); drop kv_block_size or "
+                "rebuild the cache with the matching block_size"
+            )
+        block = pool_blk or cache_blk or (16 if self.paged else None)
+        align = block or 1
+        if self.prefill_chunk is not None:
+            align = math.lcm(align, self.prefill_chunk)
+        return block, align
+
+    def _kv_block_nbytes(self, blk: int) -> int:
+        """Device bytes of one pool block across every layer and buffer
+        (mirrors ``init_cache``'s layout: bf16 k/v, or int8 k/v + fp32
+        per-(row, head) scales under ``kv_quant``)."""
+        cfg = self.cfg
+        rows = blk * cfg.num_kv_heads
+        if getattr(cfg, "kv_quant", False):
+            per_layer = 2 * (rows * cfg.head_dim * 1 + rows * 4)
+        else:
+            per_layer = 2 * rows * cfg.head_dim * 2
+        return cfg.num_layers * per_layer
+
     # ------------------------------------------------------------------ #
     # device programs (compiled once per shape)
     # ------------------------------------------------------------------ #
@@ -917,6 +1105,9 @@ class DecodeEngine:
 
         if self.draft is not None:
             self._build_spec_programs()
+            return
+        if self.paged:
+            self._build_paged_programs()
             return
 
         cfg, L, B = self.cfg, self.cache_len, self.slots
@@ -1060,6 +1251,186 @@ class DecodeEngine:
                 return {
                     "cache": cache,
                     "kv_mask": kv_mask,
+                    "fill": fill + advance.astype(jnp.int32),
+                    "last_tok": jnp.where(live, nxt, state["last_tok"]),
+                    "done": done,
+                }, nxt
+
+            state, toks = jax.lax.scan(step, state, keys)
+            return state, toks  # toks: [chunk_steps, slots]
+
+        self._decode_chunk = jax.jit(decode_chunk, donate_argnums=(1,))
+
+    def _build_paged_programs(self):
+        """Paged-mode device programs (``self.paged``).
+
+        Same attribute names and dispatcher contract as the contiguous
+        builders, but the resident KV is a global block pool
+        (``[num_blocks, block, kv_heads, head_dim]`` per layer) plus the
+        host-owned block table passed into every decode chunk:
+
+        - prefill still computes against a transient contiguous
+          ``[1, bucket]`` fresh cache (chunked prefill and prefix-cache
+          splices ride it unchanged — one admission's workspace, not
+          per-slot residency), but ``finish_prefill`` ends in a
+          TABLE-DIRECTED per-block scatter into the pool instead of a
+          contiguous row splice: only ``ceil(true_len / block)`` real
+          blocks are written, padding blocks land on the trash block;
+        - the decode chunk reads/writes through
+          :mod:`~unionml_tpu.ops.paged_attention` (``block_table=``
+          path in the model), with retired slots' table rows masked to
+          the trash block PER STEP so an in-flight chunk can never
+          corrupt a recycled block;
+        - harvest extract gathers a slot's blocks by table entry
+          (``jnp.take``), feeding the prefix cache per-block host
+          copies directly.
+
+        There is no resident ``kv_mask``: visibility is ``fill + 1``
+        (bit-identical to the contiguous mask for live slots — tested).
+        """
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from unionml_tpu.models.llama import init_cache
+
+        cfg, L, B = self.cfg, self.cache_len, self.slots
+        blk = self._kv_block_size
+        n_pool = self.kv_pool.num_blocks
+        module, sample = self.module, self._sample
+        eos_id, pad_id = self.eos_id, self.pad_id
+
+        def init_state():
+            return {
+                "pool": init_cache(cfg, n_pool, blk),
+                # empty slots idle at row 0 with all-trash table rows:
+                # dead slots still run the decode apply, but their
+                # writes land in the trash block (step_table masking)
+                "fill": jnp.zeros((B,), jnp.int32),
+                "last_tok": jnp.zeros((B,), jnp.int32),
+                "done": jnp.ones((B,), bool),
+            }
+
+        self._init_state = jax.jit(init_state)
+
+        def scatter_blocks(pool, fresh, ids):
+            """Table-directed block scatter: fresh ``[1, bucket]`` rows
+            into pool blocks ``ids`` ([bucket/block] int32; padding
+            entries point at the trash block — duplicate trash writes
+            race benignly, it is garbage by definition)."""
+            nb = ids.shape[0]
+            return tuple(
+                tuple(
+                    pbuf.at[ids].set(
+                        fbuf.reshape((nb, blk) + fbuf.shape[2:])
+                        .astype(pbuf.dtype)
+                    )
+                    for pbuf, fbuf in zip(p_layer, f_layer)
+                )
+                for p_layer, f_layer in zip(pool, fresh)
+            )
+
+        def finish_prefill(params, state, fresh, slot, ids, toks, start,
+                           true_len, key, **apply_kwargs):
+            """The paged prefill tail: same fresh-cache compute and
+            first-token sampling as the contiguous path (logits are
+            bit-identical), then the per-block pool scatter in place of
+            the contiguous row splice."""
+            bucket = fresh[0][0].shape[1]
+            c = toks.shape[1]
+            kv_mask = (jnp.arange(bucket) < true_len)[None, :]
+            logits, filled = module.apply(
+                {"params": params}, toks,
+                positions=start + jnp.arange(c)[None, :],
+                cache=fresh, cache_index=start, kv_mask=kv_mask,
+                logit_index=jnp.reshape(true_len - 1 - start, (1,)),
+                **apply_kwargs,
+            )
+            first = sample(logits[:, 0], key)[0]
+            pool = scatter_blocks(state["pool"], filled, ids)
+            return {
+                "pool": pool,
+                "fill": state["fill"].at[slot].set(true_len),
+                "last_tok": state["last_tok"].at[slot].set(first),
+                "done": state["done"].at[slot].set(False),
+            }, first
+
+        _full_kwargs = (
+            {"full_prefill": True} if cfg.prefill_impl == "flash" else {}
+        )
+
+        def prefill(params, state, slot, ids, tokens, true_len, key):
+            fresh = init_cache(cfg, 1, tokens.shape[0])
+            return finish_prefill(
+                params, state, fresh, slot, ids, tokens[None],
+                jnp.int32(0), true_len, key, **_full_kwargs,
+            )
+
+        self._prefill = jax.jit(prefill, donate_argnums=(1,))
+
+        @functools.partial(jax.jit, static_argnames=("bucket",))
+        def init_fresh(*, bucket):
+            return init_cache(cfg, 1, bucket)
+
+        self._init_fresh = init_fresh
+
+        def prefill_step(params, fresh, toks, start):
+            """One lead chunk against the contiguous fresh cache —
+            verbatim the contiguous engine's program (the workspace
+            layout did not change, only residency did)."""
+            lf = fresh[0][0].shape[1]
+            c = toks.shape[1]
+            kv_mask = (jnp.arange(lf) < start + c)[None, :]
+            _, fresh = module.apply(
+                {"params": params}, toks,
+                positions=start + jnp.arange(c)[None, :],
+                cache=fresh, cache_index=start, kv_mask=kv_mask,
+                logit_index=jnp.zeros((1,), jnp.int32),
+            )
+            return fresh
+
+        self._prefill_step = jax.jit(prefill_step, donate_argnums=(1,))
+        self._prefill_final = jax.jit(finish_prefill, donate_argnums=(1,))
+        self._build_cache_programs()
+
+        def extract_blocks(pool, ids):
+            """Gather a slot's pool blocks ([n_blocks, block, ...] per
+            buffer) for the async device→host prefix-cache insert —
+            per-block copies addressed by table entries (the contiguous
+            path's row-window slice has no paged equivalent)."""
+            return tuple(
+                tuple(jnp.take(buf, ids, axis=0) for buf in layer)
+                for layer in pool
+            )
+
+        self._extract_blocks = jax.jit(extract_blocks)
+
+        def decode_chunk(params, state, active, table, keys):
+            """``chunk_steps`` paged decode steps in one scan. The
+            block table is a per-chunk INPUT (the host grows it between
+            chunks), with retired/dead slots' rows re-masked to the
+            trash block every step so their writes can never land in a
+            block the allocator has recycled."""
+
+            def step(state, key):
+                live = active & ~state["done"]
+                fill = state["fill"]
+                step_table = jnp.where(live[:, None], table, 0)
+                logits, pool = module.apply(
+                    {"params": params}, state["last_tok"][:, None],
+                    cache=state["pool"], cache_index=fill,
+                    block_table=step_table,
+                )
+                nxt = sample(logits[:, -1], key)
+                nxt = jnp.where(live, nxt, pad_id)
+                done = state["done"]
+                if eos_id is not None:
+                    done = done | (live & (nxt == eos_id))
+                advance = live & (fill + 1 < L)
+                done = done | (live & ~advance)
+                return {
+                    "pool": pool,
                     "fill": fill + advance.astype(jnp.int32),
                     "last_tok": jnp.where(live, nxt, state["last_tok"]),
                     "done": done,
@@ -1581,6 +1952,8 @@ class DecodeEngine:
             }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
+        if self.kv_pool is not None:
+            out["kv_pool"] = self.kv_pool.stats()
         if self._programs is not None:
             # hardware truth per compiled program: flops/bytes, compile
             # counts, MFU/roofline ratios (docs/observability.md)
@@ -1623,6 +1996,8 @@ class DecodeEngine:
             m.reset()
         if self.prefix_cache is not None:
             self.prefix_cache.reset_stats()
+        if self.kv_pool is not None:
+            self.kv_pool.reset_stats()
         if self._programs is not None:
             self._programs.reset()
 
@@ -1632,8 +2007,11 @@ class DecodeEngine:
         self._harvester.join(timeout=5.0)
         with self._lock:
             adm, self._admission = self._admission, None
+            parked, self._parked = self._parked, None
         if adm is not None:
             self._drop_admission(adm.req, RuntimeError("decode engine closed"))
+        if parked is not None:
+            self._drop_admission(parked, RuntimeError("decode engine closed"))
         # drain the in-flight pipeline the harvester no longer owns:
         # stranded insert entries still hold lease refcounts — leaking
         # them would pin blocks in a user-supplied cache forever
@@ -1703,12 +2081,22 @@ class DecodeEngine:
         with self._lock:
             ep0 = self._epoch
             st = self._state
+            ids = (
+                self._take_covered_locked(req, slot, _bucket)
+                if self.paged else None
+            )
         if st is None:
             st = self._init_state()
-        new_state, first = self._prefill(
-            self._params, st, jnp.int32(slot), jnp.asarray(padded),
-            jnp.int32(len(req.prompt)), key,
-        )
+        if self.paged:
+            new_state, first = self._prefill(
+                self._params, st, jnp.int32(slot), jnp.asarray(ids),
+                jnp.asarray(padded), jnp.int32(len(req.prompt)), key,
+            )
+        else:
+            new_state, first = self._prefill(
+                self._params, st, jnp.int32(slot), jnp.asarray(padded),
+                jnp.int32(len(req.prompt)), key,
+            )
         _start_host_copy(first)
         with self._lock:
             if self._epoch != ep0:
@@ -1776,6 +2164,20 @@ class DecodeEngine:
         st = self._state  # one read: _recover may null it concurrently
         if first_new >= nb or st is None:
             rows = None  # nothing new to store — release-only entry
+        elif self.paged:
+            # gather the slot's blocks BY TABLE ENTRY (one compiled
+            # dispatch per bucket; uncovered tail entries gather the
+            # trash block and are never inserted) — the paged form of
+            # the contiguous row-window extract
+            blk = self._kv_block_size
+            with self._lock:
+                ids = self._table[
+                    slot, : self._bucket_for(len(req.prompt)) // blk
+                ].copy()
+            rows = self._extract_blocks(st["pool"], jnp.asarray(ids))
+            for layer in rows:
+                for buf in layer:
+                    _start_host_copy(buf)
         else:
             rows = self._extract_rows(
                 st["cache"], jnp.int32(slot),
@@ -1792,6 +2194,108 @@ class DecodeEngine:
         lease, req._lease = req._lease, None
         if lease is not None:
             lease.release()
+
+    # ------------------------------------------------------------------ #
+    # paged-mode pool bookkeeping (engine lock held for all of these)
+    # ------------------------------------------------------------------ #
+
+    def _sweep_deferred_locked(self) -> None:
+        """Free deferred block batches whose fence has passed: every
+        decode chunk dispatched before the owning slot retired has been
+        harvested, so no in-flight program can still write the rows."""
+        if not self._deferred_free:
+            return
+        keep = []
+        for fence, ids in self._deferred_free:
+            if fence <= self._harvest_seq:
+                self.kv_pool.give(ids)
+            else:
+                keep.append((fence, ids))
+        self._deferred_free = keep
+
+    def _take_covered_locked(self, req: _Request, slot: int,
+                             bucket: int) -> np.ndarray:
+        """Convert the leading ``ceil(true_len / block)`` of the
+        request's reservation into concrete pool blocks, install them
+        in the slot's table row, and return the scatter id vector
+        ([bucket/block] int32, trash-padded) the prefill program
+        consumes. The rest of the reservation converts lazily as
+        decode fills rows (_grow_tables_locked)."""
+        blk = self._kv_block_size
+        nbb = bucket // blk
+        covered = self.kv_pool.blocks_for_rows(len(req.prompt))
+        ids = np.zeros(nbb, np.int32)
+        self._table[slot, :] = 0
+        for j in range(covered):
+            bid = self.kv_pool.take()
+            req._resv_blocks -= 1
+            req._block_ids.append(bid)
+            ids[j] = bid
+            self._table[slot, j] = bid
+        self._slot_covered[slot] = covered
+        self._slot_rows[slot] = len(req.prompt)
+        return ids
+
+    def _grow_tables_locked(self) -> np.ndarray:
+        """Grow every live slot's block table to cover the NEXT decode
+        chunk's worst-case advance (``chunk_steps`` rows), drawing from
+        each request's admission-time reservation — which is why growth
+        can never fail — and return the table snapshot the chunk
+        dispatch uploads. Rows past a request's reserved budget stay on
+        the trash block: only overshoot (post-eos / post-budget device
+        writes whose tokens the host discards) ever lands there."""
+        used_rows = 0
+        for slot, req in enumerate(self._occupant):
+            if req is None:
+                continue
+            target_rows = min(
+                self._slot_rows[slot] + self.chunk_steps, req._rows_cap
+            )
+            want = min(
+                self.kv_pool.blocks_for_rows(target_rows),
+                self._table_width,
+            )
+            while self._slot_covered[slot] < want and req._resv_blocks > 0:
+                bid = self.kv_pool.take()
+                req._resv_blocks -= 1
+                req._block_ids.append(bid)
+                self._table[slot, self._slot_covered[slot]] = bid
+                self._slot_covered[slot] += 1
+            used_rows += min(self._slot_rows[slot], req._rows_cap)
+        self.kv_pool.note_used_rows(used_rows)
+        return self._table.copy()
+
+    def _release_blocks_locked(self, req: _Request,
+                               slot: Optional[int] = None) -> None:
+        """Retirement-path release: taken blocks go on the DEFERRED
+        list fenced at the current dispatch seq (an in-flight chunk
+        dispatched before this retirement may still write them — the
+        free lands only after its harvest); the untaken reservation
+        releases immediately (never in any table)."""
+        ids, req._block_ids = list(req._block_ids), []
+        unreserve, req._resv_blocks = req._resv_blocks, 0
+        if slot is not None:
+            self._table[slot, :] = 0
+            self._slot_covered[slot] = 0
+            self._slot_rows[slot] = 0
+        if req._pool_gen != self.kv_pool.generation:
+            return  # a recovery reset the pool under us: ids are stale
+        if ids:
+            self._deferred_free.append((self._dispatch_seq, ids))
+        if unreserve:
+            self.kv_pool.give([], unreserve=unreserve)
+        self._sweep_deferred_locked()
+
+    def _drop_blocks_now_locked(self, req: _Request) -> None:
+        """Mid-admission release (the slot never became occupied, so
+        every chunk dispatched so far carried ``active=False`` for it —
+        its writes are trash-routed on device): immediate free."""
+        ids, req._block_ids = list(req._block_ids), []
+        unreserve, req._resv_blocks = req._resv_blocks, 0
+        if req._pool_gen != self.kv_pool.generation:
+            return  # a recovery reset the pool under us: ids are stale
+        if ids or unreserve:
+            self.kv_pool.give(ids, unreserve=unreserve)
 
     def _req_done(self, req: _Request, tok: int) -> bool:
         """The single stop predicate (shared by retirement and the
@@ -1825,6 +2329,11 @@ class DecodeEngine:
             else:
                 self._m_abandoned.inc()
             self._occupant[slot] = None
+            if self.paged:
+                # taken blocks free behind the dispatch fence (chunks
+                # already in flight may still write them); the untaken
+                # reservation frees now
+                self._release_blocks_locked(req, slot)
             self._m_slots_busy.set(self._slots_in_use_locked())
             self._tracer.record_span(req.rid, "harvest", self._harvest_t0, now)
             self._tracer.finish_request(req.rid)
@@ -1874,16 +2383,32 @@ class DecodeEngine:
                         tuple(np.asarray(buf) for buf in layer)
                         for layer in rows
                     )
-                    blocks = [
-                        tuple(
+                    if self.paged:
+                        # extract arrived block-major ([n_blocks, blk,
+                        # ...] per buffer — the table-addressed gather):
+                        # block j is row j, re-leading-axised to the
+                        # host store's [1, blk, ...] form
+                        blocks = [
                             tuple(
-                                buf[:, j * blk:(j + 1) * blk].copy()
-                                for buf in layer
+                                tuple(
+                                    buf[j][None].copy()
+                                    for buf in layer
+                                )
+                                for layer in full
                             )
-                            for layer in full
-                        )
-                        for j in range(first_new, nb)
-                    ]
+                            for j in range(first_new, nb)
+                        ]
+                    else:
+                        blocks = [
+                            tuple(
+                                tuple(
+                                    buf[:, j * blk:(j + 1) * blk].copy()
+                                    for buf in layer
+                                )
+                                for layer in full
+                            )
+                            for j in range(first_new, nb)
+                        ]
                     self.prefix_cache.insert(req.prompt, first_new, blocks)
             except Exception as exc:
                 logger.info(f"prefix-cache insert skipped: {exc!r}")
@@ -1906,7 +2431,7 @@ class DecodeEngine:
                 req.emit([tok])
                 self._finish_if_done(slot, tok)
             return
-        _, _, mask, gens, toks, dispatched = entry
+        _, _, mask, gens, toks, dispatched, seq = entry
         if self.draft is not None:
             self._process_spec_chunk(mask, gens, toks, dispatched)
             return
@@ -1940,6 +2465,12 @@ class DecodeEngine:
                 req._chunk_i += 1
                 req.emit(chunk)
                 self._finish_if_done(slot, chunk[-1])
+            if self.paged:
+                # this chunk (and by FIFO order every earlier one) has
+                # been harvested: deferred frees fenced at or before it
+                # are now safe — no in-flight program references them
+                self._harvest_seq = max(self._harvest_seq, seq)
+                self._sweep_deferred_locked()
 
     def _process_spec_chunk(self, mask, gens, outs, dispatched) -> None:
         """Account one speculative chunk's readback: per round, each slot
@@ -1999,6 +2530,10 @@ class DecodeEngine:
         occupant still needs tokens beyond already-dispatched work."""
         import jax.numpy as jnp
 
+        if not self._chunk_credits.acquire(blocking=False):
+            return False  # pipeline_depth chunks already awaiting harvest
+        seq = 0
+        table_np = None
         with self._lock:
             mask = np.array([r is not None for r in self._occupant])
             needed = any(
@@ -2007,17 +2542,32 @@ class DecodeEngine:
             )
             ep0 = self._epoch
             st = self._state
-        if not mask.any() or not needed or st is None:
+            proceed = bool(mask.any()) and needed and st is not None
+            if proceed and self.paged:
+                # grow tables + snapshot + assign this chunk's fence seq
+                # under ONE lock hold: a retirement racing this dispatch
+                # fences its deferred frees at _dispatch_seq, which now
+                # covers the snapshot we are about to launch — the
+                # in-flight chunk can never write a recycled block
+                table_np = self._grow_tables_locked()
+                self._dispatch_seq += 1
+                seq = self._dispatch_seq
+        if not proceed:
+            self._chunk_credits.release()
             return False
-        if not self._chunk_credits.acquire(blocking=False):
-            return False  # pipeline_depth chunks already awaiting harvest
         t_dispatch = time.perf_counter()
         try:
             self._fire("engine.dispatch")
             keys = jnp.stack(self._next_key(self.chunk_steps))
-            new_state, toks = self._decode_chunk(
-                self._params, st, jnp.asarray(mask), keys
-            )
+            if self.paged:
+                new_state, toks = self._decode_chunk(
+                    self._params, st, jnp.asarray(mask),
+                    jnp.asarray(table_np), keys,
+                )
+            else:
+                new_state, toks = self._decode_chunk(
+                    self._params, st, jnp.asarray(mask), keys
+                )
             for leaf in toks if isinstance(toks, tuple) else (toks,):
                 _start_host_copy(leaf)
             self._h_dispatch.observe((time.perf_counter() - t_dispatch) * 1e3)
@@ -2045,11 +2595,18 @@ class DecodeEngine:
                     # over-dispatch at high acceptance is absorbed by the
                     # done mask + spare rows like any overshoot
                     self._occupant[slot]._expected += self.chunk_steps
+                    if self.paged:
+                        # host upper bound of the slot's device fill:
+                        # next growth pass covers the following chunk
+                        self._slot_rows[slot] = min(
+                            self._slot_rows[slot] + self.chunk_steps,
+                            self.cache_len,
+                        )
             gens = tuple(self._slot_gen)
             self._m_chunks.inc()
             self._m_steps.inc(self.chunk_steps)
             self._m_occupied.inc(int(mask.sum()) * self.chunk_steps)
-        self._inflight.put(("chunk", ep0, mask, gens, toks, t_dispatch))
+        self._inflight.put(("chunk", ep0, mask, gens, toks, t_dispatch, seq))
         return True
 
     def _pop_request(self) -> Optional[_Request]:
@@ -2078,6 +2635,11 @@ class DecodeEngine:
                 return
             req.error = exc
             self._admitting -= 1
+            if self.paged:
+                # the slot never became occupied, so every dispatched
+                # chunk carried active=False for it (writes trash-routed
+                # on device) — immediate free is safe
+                self._drop_blocks_now_locked(req)
         self._release_lease(req)
         if req.abandoned:
             self._m_abandoned.inc()
@@ -2122,6 +2684,57 @@ class DecodeEngine:
                 ))
                 return
             self._fire("engine.prefill")
+            if self.paged and not req._block_ids and req._resv_blocks == 0:
+                # reserve the WORST-CASE block count up front so table
+                # growth can never fail mid-decode; a transiently full
+                # pool PARKS the admission (retried every dispatcher
+                # pass, FIFO preserved — nothing admits past it) until
+                # retirements free blocks. Queue backlog behind a
+                # parked admission sheds through max_queue_depth.
+                rows_cap = min(
+                    len(req.prompt) + req.max_new_tokens, self.cache_len
+                )
+                needed = self.kv_pool.blocks_for_rows(rows_cap)
+                with self._lock:
+                    try:
+                        # retries of a parked admission count neither a
+                        # new alloc failure nor a new flight event —
+                        # one pool-pressure incident per park
+                        self.kv_pool.reserve(
+                            needed, count_failure=not req._park_logged
+                        )
+                    except PoolExhausted as exc:
+                        self._parked = req
+                        if not req._park_logged:
+                            req._park_logged = True
+                            resident = [
+                                r for r in self._occupant if r is not None
+                            ]
+                            cand = (
+                                min(resident, key=lambda r: r.submitted)
+                                if resident else None
+                            )
+                            # post-hoc 429 analysis: distinguishes
+                            # pool-full from queue-full, and names the
+                            # preemption candidate a future scheduler
+                            # would evict (docs/observability.md)
+                            self._flight_rec(
+                                "pool_pressure", reason="alloc_fail",
+                                rid=req.rid, needed_blocks=exc.needed,
+                                available_blocks=exc.available,
+                                preempt_candidate=(
+                                    cand.rid if cand is not None else None
+                                ),
+                                preempt_candidate_blocks=(
+                                    len(cand._block_ids)
+                                    if cand is not None else 0
+                                ),
+                            )
+                        return
+                    req._resv_blocks = needed
+                    req._rows_cap = rows_cap
+                    req._park_logged = False
+                    req._pool_gen = self.kv_pool.generation
             # the resident state inits lazily inside _admit / the final
             # chunk of _advance_admission (NOT here: an unlocked write
             # would race a concurrent _recover's reset)
@@ -2179,10 +2792,15 @@ class DecodeEngine:
             else:
                 splice_rows = []
             n_chunks = -(-len(req.prompt) // chunk_use)
+            pool_ids = None
+            if self.paged:
+                with self._lock:
+                    pool_ids = self._take_covered_locked(req, slot, bucket)
             adm = _Admission(
                 req=req, slot=slot, bucket=bucket, chunk=chunk_use,
                 n_chunks=n_chunks, padded=padded,
                 fresh=self._init_fresh(bucket=bucket),
+                pool_ids=pool_ids,
                 next_chunk=m_used // chunk_use,
                 splice_rows=splice_rows,
             )
@@ -2261,10 +2879,17 @@ class DecodeEngine:
                 # instead would strand the admission (never completed,
                 # never dropped) and wedge the engine
                 st = self._init_state()
-            new_state, first = self._prefill_final(
-                self._params, st, adm.fresh, jnp.int32(adm.slot),
-                toks, jnp.int32(start), jnp.int32(len(req.prompt)), key,
-            )
+            if self.paged:
+                new_state, first = self._prefill_final(
+                    self._params, st, adm.fresh, jnp.int32(adm.slot),
+                    jnp.asarray(adm.pool_ids), toks, jnp.int32(start),
+                    jnp.int32(len(req.prompt)), key,
+                )
+            else:
+                new_state, first = self._prefill_final(
+                    self._params, st, adm.fresh, jnp.int32(adm.slot),
+                    toks, jnp.int32(start), jnp.int32(len(req.prompt)), key,
+                )
             _start_host_copy(first)
             with self._lock:
                 if self._admission is not adm or self._epoch != ep0:
@@ -2315,10 +2940,19 @@ class DecodeEngine:
                     self._advance_admission(adm)
                     progressed = True
                 else:
-                    req = self._pop_request()
+                    # a parked admission (pool exhausted at reservation)
+                    # retries FIRST — nothing admits past it, so FIFO
+                    # order survives pool pressure
+                    req = self._parked
+                    if req is not None:
+                        self._parked = None
+                    else:
+                        req = self._pop_request()
                     if req is not None:
                         self._start_admission(req)
-                        progressed = True
+                        # re-parking is not progress (sleep, retry on
+                        # the next pass once retirements free blocks)
+                        progressed = self._parked is not req
                 if self._dispatch_chunk():
                     progressed = True
                 if not progressed:
@@ -2377,11 +3011,26 @@ class DecodeEngine:
                     self._m_errors.inc()
                     self._tracer.finish_request(req.rid)
                     self._release_lease(req)
+                    # pool bookkeeping resets wholesale below — zero the
+                    # per-request fields so nothing double-frees
+                    req._block_ids = []
+                    req._resv_blocks = 0
                     req.event.set()
                     req.finish_stream()
                     self._occupant[slot] = None
             self._m_slots_busy.set(0)
             self._state = None
+            if self.paged:
+                # the device pool arrays died with the donated state;
+                # the next admission's _init_state rebuilds them, so
+                # host bookkeeping resets with them (in-flight poisoned
+                # readbacks are epoch-skipped and write dead buffers)
+                self.kv_pool.reset()
+                self._table[:] = 0
+                self._slot_covered = [0] * self.slots
+                self._slot_rows = [0] * self.slots
+                self._deferred_free = []
+                self._harvest_seq = self._dispatch_seq
             self._m_recoveries.inc()
             now = time.monotonic()
             self._recovery_times.append(now)
