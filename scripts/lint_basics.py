@@ -50,8 +50,17 @@ DEFAULT_PATHS = ["unionml_tpu", "tests", "benchmarks", "scripts", "bench.py",
 MAX_LINE = 110
 
 # repo-relative prefixes where time.time() is banned (monotonic-clock
-# territory: queue deadlines, latency splits, drain timers)
-WALL_CLOCK_BANNED = ("unionml_tpu/serving/", "unionml_tpu/execution.py")
+# territory: queue deadlines, latency splits, drain timers — and, since
+# the goodput layer, every trainer path whose durations feed badput
+# buckets: wall clock stepping under NTP would mis-attribute seconds)
+WALL_CLOCK_BANNED = (
+    "unionml_tpu/serving/",
+    "unionml_tpu/execution.py",
+    "unionml_tpu/goodput.py",
+    "unionml_tpu/elastic.py",
+    "unionml_tpu/data/pipeline.py",
+    "unionml_tpu/checkpoint/",
+)
 
 # where direct `cache[...]` / `<expr>.cache[...]` subscripts are banned:
 # serving-layer device KV goes through the block-table API so the paged

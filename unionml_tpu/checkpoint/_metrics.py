@@ -1,0 +1,71 @@
+"""Checkpoint I/O telemetry, shared by pytree_io and sharded.
+
+Every save/restore on either checkpoint path publishes
+``unionml_checkpoint_{save,restore}_ms{kind}`` histograms (the wall
+time the CALLER stalled — for the async :class:`CheckpointManager`
+that is the wait-for-previous-commit plus launch, exactly the piece
+that lands in the training loop's ``checkpoint`` badput bucket) and
+``unionml_checkpoint_{save,restore}_bytes_total{kind}`` counters
+(``kind="pytree"`` for the single-file msgpack artifact,
+``kind="sharded"`` for Orbax). The series feed the goodput layer
+(docs/observability.md "Training goodput") and give ROADMAP's
+async-checkpoint work a before/after yardstick.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from unionml_tpu import telemetry
+
+
+def checkpoint_metrics(
+    registry: Optional[telemetry.MetricsRegistry] = None,
+) -> dict:
+    """The four checkpoint I/O families on ``registry`` (default: the
+    process-global one), keyed ``save_ms`` / ``restore_ms`` /
+    ``save_bytes`` / ``restore_bytes``."""
+    reg = registry if registry is not None else telemetry.get_registry()
+    return {
+        "save_ms": reg.histogram(
+            "unionml_checkpoint_save_ms",
+            "Caller-visible checkpoint save stall (async managers: wait "
+            "for the previous commit + snapshot/launch).",
+            ("kind",),
+        ),
+        "restore_ms": reg.histogram(
+            "unionml_checkpoint_restore_ms",
+            "Checkpoint restore wall time.",
+            ("kind",),
+        ),
+        "save_bytes": reg.counter(
+            "unionml_checkpoint_save_bytes_total",
+            "Bytes written to checkpoints (pytree leaf bytes for "
+            "sharded saves; serialized artifact bytes for pytree saves).",
+            ("kind",),
+        ),
+        "restore_bytes": reg.counter(
+            "unionml_checkpoint_restore_bytes_total",
+            "Bytes restored from checkpoints.",
+            ("kind",),
+        ),
+    }
+
+
+def tree_nbytes(tree) -> int:
+    """Total leaf bytes of a (possibly device-resident) pytree — the
+    size a sharded save writes / a restore re-places. Leaves without
+    ``nbytes`` (scalars, None) count 0; never raises."""
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(tree)
+    except Exception:
+        return 0
+    total = 0
+    for leaf in leaves:
+        try:
+            total += int(getattr(leaf, "nbytes", 0) or 0)
+        except Exception:
+            continue
+    return total

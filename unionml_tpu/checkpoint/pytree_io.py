@@ -12,7 +12,10 @@ from __future__ import annotations
 import json
 import os
 import struct
+import time
 from typing import IO, Any, Callable, Optional, Union
+
+from unionml_tpu.checkpoint._metrics import checkpoint_metrics
 
 _MAGIC = b"UTPU1"
 
@@ -27,6 +30,7 @@ def save_pytree(pytree: Any, hyperparameters: Optional[dict], file: Union[str, o
     """Serialize ``pytree`` + hyperparameters to ``file``."""
     from flax import serialization
 
+    t0 = time.perf_counter()
     payload = serialization.to_bytes(pytree)
     header = json.dumps({"hyperparameters": hyperparameters}).encode()
     f, should_close = _open(file, "wb")
@@ -38,6 +42,13 @@ def save_pytree(pytree: Any, hyperparameters: Optional[dict], file: Union[str, o
     finally:
         if should_close:
             f.close()
+    metrics = checkpoint_metrics()
+    metrics["save_ms"].labels("pytree").observe(
+        (time.perf_counter() - t0) * 1e3
+    )
+    metrics["save_bytes"].labels("pytree").inc(
+        len(_MAGIC) + 8 + len(header) + len(payload)
+    )
 
 
 def load_pytree(
@@ -48,6 +59,7 @@ def load_pytree(
     the target structure (typically the app's ``init``)."""
     from flax import serialization
 
+    t0 = time.perf_counter()
     f, should_close = _open(file, "rb")
     try:
         magic = f.read(len(_MAGIC))
@@ -63,4 +75,10 @@ def load_pytree(
         if should_close:
             f.close()
     target = target_factory(header.get("hyperparameters"))
-    return serialization.from_bytes(target, payload)
+    out = serialization.from_bytes(target, payload)
+    metrics = checkpoint_metrics()
+    metrics["restore_ms"].labels("pytree").observe(
+        (time.perf_counter() - t0) * 1e3
+    )
+    metrics["restore_bytes"].labels("pytree").inc(len(payload))
+    return out

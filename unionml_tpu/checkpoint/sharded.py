@@ -18,8 +18,11 @@ from __future__ import annotations
 
 import os
 import shutil
+import time
 from pathlib import Path
 from typing import Any, Optional, Union
+
+from unionml_tpu.checkpoint._metrics import checkpoint_metrics, tree_nbytes
 
 
 def _ocp():
@@ -44,8 +47,14 @@ def save_sharded(
     path = Path(path).absolute()
     if step is not None:
         path = path / f"step_{step}"
+    t0 = time.perf_counter()
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, state, force=force)
+    metrics = checkpoint_metrics()
+    metrics["save_ms"].labels("sharded").observe(
+        (time.perf_counter() - t0) * 1e3
+    )
+    metrics["save_bytes"].labels("sharded").inc(tree_nbytes(state))
 
 
 def restore_sharded(path: Union[str, os.PathLike], target: Any = None, *, step: Optional[int] = None) -> Any:
@@ -55,8 +64,18 @@ def restore_sharded(path: Union[str, os.PathLike], target: Any = None, *, step: 
     path = Path(path).absolute()
     if step is not None:
         path = path / f"step_{step}"
+    t0 = time.perf_counter()
     with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.restore(path, target) if target is not None else ckptr.restore(path)
+        out = (
+            ckptr.restore(path, target) if target is not None
+            else ckptr.restore(path)
+        )
+    metrics = checkpoint_metrics()
+    metrics["restore_ms"].labels("sharded").observe(
+        (time.perf_counter() - t0) * 1e3
+    )
+    metrics["restore_bytes"].labels("sharded").inc(tree_nbytes(out))
+    return out
 
 
 class CheckpointManager:
@@ -81,6 +100,7 @@ class CheckpointManager:
         *,
         max_to_keep: int = 3,
         async_save: bool = True,
+        registry: Optional[Any] = None,
     ):
         if max_to_keep is not None and max_to_keep < 0:
             raise ValueError(f"max_to_keep must be >= 0 or None, got {max_to_keep}")
@@ -89,6 +109,12 @@ class CheckpointManager:
         self.async_save = async_save
         self.root.mkdir(parents=True, exist_ok=True)
         self._ckptr = None
+        # unionml_checkpoint_* save/restore histograms + bytes counters
+        # (docs/observability.md): what save() observes is the CALLER
+        # stall — for async saves the wait-for-previous-commit plus the
+        # device->host snapshot/launch, i.e. the checkpoint badput the
+        # training loop actually pays
+        self._metrics = checkpoint_metrics(registry)
 
     def _checkpointer(self):
         if self._ckptr is None:
@@ -122,6 +148,7 @@ class CheckpointManager:
             shutil.rmtree(self.root / f"step_{victim}", ignore_errors=True)
 
     def save(self, step: int, state: Any) -> None:
+        t0 = time.perf_counter()
         ckptr = self._checkpointer()
         # one write in flight at a time: pruning must never race a pending
         # commit, and a second save would contend for host I/O
@@ -130,6 +157,10 @@ class CheckpointManager:
         ckptr.save(self.root / f"step_{step}", state, force=True)
         if not self.async_save:
             ckptr.wait_until_finished()
+        self._metrics["save_ms"].labels("sharded").observe(
+            (time.perf_counter() - t0) * 1e3
+        )
+        self._metrics["save_bytes"].labels("sharded").inc(tree_nbytes(state))
 
     def wait(self) -> None:
         """Block until every launched save has committed, then prune."""
@@ -138,17 +169,23 @@ class CheckpointManager:
             self._prune()
 
     def restore(self, state_target: Any = None, step: Optional[int] = None) -> Any:
+        t0 = time.perf_counter()
         self.wait()
         step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.root}")
         ckptr = self._checkpointer()
         path = self.root / f"step_{step}"
-        return (
+        out = (
             ckptr.restore(path, state_target)
             if state_target is not None
             else ckptr.restore(path)
         )
+        self._metrics["restore_ms"].labels("sharded").observe(
+            (time.perf_counter() - t0) * 1e3
+        )
+        self._metrics["restore_bytes"].labels("sharded").inc(tree_nbytes(out))
+        return out
 
     def close(self) -> None:
         if self._ckptr is not None:

@@ -133,26 +133,48 @@ def local_batches(
         yield jax.tree_util.tree_map(cut, batch)
 
 
+_EXHAUSTED = object()  # prefetch sentinel: next(it) default at stream end
+
+
 def prefetch_to_device(
     iterator: Iterable[Any],
     *,
     buffer_size: int = 2,
     sharding: Any = None,
     device: Any = None,
+    goodput: Any = None,
 ) -> Iterator[Any]:
     """Yield device-resident batches, keeping ``buffer_size`` in flight.
 
     Multi-process contract: ``iterator`` yields PROCESS-LOCAL rows (wrap
     a global-batch source with :func:`local_batches`); placement then
     assembles global arrays per :class:`DeviceFeed`.
+
+    ``goodput`` (a :class:`~unionml_tpu.goodput.GoodputTracker`, or any
+    object with a ``phase(name)`` context manager) attributes the feed's
+    wall time: pulling the host iterator lands in the ``data_wait``
+    bucket (host input starvation — the producer was not ready) and
+    :meth:`DeviceFeed.put` in ``host_to_device`` (the device_put
+    *dispatch*; the DMA itself overlaps compute, which is the point of
+    the prefetch — a transfer the compute had to wait on shows up as
+    compute time, not here).
     """
     feed = DeviceFeed(sharding=sharding, device=device)
     queue: collections.deque = collections.deque()
     it = iter(iterator)
 
     def enqueue(k: int) -> None:
-        for item in itertools.islice(it, k):
-            queue.append(feed.put(item))
+        if goodput is None:
+            for item in itertools.islice(it, k):
+                queue.append(feed.put(item))
+            return
+        for _ in range(k):
+            with goodput.phase("data_wait"):
+                item = next(it, _EXHAUSTED)
+            if item is _EXHAUSTED:
+                return
+            with goodput.phase("host_to_device"):
+                queue.append(feed.put(item))
 
     enqueue(buffer_size)
     while queue:

@@ -20,6 +20,7 @@ checkpoint-based restart a framework primitive:
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import numpy as np
@@ -27,10 +28,14 @@ import numpy as np
 from unionml_tpu._logging import logger
 from unionml_tpu.checkpoint.sharded import CheckpointManager
 from unionml_tpu.data.native import BatchLoader
+from unionml_tpu.goodput import phase_scope as _phase
 
 
 class Preemption(RuntimeError):
     """Raised by fault injectors to simulate a slice preemption."""
+
+
+_STREAM_END = object()  # next(it) default: exhaustion sentinel
 
 
 def run_elastic_trainer(
@@ -50,6 +55,7 @@ def run_elastic_trainer(
     donate_state: bool = True,
     accumulate_steps: int = 1,
     fault_hook: Optional[Callable[[int], None]] = None,
+    goodput: Any = None,
 ) -> Tuple[Any, int]:
     """Train with periodic checkpoints, resuming from the newest one.
 
@@ -86,6 +92,15 @@ def run_elastic_trainer(
 
     A final checkpoint is always written at exhaustion, so a finished
     stream run restores at its last step like an array run.
+
+    **Goodput accounting**: ``goodput=True`` (or a
+    :class:`~unionml_tpu.goodput.GoodputTracker`) attributes the
+    loop's wall time (docs/observability.md "Training goodput") —
+    jitted compute, ``data_wait`` on the batch source, ``checkpoint``
+    for the save stall on the critical path, and ``preemption`` for
+    the restore + replay cost of resuming after a kill: the price of
+    the preemption, measured, so "how much did that eviction cost us"
+    stops being a guess.
     """
     if (arrays is None) == (stream is None):
         raise ValueError("pass exactly one of arrays= or stream=")
@@ -94,6 +109,15 @@ def run_elastic_trainer(
     feed_rows = batch_size * accumulate_steps
     if accumulate_steps > 1 and sharding is not None:
         sharding = sharding.microbatched()
+    tracker = None
+    if goodput:
+        from unionml_tpu.goodput import GoodputTracker
+
+        tracker = (
+            goodput if isinstance(goodput, GoodputTracker) else GoodputTracker()
+        )
+        tracker.start()
+
     if sharding is not None:
         from unionml_tpu.parallel import compile_step
 
@@ -102,6 +126,18 @@ def run_elastic_trainer(
         from unionml_tpu.execution import _jitted
 
         step = _jitted(step_fn, donate_state)
+
+    if tracker is not None:
+        # compile-event detection on the jitted step (must wrap BEFORE
+        # the accumulation shim below — the shim is a plain callable the
+        # tracker could only observe opaquely): recompiles debit the
+        # goodput compute bucket into the `compile` badput cause
+        from unionml_tpu.introspection import ProgramTracker
+
+        step = ProgramTracker(
+            registry=tracker.registry, component="trainer",
+            on_compile=tracker.note_compile_ms,
+        ).wrap("trainer.elastic_step", step)
 
     if accumulate_steps > 1:
         from unionml_tpu.execution import to_microbatches
@@ -119,7 +155,7 @@ def run_elastic_trainer(
             step, state, stream,
             checkpoint_dir=checkpoint_dir, num_steps=num_steps,
             checkpoint_every=checkpoint_every, max_to_keep=max_to_keep,
-            fault_hook=fault_hook,
+            fault_hook=fault_hook, tracker=tracker,
         )
 
     loader = BatchLoader(
@@ -136,27 +172,47 @@ def run_elastic_trainer(
         )
     total_steps = steps_per_epoch * num_epochs
 
-    manager = CheckpointManager(checkpoint_dir, max_to_keep=max_to_keep)
+    # checkpoint I/O series belong in the same scrape as the goodput
+    # buckets they feed (a tracker with a private registry would
+    # otherwise watch unionml_checkpoint_save_ms accrue globally)
+    manager = CheckpointManager(
+        checkpoint_dir, max_to_keep=max_to_keep,
+        registry=tracker.registry if tracker is not None else None,
+    )
     global_step = 0
     resume_step = manager.latest_step()
     if resume_step is not None:
-        state = manager.restore(state, step=resume_step)
+        # resuming after a kill: the restore is preemption badput — the
+        # measured price of the eviction, not of checkpointing policy
+        with _phase(tracker, "preemption"):
+            state = manager.restore(state, step=resume_step)
         global_step = resume_step
         logger.info(f"elastic trainer: resuming from step {global_step}")
 
     single = len(arrays) == 1
     try:
         start_epoch, start_batch = divmod(global_step, steps_per_epoch)
-        for _epoch, _idx, batch in loader.epochs(
+        batches = iter(loader.epochs(
             num_epochs, start_epoch=start_epoch, start_batch=start_batch
-        ):
-            state, _metrics = step(state, batch[0] if single else batch)
+        ))
+        while True:
+            with _phase(tracker, "data_wait"):
+                item = next(batches, _STREAM_END)
+            if item is _STREAM_END:
+                break
+            _epoch, _idx, batch = item
+            t_step = time.perf_counter()
+            with _phase(tracker, "compute"):
+                state, _metrics = step(state, batch[0] if single else batch)
+            if tracker is not None:
+                tracker.step_complete(time.perf_counter() - t_step)
             global_step += 1
             if global_step % checkpoint_every == 0 or global_step == total_steps:
                 # async save: device->host snapshot happens before save()
                 # returns (so donation of state buffers by the next step is
                 # safe); the disk write overlaps the following steps
-                manager.save(global_step, state)
+                with _phase(tracker, "checkpoint"):
+                    manager.save(global_step, state)
             if fault_hook is not None:
                 fault_hook(global_step)
     finally:
@@ -164,7 +220,10 @@ def run_elastic_trainer(
         # a preemption mid-write leaves only an uncommitted tmp dir (orbax
         # renames atomically); close() waits for the final checkpoint to
         # commit and releases the async checkpointer's worker threads
-        manager.close()
+        with _phase(tracker, "checkpoint"):
+            manager.close()
+        if tracker is not None:
+            tracker.finish()
 
     logger.info(f"elastic trainer: finished at step {global_step}/{total_steps}")
     return state, global_step
@@ -180,19 +239,26 @@ def _run_stream(
     checkpoint_every: int,
     max_to_keep: int,
     fault_hook: Optional[Callable[[int], None]],
+    tracker: Any = None,
 ) -> Tuple[Any, int]:
     """Step-indexed resumable loop over a streaming batch source."""
     import inspect
 
-    manager = CheckpointManager(checkpoint_dir, max_to_keep=max_to_keep)
+    manager = CheckpointManager(
+        checkpoint_dir, max_to_keep=max_to_keep,
+        registry=tracker.registry if tracker is not None else None,
+    )
     global_step = 0
     resume_step = manager.latest_step()
     if resume_step is not None:
-        state = manager.restore(state, step=resume_step)
+        with _phase(tracker, "preemption"):
+            state = manager.restore(state, step=resume_step)
         global_step = resume_step
         logger.info(f"elastic trainer: resuming stream from step {global_step}")
     if num_steps is not None and global_step >= num_steps:
         manager.close()
+        if tracker is not None:
+            tracker.finish()
         return state, global_step
 
     params = inspect.signature(stream).parameters.values()
@@ -220,21 +286,35 @@ def _run_stream(
             )
     trained = 0
     try:
-        for batch in batches:
+        it = iter(batches)
+        exhausted = False
+        while True:
+            # replay skip: producing the already-consumed batches again
+            # is preemption badput, not data starvation
+            with _phase(tracker, "preemption" if skip else "data_wait"):
+                batch = next(it, _STREAM_END)
+            if batch is _STREAM_END:
+                exhausted = True
+                break
             if skip:
                 skip -= 1
                 continue
-            state, _metrics = step(state, batch)
+            t_step = time.perf_counter()
+            with _phase(tracker, "compute"):
+                state, _metrics = step(state, batch)
+            if tracker is not None:
+                tracker.step_complete(time.perf_counter() - t_step)
             global_step += 1
             trained += 1
             at_bound = num_steps is not None and global_step >= num_steps
             if global_step % checkpoint_every == 0 or at_bound:
-                manager.save(global_step, state)
+                with _phase(tracker, "checkpoint"):
+                    manager.save(global_step, state)
             if fault_hook is not None:
                 fault_hook(global_step)
             if at_bound:
                 break
-        else:
+        if exhausted:
             if skip:
                 # the replayed stream ended BEFORE the resume position:
                 # returning "finished" would silently bless a truncated or
@@ -249,9 +329,13 @@ def _run_stream(
             # — unless nothing ran since resume (the state is unchanged and
             # a terminal checkpoint for it already exists)
             if trained and global_step % checkpoint_every != 0:
-                manager.save(global_step, state)
+                with _phase(tracker, "checkpoint"):
+                    manager.save(global_step, state)
     finally:
-        manager.close()
+        with _phase(tracker, "checkpoint"):
+            manager.close()
+        if tracker is not None:
+            tracker.finish()
 
     logger.info(f"elastic trainer: stream finished at step {global_step}")
     return state, global_step

@@ -187,6 +187,9 @@ def run_step_trainer(
     accumulate_steps: int = 1,
     profile_dir: Optional[str] = None,
     registry: Optional[Any] = None,
+    goodput: Any = None,
+    measure_device_time: bool = False,
+    skew_every: int = 50,
 ) -> Any:
     """Synthesized trainer loop around a jittable per-batch step.
 
@@ -225,6 +228,26 @@ def run_step_trainer(
     ``unionml_trainer_hbm_bytes_in_use`` gauges from
     ``jax.Device.memory_stats()`` — the same registry the serving
     layers scrape through ``GET /metrics``.
+
+    ``measure_device_time=True`` adds a ``block_until_ready`` sync
+    point after EVERY step dispatch so ``unionml_trainer_step_ms``
+    samples real device step latency instead of host dispatch time
+    (async dispatch makes the default per-step sample an enqueue
+    measurement; only window boundaries force a readback). Opt-in: the
+    sync defeats dispatch pipelining, so expect a small throughput
+    cost — it exists for latency attribution, not production runs.
+
+    **Goodput accounting** (docs/observability.md "Training
+    goodput"): ``goodput=True`` (or a
+    :class:`~unionml_tpu.goodput.GoodputTracker` instance) attributes
+    the loop's wall time into compute vs. badput buckets — data-wait
+    and host→device dispatch in the prefetch feed, compile/recompile
+    (via the program tracker's compile events), jitted compute — and
+    publishes ``unionml_train_goodput_ratio`` /
+    ``unionml_train_badput_seconds_total{cause}``, per-phase trace
+    spans, the step-time regression detector, and (every
+    ``skew_every`` steps under ``jax.process_count() > 1``) per-host
+    step-skew gauges with straggler flight events.
     """
     import jax
 
@@ -331,8 +354,10 @@ def run_step_trainer(
     reg = registry if registry is not None else telemetry.get_registry()
     h_step = reg.histogram(
         "unionml_trainer_step_ms",
-        "Per-step host wall time (dispatch; window boundaries force a "
-        "data-dependent readback so windowed rates measure compute).",
+        "Per-step wall time. Default: host dispatch (async enqueue; "
+        "window boundaries force a data-dependent readback so windowed "
+        "rates measure compute). With measure_device_time= every step "
+        "syncs, so samples are real device step latency.",
     )
     g_loss = reg.gauge(
         "unionml_trainer_loss",
@@ -349,6 +374,17 @@ def run_step_trainer(
         "unionml_trainer_examples_total", "Training examples consumed.",
     )
 
+    from unionml_tpu.goodput import (
+        GoodputTracker, allgather_step_times, phase_scope,
+    )
+
+    tracker = None
+    if goodput:
+        tracker = (
+            goodput if isinstance(goodput, GoodputTracker)
+            else GoodputTracker(registry=reg)
+        )
+
     # program introspection (docs/observability.md): compile events on
     # the step record XLA cost-analysis flops/bytes + compile time, and
     # the unionml_program_mfu_ratio{component="trainer",
@@ -356,60 +392,98 @@ def run_step_trainer(
     # peak — the same scrape surface as the serving layers
     from unionml_tpu.introspection import ProgramTracker
 
-    step = ProgramTracker(registry=reg, component="trainer").wrap(
-        "trainer.step", step
-    )
+    step = ProgramTracker(
+        registry=reg, component="trainer",
+        on_compile=tracker.note_compile_ms if tracker is not None else None,
+    ).wrap("trainer.step", step)
 
     timer = StepTimer()
     steps = 0
     metrics = None
+    if tracker is not None:
+        tracker.start()
     ctx = trace(profile_dir) if profile_dir else contextlib.nullcontext()
-    with ctx:
-        for batch in prefetch_to_device(host_batches(), sharding=sharding):
-            t_step = time.perf_counter()
-            state, metrics = step(state, batch)
-            window_closed = timer.closes_window()
-            if window_closed:
-                # force a readback data-dependent on this step so the
-                # window measures compute, not async dispatch (step() only
-                # enqueues work; see BASELINE.md on tunnel timing)
-                leaves = jax.tree_util.tree_leaves(metrics)
-                if leaves:
-                    np.asarray(leaves[0])
-            # the sync above is part of step time; the publishes below
-            # are host-side bookkeeping and must not inflate the sample
-            h_step.observe((time.perf_counter() - t_step) * 1e3)
-            if window_closed:
-                # the window already synced: piggyback the loss/HBM
-                # publishes on it instead of adding readbacks per step
-                _publish_loss(metrics, g_loss)
-                publish_hbm_gauges(reg)
-            # actual leading dim (streamed batches may differ from batch_size);
-            # with accumulation the example count spans the two leading axes
-            rows = next(
-                (
-                    leaf.shape[0] * leaf.shape[1]
-                    if accumulate_steps > 1 and getattr(leaf, "ndim", 0) >= 2
-                    else leaf.shape[0]
-                    for leaf in jax.tree_util.tree_leaves(batch)
-                    if getattr(leaf, "ndim", 0) >= 1
-                ),
-                batch_size,
-            )
-            timer.tick(rows)
-            c_steps.inc()
-            c_examples.inc(rows)
-            if timer.rates:
-                g_rate.set(timer.rates[-1])
-            steps += 1
-    if steps:
-        jax.block_until_ready(state)
-        last = jax.tree_util.tree_map(lambda x: np.asarray(x).item() if np.ndim(x) == 0 else x, metrics)
-        _publish_loss(metrics, g_loss)
-        publish_hbm_gauges(reg)
-        rate = timer.summary().get("samples_per_sec_median")
-        if rate:
-            g_rate.set(rate)
-        suffix = f", ~{rate:.0f} samples/sec" if rate else ""
-        logger.info(f"step trainer: {steps} steps, final metrics: {last}{suffix}")
+    # finish() must run on the exception path too (mirrors elastic.py):
+    # a raising stream would otherwise leave the trainer trace timeline
+    # open forever, and a retry with the same tracker would count the
+    # crash-to-retry gap as unattributed wall time
+    try:
+        with ctx:
+            for batch in prefetch_to_device(
+                host_batches(), sharding=sharding, goodput=tracker
+            ):
+                t_step = time.perf_counter()
+                with phase_scope(tracker, "compute"):
+                    state, metrics = step(state, batch)
+                    window_closed = timer.closes_window()
+                    if measure_device_time:
+                        # opt-in sync point: the step_ms sample below then
+                        # measures real device latency, not host dispatch
+                        jax.block_until_ready((state, metrics))
+                    elif window_closed:
+                        # force a readback data-dependent on this step so the
+                        # window measures compute, not async dispatch (step()
+                        # only enqueues work; see BASELINE.md on tunnel timing)
+                        leaves = jax.tree_util.tree_leaves(metrics)
+                        if leaves:
+                            np.asarray(leaves[0])
+                # the sync above is part of step time; the publishes below
+                # are host-side bookkeeping and must not inflate the sample
+                step_s = time.perf_counter() - t_step
+                h_step.observe(step_s * 1e3)
+                if tracker is not None:
+                    # under async dispatch the window-boundary readback
+                    # drains a whole window of device work into this one
+                    # sample — not comparable to the dispatch-scale
+                    # baseline, so keep it out of the regression detector
+                    # (with measure_device_time every step syncs and all
+                    # samples are comparable)
+                    tracker.step_complete(
+                        step_s,
+                        detect=measure_device_time or not window_closed,
+                    )
+                    if skew_every > 0 and (steps + 1) % skew_every == 0:
+                        # multihost sync point only (process_count > 1):
+                        # single-host runs never pay a collective here
+                        times = allgather_step_times(step_s)
+                        if times is not None:
+                            tracker.record_step_skew(steps + 1, times)
+                if window_closed:
+                    # the window already synced: piggyback the loss/HBM
+                    # publishes on it instead of adding readbacks per step
+                    _publish_loss(metrics, g_loss)
+                    publish_hbm_gauges(reg)
+                # actual leading dim (streamed batches may differ from batch_size);
+                # with accumulation the example count spans the two leading axes
+                rows = next(
+                    (
+                        leaf.shape[0] * leaf.shape[1]
+                        if accumulate_steps > 1 and getattr(leaf, "ndim", 0) >= 2
+                        else leaf.shape[0]
+                        for leaf in jax.tree_util.tree_leaves(batch)
+                        if getattr(leaf, "ndim", 0) >= 1
+                    ),
+                    batch_size,
+                )
+                timer.tick(rows)
+                c_steps.inc()
+                c_examples.inc(rows)
+                if timer.rates:
+                    g_rate.set(timer.rates[-1])
+                steps += 1
+        if steps:
+            # the trailing drain is device compute still in flight
+            with phase_scope(tracker, "compute"):
+                jax.block_until_ready(state)
+            last = jax.tree_util.tree_map(lambda x: np.asarray(x).item() if np.ndim(x) == 0 else x, metrics)
+            _publish_loss(metrics, g_loss)
+            publish_hbm_gauges(reg)
+            rate = timer.summary().get("samples_per_sec_median")
+            if rate:
+                g_rate.set(rate)
+            suffix = f", ~{rate:.0f} samples/sec" if rate else ""
+            logger.info(f"step trainer: {steps} steps, final metrics: {last}{suffix}")
+    finally:
+        if tracker is not None:
+            tracker.finish()
     return state

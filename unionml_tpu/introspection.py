@@ -204,12 +204,19 @@ class ProgramTracker:
         registry: Optional[telemetry.MetricsRegistry] = None,
         component: str = "program",
         window_s: float = WINDOW_S,
+        on_compile: Optional[Callable[[str, float], None]] = None,
     ):
         self._registry = (
             registry if registry is not None else telemetry.get_registry()
         )
         self.component = component
         self.window_s = float(window_s)
+        # compile-event push seam: called as on_compile(key, call_ms)
+        # AFTER the compiling call returns — the goodput tracker
+        # (unionml_tpu.goodput) subscribes to debit compile time out of
+        # the compute bucket. Exceptions are swallowed: an observer bug
+        # must never fail the hot path.
+        self.on_compile = on_compile
         self._lock = threading.Lock()
         self._programs: Dict[str, _Program] = {}
         self._peaks: Optional[dict] = None
@@ -332,6 +339,11 @@ class ProgramTracker:
             prog.last_cost = cost
         prog.m_compiles.inc()
         prog.h_compile.observe(dt_ms)
+        if self.on_compile is not None:
+            try:
+                self.on_compile(prog.key, dt_ms)
+            except Exception:
+                pass
         self._account(prog, cost)
 
     def _on_call(self, prog: _Program, sig) -> None:

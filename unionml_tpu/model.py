@@ -304,6 +304,8 @@ class Model(TrackedInstance):
         checkpoint_dir: Optional[str] = None,
         save_every: int = 100,
         max_checkpoints: int = 3,
+        goodput: Any = None,
+        measure_device_time: bool = False,
         **train_task_kwargs,
     ):
         """Register a TPU-native, jittable per-batch training step.
@@ -321,6 +323,16 @@ class Model(TrackedInstance):
         ``accumulate_steps`` or
         :func:`unionml_tpu.models.train.accumulated_value_and_grad`).
         The HBM knob for effective batch at long context.
+
+        ``goodput``: training goodput accounting
+        (docs/observability.md "Training goodput") — ``True`` or a
+        :class:`unionml_tpu.goodput.GoodputTracker` attributes the
+        synthesized loop's wall time into compute vs. badput buckets
+        (data-wait, host→device, compile, checkpoint, preemption) on
+        both the plain and checkpointed routes;
+        ``measure_device_time=True`` adds a per-step sync so
+        ``unionml_trainer_step_ms`` samples real device latency
+        (plain route only — the elastic loop owns its own stepping).
 
         ``checkpoint_dir``: PREEMPTION SAFETY (SURVEY §5.3) — the
         synthesized trainer routes through
@@ -344,7 +356,9 @@ class Model(TrackedInstance):
                 f, sharding=sharding, donate_state=donate_state,
                 accumulate_steps=accumulate_steps,
                 checkpoint_dir=checkpoint_dir, save_every=save_every,
-                max_checkpoints=max_checkpoints, **train_task_kwargs
+                max_checkpoints=max_checkpoints, goodput=goodput,
+                measure_device_time=measure_device_time,
+                **train_task_kwargs
             )
         type_guards.guard_train_step(fn)
         self._train_step = fn
@@ -355,6 +369,8 @@ class Model(TrackedInstance):
             "checkpoint_dir": checkpoint_dir,
             "save_every": save_every,
             "max_checkpoints": max_checkpoints,
+            "goodput": goodput,
+            "measure_device_time": measure_device_time,
         }
         self._trainer = self._make_step_trainer()
         self._train_task_kwargs = {"resources": DEFAULT_DEVICE_RESOURCES, **train_task_kwargs}
@@ -399,6 +415,7 @@ class Model(TrackedInstance):
                     sharding=opts.get("sharding"),
                     donate_state=opts.get("donate_state", True),
                     accumulate_steps=opts.get("accumulate_steps", 1),
+                    goodput=opts.get("goodput"),
                 )
                 if is_stream(features):
                     # resumable streams must be SEEKABLE or REPLAYABLE
@@ -449,6 +466,8 @@ class Model(TrackedInstance):
                 sharding=opts.get("sharding"),
                 donate_state=opts.get("donate_state", True),
                 accumulate_steps=opts.get("accumulate_steps", 1),
+                goodput=opts.get("goodput"),
+                measure_device_time=opts.get("measure_device_time", False),
             )
 
         trainer.__name__ = "synthesized_step_trainer"
