@@ -30,6 +30,13 @@ Usage::
     #   FIXED HBM byte budget under a long-tail prompt mix — effective
     #   max batch ratio (target >= 1.5x), decode tokens/s at equal
     #   batch, token parity asserted (docs/performance.md)
+    UNIONML_TPU_BENCH_PRESET=serve_usage python benchmarks/serve_latency.py
+    # ^ per-tenant usage metering: attribution identity (per-tenant
+    #   attributed device-seconds + tokens explain >= 95% of engine
+    #   totals under a mixed 3-tenant stream), exported tenant-label
+    #   cardinality <= top_k + 1 under a 40-distinct-tenant burst, and
+    #   ledger-on vs -off p99 overhead <= 2% at token parity
+    #   (docs/observability.md "Usage metering & cost attribution")
 """
 
 from __future__ import annotations
@@ -985,6 +992,294 @@ def paged_leg() -> None:
         }))
 
 
+def usage_leg() -> None:
+    """Per-tenant usage metering: attribution identity, cardinality
+    bound, and ledger overhead
+    (``UNIONML_TPU_BENCH_PRESET=serve_usage``).
+
+    Phase 1 — **attribution identity + cardinality**: a mixed 3-tenant
+    stream (interleaved concurrent clients, uneven request counts)
+    through a ledger-on engine. Asserts per-tenant attributed
+    device-seconds and tokens each explain >= 95% of the engine totals
+    (the measurement-substrate contract fair scheduling will build on),
+    then fires a burst of 40 distinct one-request tenants and asserts
+    the exported ``unionml_tenant_*`` label cardinality stays
+    <= top_k + 1 (the ``other`` rollup absorbing the tail).
+
+    Phase 2 — **overhead at token parity**: the same prompts through
+    ONE engine with the ledger toggled on/off between rounds (the
+    ``engine.usage`` idle-swap seam), tokens asserted bit-identical,
+    per-request p99 delta asserted <= 2%. The estimator is built for a
+    2% bar on a millisecond-scale CPU workload (the goodput bench's
+    overhead-leg lessons, adapted):
+
+    - BOTH legs run on the SAME engine instance — two separately-
+      constructed engines differ by several percent (p50 included)
+      from thread/allocator placement alone, a persistent instance
+      bias that min-over-rounds cannot wash out; toggling the seam
+      leaves only the ledger's own cost in the delta,
+    - the stream is SEQUENTIAL — per-request p99 under 4 GIL-bound
+      client threads differs +-5% between two IDENTICAL ledger-off
+      engines (scheduler tails), swamping the bar; concurrency belongs
+      to phase 1's attribution identity, the overhead question is
+      per-request cost,
+    - legs are paired PER REQUEST (each request runs ledger-off and
+      ledger-on back-to-back, order alternating by round+index), not
+      per pass — the host's minute-scale drift moves whole sequential
+      passes by +-2%, which leg-level alternation leaves on one leg
+      but a milliseconds-apart pair cancels,
+    - per-request MIN over rounds, then nearest-rank p99 across
+      requests, UNROUNDED (``percentile_summary`` rounds to 0.1 ms =
+      2% of this workload): the min discards scheduler outliers per
+      request the way interleaved min-of-N discards bad rounds, while
+      the p99 across requests keeps the workload's own tail,
+    - gc paused over the timed rounds (a collection mid-round lands a
+      ~30 ms outlier on whichever leg happens to be running).
+    """
+    import gc
+    import threading
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu import telemetry
+    from unionml_tpu.models import Llama, LlamaConfig
+    from unionml_tpu.serving.engine import DecodeEngine
+    from unionml_tpu.serving.usage import UsageLedger, tenant_scope
+
+    backend = jax.default_backend()
+    if backend == "cpu":
+        cfg = serving_config("tiny")
+        module = Llama(cfg)
+        tokens0 = jnp.zeros((1, 8), jnp.int32)
+        params = jax.jit(module.init)(jax.random.PRNGKey(0), tokens0)["params"]
+        n_req, new_tokens, bucket, slots, chunk_steps = 48, 8, 16, 4, 4
+        rounds = 6
+    else:
+        cfg = serving_config("serve_1p5b")
+        qcfg = LlamaConfig(**{**cfg.__dict__, "quantized": True})
+        module = Llama(qcfg)
+        params = random_quantized_params(module)
+        n_req, new_tokens, bucket, slots, chunk_steps = 128, 32, 64, 8, 8
+        rounds = 4
+    top_k = 4
+    burst_tenants = 40
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, bucket // 2).tolist()
+        for _ in range(n_req)
+    ]
+    # uneven tenant mix: tenant-a 3/6, tenant-b 2/6, tenant-c 1/6
+    mix = ("tenant-a", "tenant-a", "tenant-a", "tenant-b", "tenant-b",
+           "tenant-c")
+    tenants = [mix[i % len(mix)] for i in range(n_req)]
+
+    def run_stream(engine, traced_tenants):
+        """Serve the stream with `clients` concurrent workers, each
+        request under its tenant's scope; outputs index-aligned."""
+        clients = 4
+        outs = [None] * n_req
+
+        def client(idx0):
+            for i in range(idx0, n_req, clients):
+                with tenant_scope(traced_tenants[i]):
+                    out = engine.generate(params, [prompts[i]])
+                outs[i] = out[0]
+
+        threads = [
+            threading.Thread(target=client, args=(c,))
+            for c in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return outs
+
+    # ---- phase 1: attribution identity + cardinality bound ----
+    registry = telemetry.MetricsRegistry()
+    ledger = UsageLedger(registry=registry, top_k=top_k)
+    engine = DecodeEngine(
+        module, slots=slots, max_new_tokens=new_tokens,
+        prompt_buckets=(bucket,), chunk_steps=chunk_steps,
+        registry=registry, tracer=telemetry.TraceRecorder(),
+        flight=telemetry.FlightRecorder(), usage=ledger,
+    )
+    try:
+        engine.warmup(params)
+        engine.reset_stats()
+        run_stream(engine, tenants)
+        report = ledger.report()
+        per_tenant = report["tenants"]
+        attributed_s = report["attribution"]["attributed_device_seconds"]
+        attributed_tok = report["attribution"]["attributed_tokens"]
+        totals = report["totals"]
+        s_cov = report["attribution"]["device_seconds_coverage"]
+        t_cov = report["attribution"]["token_coverage"]
+        print(json.dumps({
+            "metric": "serve_usage_attribution",
+            "requests": n_req,
+            "tenants": {
+                t: {
+                    "device_seconds": v["device_seconds"],
+                    "decode_tokens": v["decode_tokens"],
+                    "requests": v["requests"],
+                }
+                for t, v in per_tenant.items()
+            },
+            "total_device_seconds": totals["device_seconds"],
+            "total_tokens": totals["tokens"],
+            "attributed_device_seconds": attributed_s,
+            "attributed_tokens": attributed_tok,
+            "value": s_cov,
+            "token_coverage": t_cov,
+            "capacity_headroom": report["capacity"]["headroom"],
+            "unit": "coverage ratio",
+        }))
+        assert s_cov >= 0.95, (
+            f"attributed device-seconds cover only {s_cov:.3f} of "
+            "engine totals (bar: 0.95)"
+        )
+        assert t_cov >= 0.95, (
+            f"attributed tokens cover only {t_cov:.3f} of engine "
+            "totals (bar: 0.95)"
+        )
+        # cardinality: a burst of distinct one-request tenants must
+        # roll into `other`, not mint series
+        for i in range(burst_tenants):
+            with tenant_scope(f"burst-{i}"):
+                engine.generate(params, [prompts[i % n_req]])
+        text = registry.exposition()
+        label_values = set()
+        for line in text.splitlines():
+            if line.startswith("unionml_tenant_") and 'tenant="' in line:
+                label_values.add(
+                    line.split('tenant="', 1)[1].split('"', 1)[0]
+                )
+        print(json.dumps({
+            "metric": "serve_usage_cardinality",
+            "distinct_tenants": ledger.report()["distinct_tenants"],
+            "top_k": top_k,
+            "exported_tenant_labels": sorted(label_values),
+            "value": len(label_values),
+            "unit": "label values",
+        }))
+        assert len(label_values) <= top_k + 1, (
+            f"exported tenant-label cardinality {len(label_values)} "
+            f"exceeds top_k + 1 = {top_k + 1}: {sorted(label_values)}"
+        )
+    finally:
+        engine.close()
+
+    # ---- phase 2: overhead at token parity (sequential paired rounds,
+    # alternating leg order, per-request min, unrounded p99) ----
+    # per-request base doubled on CPU so the ledger's ~10 us/chunk and
+    # the timer/scheduler jitter are small FRACTIONS of every sample
+    p2_new_tokens = new_tokens * 2 if backend == "cpu" else new_tokens
+    # sample sizes sized for the nearest-rank p99 of per-request MINs:
+    # at n=48 that rank IS the maximum, so one request unlucky in every
+    # round decides the stat — >=120 requests drop the single worst,
+    # and 10 rounds tighten each request's min (an outlier must recur
+    # in ALL rounds to survive)
+    p2_n_req, p2_rounds = (120, 10) if backend == "cpu" else (128, rounds)
+    p2_prompts = [
+        rng.integers(1, cfg.vocab_size, bucket // 2).tolist()
+        for _ in range(p2_n_req)
+    ]
+    p2_tenants = [mix[i % len(mix)] for i in range(p2_n_req)]
+    # ONE engine for both legs, toggling the off-switch seam between
+    # rounds (swapped only while idle): two separately-constructed
+    # engines differ by several percent — p50 included — from thread/
+    # allocator placement alone on this host, a persistent instance
+    # bias that per-request min-over-rounds cannot wash out because
+    # every round of the slow leg runs on the slow instance. The
+    # attribution window is clamped at dispatch time, so the off-leg's
+    # idle gap never inflates the first on-leg window.
+    registry = telemetry.MetricsRegistry()
+    p2_engine = DecodeEngine(
+        module, slots=slots, max_new_tokens=p2_new_tokens,
+        prompt_buckets=(bucket,), chunk_steps=chunk_steps,
+        registry=registry, tracer=telemetry.TraceRecorder(),
+        flight=telemetry.FlightRecorder(), usage=None,
+    )
+    p2_ledger = UsageLedger(registry=registry)
+
+    try:
+        p2_engine.warmup(params)
+        p2_engine.reset_stats()
+        per_req = {m: [[] for _ in range(p2_n_req)] for m in (False, True)}
+        outs = {m: [None] * p2_n_req for m in (False, True)}
+        gc.collect()
+        gc.disable()
+        try:
+            for r in range(p2_rounds):
+                for i in range(p2_n_req):
+                    # request-level pairing: each request runs BOTH
+                    # legs back-to-back (~ms apart, order alternating
+                    # by round+index), so the host's minute-scale
+                    # drift — which moved whole leg-level passes by
+                    # +-2% and swamped the bar — cancels within the
+                    # pair instead of landing on one leg
+                    legs = (
+                        (False, True) if (r + i) % 2 == 0
+                        else (True, False)
+                    )
+                    for metered in legs:
+                        p2_engine.usage = p2_ledger if metered else None
+                        t0 = time.perf_counter()
+                        with tenant_scope(p2_tenants[i]):
+                            out = p2_engine.generate(
+                                params, [p2_prompts[i]]
+                            )
+                        per_req[metered][i].append(
+                            (time.perf_counter() - t0) * 1e3
+                        )
+                        outs[metered][i] = out[0]
+        finally:
+            p2_engine.usage = None
+            gc.enable()
+        assert outs[False] == outs[True], (
+            "usage metering changed produced tokens — parity violation"
+        )
+
+        def tail_p99(metered: bool) -> float:
+            best = sorted(min(vs) for vs in per_req[metered])
+            return best[max(0, math.ceil(0.99 * len(best)) - 1)]
+
+        off_p99, on_p99 = tail_p99(False), tail_p99(True)
+        overhead_pct = 100.0 * (on_p99 - off_p99) / max(off_p99, 1e-9)
+        for metered in (False, True):
+            best = [min(vs) for vs in per_req[metered]]
+            print(json.dumps({
+                "metric": "serve_usage_latency_p99_ms",
+                "metered": metered,
+                "requests": p2_n_req,
+                "rounds": p2_rounds,
+                "new_tokens": p2_new_tokens,
+                "protocol": "sequential, per-request paired legs, "
+                            "min-per-request over rounds",
+                "value": round(tail_p99(metered), 3),
+                "p50_ms": round(sorted(best)[len(best) // 2], 3),
+                "unit": "ms",
+            }))
+        print(json.dumps({
+            "metric": "serve_usage_summary",
+            "tokens_identical": True,
+            "value": round(overhead_pct, 2),
+            "unit": "pct p99 overhead",
+        }))
+        assert overhead_pct <= 2.0, (
+            f"usage-ledger p99 overhead {overhead_pct:.2f}% exceeds "
+            "the 2% bar"
+        )
+    finally:
+        p2_engine.close()
+
+
 def overload_leg() -> None:
     """Admission control + supervised recovery under saturation
     (``UNIONML_TPU_BENCH_PRESET=serve_overload``).
@@ -1173,6 +1468,17 @@ if __name__ == "__main__":
                 "workload is hardcoded in paged_leg"
             )
         paged_leg()
+    elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_usage":
+        if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
+            os.environ.get("UNIONML_TPU_BENCH_PREFIX")
+        ):
+            # hardcoded workload, same rule as the other engine legs
+            raise SystemExit(
+                "UNIONML_TPU_BENCH_PRESET=serve_usage takes no CLI "
+                f"flags or KV/PREFIX env legs (got {sys.argv[1:]}); its "
+                "workload is hardcoded in usage_leg"
+            )
+        usage_leg()
     elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_overload":
         if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
             os.environ.get("UNIONML_TPU_BENCH_PREFIX")

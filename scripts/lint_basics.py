@@ -27,6 +27,16 @@ richer gate where installed):
   bypasses the allocator and silently breaks the paged layout. Route
   through the block-table API (``kv_pool.py`` + the engine's
   scatter/extract programs) instead.
+- label-cardinality guard (repo-wide, when the default paths are
+  linted): any ``unionml_*`` metric registered under ``unionml_tpu/``
+  whose label schema contains a **request-derived** label name
+  (:data:`REQUEST_DERIVED_LABELS` — tenant/rid/request ids) must live
+  in the usage ledger module (:data:`REQUEST_LABEL_EXEMPT`), whose
+  top-K rollup bounds the label's value set. Anywhere else, a
+  request-derived label means unbounded series cardinality the moment
+  a client controls the value — route the increment through
+  ``UsageLedger.label_for`` instead (docs/observability.md "Usage
+  metering & cost attribution").
 - metrics-doc drift (repo-wide, when the default paths are linted):
   every ``unionml_*`` metric registered under ``unionml_tpu/`` must be
   documented in ``docs/observability.md``, and every full metric name
@@ -269,6 +279,80 @@ _DOC_METRIC_RE = re.compile(r"\bunionml(?:_[a-z0-9]+){2,}\b")
 _SERIES_SUFFIXES = ("_bucket", "_sum", "_count")
 
 
+# request-derived label names: a client-controlled value minted into a
+# label is unbounded cardinality — only the usage ledger's bounded
+# top-K rollup may own such labels
+REQUEST_DERIVED_LABELS = (
+    "tenant", "rid", "request_id", "user", "user_id", "client",
+    "client_id",
+)
+REQUEST_LABEL_EXEMPT = ("unionml_tpu/serving/usage.py",)
+
+
+def _call_labelnames(node: ast.Call):
+    """Constant label names of a metric registration call: the third
+    positional arg or the ``labelnames`` kwarg, when it is a literal
+    tuple/list of strings (the codebase's only registration idiom)."""
+    label_arg = node.args[2] if len(node.args) >= 3 else None
+    for kw in node.keywords:
+        if kw.arg == "labelnames":
+            label_arg = kw.value
+    if not isinstance(label_arg, (ast.Tuple, ast.List)):
+        return ()
+    return tuple(
+        e.value for e in label_arg.elts
+        if isinstance(e, ast.Constant) and isinstance(e.value, str)
+    )
+
+
+def check_label_cardinality(package_root: Path) -> list:
+    """Every ``unionml_*`` registration whose label schema contains a
+    request-derived name must live in the ledger module — the single
+    home of the bounded rollup that keeps such labels finite."""
+    problems = []
+    for path in sorted(package_root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        try:
+            rel = path.resolve().relative_to(ROOT).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        if rel in REQUEST_LABEL_EXEMPT:
+            continue
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"))
+        except SyntaxError:
+            continue  # reported by the per-file checker
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            func = node.func
+            factory = (
+                func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else None
+            )
+            if factory not in _METRIC_FACTORIES or not (
+                isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("unionml_")
+            ):
+                continue
+            bad = [
+                label for label in _call_labelnames(node)
+                if label in REQUEST_DERIVED_LABELS
+            ]
+            if bad:
+                problems.append(
+                    f"{path}:{node.lineno}: metric "
+                    f"{node.args[0].value} takes request-derived "
+                    f"label(s) {bad} outside the usage ledger — route "
+                    "through UsageLedger's bounded top-K rollup "
+                    "(unionml_tpu/serving/usage.py) so a client cannot "
+                    "mint unbounded series"
+                )
+    return problems
+
+
 def registered_metric_names(package_root: Path) -> dict:
     """``{metric_name: "file:line"}`` for every ``unionml_*`` metric
     registered under the package (AST walk: the first string argument
@@ -347,9 +431,10 @@ def main(argv) -> int:
             continue
         problems.extend(check_file(f))
     if paths is DEFAULT_PATHS or "unionml_tpu" in paths:
-        # repo-wide contract, meaningful only when the package is in
+        # repo-wide contracts, meaningful only when the package is in
         # scope (a single-file lint must not fail on doc drift)
         problems.extend(check_metrics_doc(ROOT))
+        problems.extend(check_label_cardinality(ROOT / "unionml_tpu"))
     for p in problems:
         print(p)
     print(f"lint_basics: {len(files)} files, {len(problems)} problem(s)")

@@ -1,0 +1,795 @@
+"""Per-tenant usage metering tests (docs/observability.md "Usage
+metering & cost attribution").
+
+The contract under test: the ledger's per-tenant attributed
+device-seconds and tokens explain >= 95% of engine totals under a
+mixed multi-tenant stream (the attribution identity), exported
+tenant-label cardinality is bounded by top_k + 1 no matter how many
+distinct tenants appear, prefix-cache savings are credited to the
+LEASING tenant, KV block-second hold windows close on abandon and
+recovery, the tenant header round-trips through all three transports
+(with a 422 boundary for hostile values), and the whole subsystem is
+an off-switch away from zero overhead.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu import telemetry
+from unionml_tpu.models import Llama, LlamaConfig
+from unionml_tpu.serving.engine import DecodeEngine
+from unionml_tpu.serving.faults import FaultInjector, xla_oom_error
+from unionml_tpu.serving.prefix_cache import RadixPrefixCache
+from unionml_tpu.serving.usage import (
+    DEFAULT_TENANT,
+    OTHER_TENANT,
+    UsageLedger,
+    current_tenant,
+    tenant_scope,
+    validate_tenant,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    cfg = LlamaConfig.tiny(vocab_size=97)
+    module = Llama(cfg)
+    params = module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return module, params
+
+
+@pytest.fixture
+def trained_model(model):
+    model.train(
+        hyperparameters={"max_iter": 500}, sample_frac=1.0, random_state=123
+    )
+    return model
+
+
+def _tenant_labels(registry):
+    """Distinct tenant= label values across every exported
+    unionml_tenant_* series (the cardinality the rollup bounds)."""
+    values = set()
+    for line in registry.exposition().splitlines():
+        if line.startswith("unionml_tenant_") and 'tenant="' in line:
+            values.add(line.split('tenant="', 1)[1].split('"', 1)[0])
+    return values
+
+
+# ---------------------------------------------------------------- unit
+
+
+def test_validate_tenant_defaults_and_limits():
+    assert validate_tenant(None) == DEFAULT_TENANT
+    assert validate_tenant("") == DEFAULT_TENANT
+    assert validate_tenant("acme-prod") == "acme-prod"
+    assert validate_tenant("x" * 64) == "x" * 64
+    with pytest.raises(ValueError, match="longer than 64"):
+        validate_tenant("x" * 65)
+    with pytest.raises(ValueError, match="non-printable"):
+        validate_tenant("a\x00b")
+    with pytest.raises(ValueError, match="non-printable"):
+        validate_tenant("a\nb")
+
+
+def test_tenant_scope_nesting_and_default():
+    assert current_tenant() == DEFAULT_TENANT
+    with tenant_scope("outer"):
+        assert current_tenant() == "outer"
+        with tenant_scope("inner"):
+            assert current_tenant() == "inner"
+        assert current_tenant() == "outer"
+        with tenant_scope(None):  # no-op scope: outer stays visible
+            assert current_tenant() == "outer"
+    assert current_tenant() == DEFAULT_TENANT
+
+
+def test_rollup_topk_other_bounds():
+    registry = telemetry.MetricsRegistry()
+    ledger = UsageLedger(registry=registry, top_k=2)
+    for tenant in ("a", "b", "c", "d", "other"):
+        ledger.finish_request(tenant, queue_ms=1.0)
+    # sticky slots for the first two; everyone else (and a tenant
+    # literally named "other") rolls up
+    assert ledger.label_for("a") == "a"
+    assert ledger.label_for("b") == "b"
+    assert ledger.label_for("c") == OTHER_TENANT
+    assert ledger.label_for("other") == OTHER_TENANT
+    labels = _tenant_labels(registry)
+    assert labels == {"a", "b", OTHER_TENANT}
+    assert len(labels) <= ledger.top_k + 1
+    report = ledger.report()
+    assert report["distinct_tenants"] == 5
+    # exact vectors are still per-tenant (JSON, not label values)
+    assert set(report["tenants"]) == {"a", "b", "c", "d", "other"}
+
+
+def test_attribute_splits_by_token_share():
+    registry = telemetry.MetricsRegistry()
+    ledger = UsageLedger(registry=registry, top_k=4)
+    ledger.attribute(
+        {"a": 3, "b": 1}, device_s=4.0, flops=8.0, slot_steps=8.0
+    )
+    report = ledger.report()
+    assert report["tenants"]["a"]["device_seconds"] == pytest.approx(3.0)
+    assert report["tenants"]["b"]["device_seconds"] == pytest.approx(1.0)
+    assert report["tenants"]["a"]["flops"] == pytest.approx(6.0)
+    assert report["tenants"]["b"]["flops"] == pytest.approx(2.0)
+    assert report["tenants"]["a"]["decode_tokens"] == 3
+    assert report["totals"]["device_seconds"] == pytest.approx(4.0)
+    assert report["attribution"]["device_seconds_coverage"] == 1.0
+    # an ownerless dispatch still counts toward the totals (the honest
+    # identity denominator), attributed to nobody
+    ledger.attribute({}, device_s=1.0)
+    report = ledger.report()
+    assert report["totals"]["device_seconds"] == pytest.approx(5.0)
+    assert report["attribution"]["device_seconds_coverage"] == pytest.approx(
+        4.0 / 5.0
+    )
+
+
+def test_capacity_headroom_estimate():
+    ledger = UsageLedger(registry=telemetry.MetricsRegistry(), top_k=4)
+    ledger.attribute({"a": 6, "b": 2}, device_s=1.0, slot_steps=16.0)
+    cap = ledger.report()["capacity"]
+    assert cap["slot_steps"] == 16.0
+    assert cap["per_tenant"]["a"] == pytest.approx(6 / 16)
+    assert cap["per_tenant"]["b"] == pytest.approx(2 / 16)
+    assert cap["headroom"] == pytest.approx(0.5)
+
+
+def test_drop_causes_are_a_closed_label_set():
+    registry = telemetry.MetricsRegistry()
+    ledger = UsageLedger(registry=registry, top_k=4)
+    ledger.record_drop("a", "abandoned")
+    ledger.record_drop("a", "SomeExoticException")  # free-form -> error
+    text = registry.exposition()
+    assert 'cause="abandoned"' in text
+    assert 'cause="error"' in text
+    assert "SomeExoticException" not in text
+
+
+def test_reset_keeps_label_slots_sticky():
+    registry = telemetry.MetricsRegistry()
+    ledger = UsageLedger(registry=registry, top_k=1)
+    ledger.finish_request("a")
+    ledger.reset_stats()
+    assert ledger.report()["tenants"] == {}
+    # the slot survives the reset: a new tenant still rolls up, so the
+    # exported series stay monotonic per label value
+    ledger.finish_request("b")
+    assert ledger.label_for("a") == "a"
+    assert ledger.label_for("b") == OTHER_TENANT
+
+
+def test_max_tenants_overflow_accumulates_into_other():
+    ledger = UsageLedger(
+        registry=telemetry.MetricsRegistry(), top_k=1, max_tenants=1
+    )
+    ledger.finish_request("a")
+    ledger.finish_request("b")
+    report = ledger.report()
+    assert set(report["tenants"]) == {"a"}
+    assert report["other"]["requests"] == 1
+
+
+def test_max_tenants_bounds_remembered_ids():
+    """A client minting a fresh (valid) tenant id per request must not
+    grow host memory or the debug body: past max_tenants, unseen ids
+    resolve to `other` without being remembered anywhere."""
+    registry = telemetry.MetricsRegistry()
+    ledger = UsageLedger(registry=registry, top_k=2, max_tenants=4)
+    for i in range(50):
+        tenant = f"hostile-{i}"
+        ledger.finish_request(tenant)
+        ledger.attribute({tenant: 1}, device_s=0.01, slot_steps=2.0)
+    report = ledger.report()
+    assert len(ledger._labels) <= 4
+    assert len(report["tenants"]) <= 4
+    assert len(report["capacity"]["per_tenant"]) <= 4 + 1  # + other key
+    assert report["distinct_tenants"] <= 4  # saturates at the bound
+    # usage past the cap still lands in the `other` vector + label
+    assert report["other"]["requests"] == 46
+    assert _tenant_labels(registry) <= {
+        "hostile-0", "hostile-1", OTHER_TENANT,
+    }
+
+
+def test_capacity_gauge_sums_rolled_up_tenants():
+    """Several tenants sharing the `other` label must SUM into the
+    capacity-fraction gauge, not overwrite each other."""
+    registry = telemetry.MetricsRegistry()
+    ledger = UsageLedger(registry=registry, top_k=1)
+    ledger.attribute(
+        {"a": 100, "b": 50, "c": 10}, device_s=1.0, slot_steps=200.0
+    )
+    ledger.report()  # refreshes the gauges
+    frac = {}
+    for line in registry.exposition().splitlines():
+        if line.startswith("unionml_tenant_capacity_fraction{"):
+            label = line.split('tenant="', 1)[1].split('"', 1)[0]
+            frac[label] = float(line.rsplit(" ", 1)[1])
+    assert frac["a"] == pytest.approx(0.5)
+    # b (0.25) and c (0.05) share `other`: the gauge carries their sum
+    assert frac[OTHER_TENANT] == pytest.approx(0.3)
+
+
+def test_capacity_counts_only_capacity_bearing_dispatches():
+    """Prefill harvests and batcher rows pass slot_steps=0 — they are
+    not decode capacity, so they must not inflate used slot-steps."""
+    ledger = UsageLedger(registry=telemetry.MetricsRegistry(), top_k=4)
+    ledger.attribute({"a": 1}, device_s=0.5)          # prefill-style
+    ledger.attribute({"a": 4}, device_s=1.0, slot_steps=8.0)
+    cap = ledger.report()["capacity"]
+    assert cap["per_tenant"]["a"] == pytest.approx(4 / 8)
+    assert cap["headroom"] == pytest.approx(0.5)
+
+
+def test_lint_guard_flags_request_derived_labels(tmp_path):
+    """The label-cardinality guard: a unionml_* metric taking a
+    tenant/rid label OUTSIDE the ledger module fails lint."""
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_basics",
+        Path(__file__).resolve().parents[2] / "scripts" / "lint_basics.py",
+    )
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    pkg = tmp_path / "unionml_tpu"
+    pkg.mkdir()
+    (pkg / "rogue.py").write_text(
+        'R.counter("unionml_rogue_total", "x", ("engine", "tenant"))\n'
+    )
+    problems = lint.check_label_cardinality(pkg)
+    assert len(problems) == 1 and "tenant" in problems[0]
+    (pkg / "clean.py").write_text(
+        'R.counter("unionml_ok_total", "x", ("engine", "reason"))\n'
+    )
+    assert len(lint.check_label_cardinality(pkg)) == 1  # clean file ok
+    # the real ledger module is exempt (and the repo itself is clean)
+    repo_pkg = Path(lint.ROOT) / "unionml_tpu"
+    assert lint.check_label_cardinality(repo_pkg) == []
+
+
+# ---------------------------------------------------- engine integration
+
+
+def test_attribution_identity_mixed_three_tenant_stream(tiny_llama):
+    """The acceptance identity: per-tenant attributed device-seconds
+    and tokens sum to >= 95% of engine totals under a concurrent
+    3-tenant stream with an uneven mix."""
+    module, params = tiny_llama
+    registry = telemetry.MetricsRegistry()
+    ledger = UsageLedger(registry=registry, top_k=8)
+    engine = DecodeEngine(
+        module, slots=4, max_new_tokens=8, prompt_buckets=(16,),
+        chunk_steps=4, registry=registry,
+        tracer=telemetry.TraceRecorder(), usage=ledger,
+    )
+    try:
+        engine.warmup(params)
+        engine.reset_stats()
+        rng = np.random.default_rng(0)
+        mix = ["a", "a", "a", "b", "b", "c"]
+        n_req = 24
+        prompts = [rng.integers(1, 97, 8).tolist() for _ in range(n_req)]
+
+        def client(idx0):
+            for i in range(idx0, n_req, 4):
+                with tenant_scope(mix[i % len(mix)]):
+                    engine.generate(params, [prompts[i]])
+
+        threads = [
+            threading.Thread(target=client, args=(c,)) for c in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        report = ledger.report()
+        # every request ran to its token budget (no eos): exact counts
+        counts = {t: mix.count(t) * n_req // len(mix) for t in "abc"}
+        for tenant, n in counts.items():
+            vec = report["tenants"][tenant]
+            assert vec["requests"] == n
+            assert vec["decode_tokens"] == n * 8
+            assert vec["device_seconds"] > 0
+            assert vec["queue_ms"] >= 0
+        assert report["attribution"]["device_seconds_coverage"] >= 0.95
+        assert report["attribution"]["token_coverage"] >= 0.95
+        assert report["totals"]["tokens"] == n_req * 8
+        # flops attribution follows the tracker's cost analysis
+        assert report["tenants"]["a"]["flops"] > 0
+        # engine stats carry the compact view
+        assert engine.stats()["usage"]["attribution"][
+            "token_coverage"
+        ] >= 0.95
+    finally:
+        engine.close()
+
+
+def test_usage_off_switch_token_parity(tiny_llama):
+    """usage=None (the default): no tenant series, no usage stats
+    section, and bit-identical tokens to a metered engine."""
+    module, params = tiny_llama
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, 97, 6).tolist() for _ in range(4)]
+    outs = {}
+    for metered in (False, True):
+        registry = telemetry.MetricsRegistry()
+        engine = DecodeEngine(
+            module, slots=2, max_new_tokens=6, prompt_buckets=(8,),
+            chunk_steps=2, registry=registry,
+            tracer=telemetry.TraceRecorder(),
+            usage=True if metered else None,
+        )
+        try:
+            with tenant_scope("acme"):
+                outs[metered] = engine.generate(params, prompts)
+            text = registry.exposition()
+            stats = engine.stats()
+            if metered:
+                assert "unionml_tenant_requests_total" in text
+                assert stats["usage"]["distinct_tenants"] >= 1
+                assert engine.usage is not None
+            else:
+                assert "unionml_tenant_" not in text
+                assert "usage" not in stats
+                assert engine.usage is None
+        finally:
+            engine.close()
+    assert outs[False] == outs[True]
+
+
+def test_usage_setter_toggles_metering_on_idle_engine(tiny_llama):
+    """The ``engine.usage`` idle-swap seam (the serve_usage bench runs
+    both overhead legs on ONE engine through it): toggling the ledger
+    on meters exactly the requests served while attached, toggling it
+    off stops accrual, and tokens are identical across toggles."""
+    module, params = tiny_llama
+    registry = telemetry.MetricsRegistry()
+    ledger = UsageLedger(registry=registry, top_k=4)
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=6, prompt_buckets=(8,),
+        chunk_steps=2, registry=registry,
+        tracer=telemetry.TraceRecorder(), usage=None,
+    )
+    try:
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(1, 97, 6).tolist()
+        with tenant_scope("acme"):
+            out_off = engine.generate(params, [prompt])
+        assert ledger.report()["totals"]["tokens"] == 0
+        engine.usage = ledger
+        assert engine.usage is ledger
+        with tenant_scope("acme"):
+            out_on = engine.generate(params, [prompt])
+        on_report = ledger.report()
+        assert on_report["tenants"]["acme"]["decode_tokens"] == 6
+        # the off-leg's idle gap must not inflate the first metered
+        # window: attribution is clamped at each chunk's dispatch time
+        assert on_report["tenants"]["acme"]["device_seconds"] < 30.0
+        engine.usage = None
+        with tenant_scope("acme"):
+            out_off2 = engine.generate(params, [prompt])
+        assert ledger.report()["totals"]["tokens"] == 6
+        assert out_off == out_on == out_off2
+    finally:
+        engine.close()
+
+
+def test_prefix_cache_savings_credited_to_leasing_tenant(tiny_llama):
+    """Tenant A pays the cold prefill and inserts the blocks; tenant B
+    reuses them — the cached_tokens credit lands on B (the lease
+    holder whose prefill was skipped), not on A."""
+    module, params = tiny_llama
+    registry = telemetry.MetricsRegistry()
+    ledger = UsageLedger(registry=registry, top_k=4)
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=4, prompt_buckets=(16,),
+        chunk_steps=2, registry=registry,
+        tracer=telemetry.TraceRecorder(),
+        prefix_cache=RadixPrefixCache(block_size=4, registry=registry),
+        usage=ledger,
+    )
+    try:
+        rng = np.random.default_rng(2)
+        prompt = rng.integers(1, 97, 12).tolist()
+        with tenant_scope("author"):
+            out_a = engine.generate(params, [prompt])
+        # the insert rides the async harvest pipeline: wait for it
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if engine.prefix_cache.stats()["entries"] > 0:
+                break
+            time.sleep(0.01)
+        with tenant_scope("reuser"):
+            out_b = engine.generate(params, [prompt])
+        assert out_a == out_b  # cache parity rides along
+        report = ledger.report()
+        assert report["tenants"]["author"]["cached_tokens"] == 0
+        # (12 - 1) // 4 = 2 usable blocks -> 8 tokens spliced
+        assert report["tenants"]["reuser"]["cached_tokens"] == 8
+        assert report["cache_savings_tokens"] == 8
+        assert (
+            report["tenants"]["reuser"]["prefill_tokens"]
+            == 12 - 8
+        )
+    finally:
+        engine.close()
+
+
+def test_kv_block_seconds_closed_on_abandoned_stream(tiny_llama):
+    """Paged mode: an abandoned stream's pool blocks free AND its hold
+    window closes into the tenant's kv_block_seconds."""
+    module, params = tiny_llama
+    registry = telemetry.MetricsRegistry()
+    ledger = UsageLedger(registry=registry, top_k=4)
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=32, prompt_buckets=(16,),
+        chunk_steps=2, paged=True, registry=registry,
+        tracer=telemetry.TraceRecorder(), usage=ledger,
+    )
+    try:
+        rng = np.random.default_rng(3)
+        with tenant_scope("ghost"):
+            gen = engine.generate_stream(
+                params, rng.integers(1, 97, 8).tolist()
+            )
+            next(gen)
+            gen.close()  # client disconnect mid-decode
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            st = engine.stats()["kv_pool"]
+            if st["blocks_in_use"] == 0 and st["blocks_reserved"] == 0:
+                break
+            time.sleep(0.01)
+        else:
+            pytest.fail(f"pool never drained: {st}")
+        report = ledger.report()
+        assert report["tenants"]["ghost"]["kv_block_seconds"] > 0
+        assert report["tenants"]["ghost"]["dropped"] == 1
+        text = registry.exposition()
+        assert "unionml_tenant_kv_block_seconds_total" in text
+    finally:
+        engine.close()
+
+
+@pytest.mark.chaos
+def test_kv_block_seconds_closed_on_recovery(tiny_llama):
+    """Paged mode + chaos: a poisoned batch's hold windows close at
+    recovery (before the pool resets under it) and the drops are
+    billed to their tenants."""
+    module, params = tiny_llama
+    fi = FaultInjector()
+    registry = telemetry.MetricsRegistry()
+    ledger = UsageLedger(registry=registry, top_k=4)
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=8, prompt_buckets=(16,),
+        chunk_steps=4, paged=True, registry=registry,
+        tracer=telemetry.TraceRecorder(), usage=ledger,
+        fault_injector=fi,
+    )
+    try:
+        engine.warmup(params)
+        engine.reset_stats()
+        rng = np.random.default_rng(4)
+        fi.arm("engine.dispatch", exc=xla_oom_error())
+
+        def run(p):
+            try:
+                with tenant_scope("victim"):
+                    engine.generate(params, [p])
+            except Exception:
+                pass  # the poisoned batch
+
+        threads = [
+            threading.Thread(
+                target=run, args=(rng.integers(1, 97, 9).tolist(),)
+            )
+            for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert engine.stats()["robustness"]["recoveries"] >= 1
+        report = ledger.report()
+        vec = report["tenants"]["victim"]
+        assert vec["kv_block_seconds"] > 0
+        assert vec["dropped"] >= 1
+        st = engine.stats()["kv_pool"]
+        assert st["blocks_in_use"] == 0 and st["blocks_reserved"] == 0
+    finally:
+        engine.close()
+
+
+def test_rejections_gain_tenant_dimension(tiny_llama):
+    module, params = tiny_llama
+    registry = telemetry.MetricsRegistry()
+    ledger = UsageLedger(registry=registry, top_k=4)
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=4, prompt_buckets=(8,),
+        chunk_steps=2, registry=registry,
+        tracer=telemetry.TraceRecorder(), usage=ledger,
+    )
+    try:
+        engine.drain(timeout=5)
+        from unionml_tpu.serving.faults import EngineUnavailable
+
+        with pytest.raises(EngineUnavailable):
+            with tenant_scope("shed-me"):
+                engine.generate(params, [[1, 2, 3]])
+        report = ledger.report()
+        assert report["tenants"]["shed-me"]["rejected"] == 1
+        assert (
+            'unionml_tenant_rejected_total{ledger="'
+            f'{ledger.instance}",tenant="shed-me",reason="draining"}} 1'
+        ) in registry.exposition()
+    finally:
+        engine.close()
+
+
+def test_flight_events_tenant_tag_and_filter(tiny_llama):
+    module, params = tiny_llama
+    flight = telemetry.FlightRecorder()
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=4, prompt_buckets=(8,),
+        chunk_steps=2, registry=telemetry.MetricsRegistry(),
+        tracer=telemetry.TraceRecorder(), flight=flight, usage=True,
+    )
+    try:
+        rng = np.random.default_rng(5)
+        for tenant in ("red", "blue"):
+            with tenant_scope(tenant):
+                engine.generate(params, [rng.integers(1, 97, 5).tolist()])
+        red = flight.dump(tenant="red")
+        assert red and all(e["tenant"] == "red" for e in red)
+        kinds = {e["kind"] for e in red}
+        assert {"submit", "prefill", "finish"} <= kinds
+        assert flight.dump(tenant="nobody") == []
+    finally:
+        engine.close()
+
+
+def test_batcher_usage_attribution_by_rows():
+    from unionml_tpu.serving.batcher import MicroBatcher
+
+    registry = telemetry.MetricsRegistry()
+    ledger = UsageLedger(registry=registry, top_k=4)
+
+    def predict(feats):
+        return feats.sum(axis=1)
+
+    batcher = MicroBatcher(
+        predict, max_batch_size=16, max_wait_ms=50.0,
+        registry=registry, tracer=telemetry.TraceRecorder(),
+        usage=ledger,
+    )
+    try:
+        results = {}
+
+        def submit(tenant, rows):
+            with tenant_scope(tenant):
+                results[tenant] = batcher.submit(
+                    np.full((rows, 4), 1.0)
+                )
+
+        threads = [
+            threading.Thread(target=submit, args=("big", 3)),
+            threading.Thread(target=submit, args=("small", 1)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        report = ledger.report()
+        assert report["tenants"]["big"]["requests"] == 1
+        assert report["tenants"]["big"]["decode_tokens"] == 3
+        assert report["tenants"]["small"]["decode_tokens"] == 1
+        total = report["totals"]["device_seconds"]
+        split = (
+            report["tenants"]["big"]["device_seconds"]
+            + report["tenants"]["small"]["device_seconds"]
+        )
+        # abs term: vector() rounds to nanoseconds, so the two-tenant
+        # sum can differ from the total by up to 1e-9 even though the
+        # unrounded identity is exact
+        assert split == pytest.approx(total, rel=1e-6, abs=1e-8)
+        # rows split 3:1 -> device share splits 3:1 when batched
+        # together (the two may also land in separate batches; either
+        # way the identity above holds)
+        assert "usage" in batcher.stats()
+    finally:
+        batcher.close()
+
+
+# ------------------------------------------------------- transports
+
+
+def test_stdlib_transport_tenant_round_trip(trained_model):
+    import httpx
+
+    from unionml_tpu.serving.http import ServingApp
+
+    app = ServingApp(trained_model)
+    host, port = app.serve(port=0, blocking=False)
+    base = f"http://{host}:{port}"
+    try:
+        r = httpx.post(
+            f"{base}/predict",
+            json={"features": [{"x": 1.0, "x2": 1.0}]},
+            headers={"X-Tenant-ID": "acme"},
+        )
+        assert r.status_code == 200
+        assert r.headers["x-tenant-id"] == "acme"
+        assert r.headers.get("x-request-id")
+        # default + echo on non-predict routes too
+        h = httpx.get(f"{base}/health")
+        assert h.headers["x-tenant-id"] == "anonymous"
+        # hostile values: 422, never a label value
+        bad = httpx.post(
+            f"{base}/predict", json={"features": []},
+            headers={"X-Tenant-ID": "x" * 65},
+        )
+        assert bad.status_code == 422
+        # no ledger on this app -> /debug/usage is 422 like /debug/slo
+        assert httpx.get(f"{base}/debug/usage").status_code == 422
+    finally:
+        app.shutdown()
+
+
+def test_serving_app_batch_mode_forwards_ledger_to_batcher(trained_model):
+    """ServingApp(batch=True, usage=) must hand the SAME ledger to the
+    MicroBatcher it constructs — `usage` is consumed by the app for
+    /debug/usage and cannot be reached through **batcher_kwargs, so
+    without the forward the batched path silently meters nothing."""
+    import httpx
+
+    from unionml_tpu.serving.http import ServingApp
+
+    registry = telemetry.MetricsRegistry()
+    ledger = UsageLedger(registry=registry, top_k=4)
+    app = ServingApp(
+        trained_model, batch=True, registry=registry, usage=ledger,
+        max_batch_size=4, max_wait_ms=1.0,
+    )
+    host, port = app.serve(port=0, blocking=False)
+    base = f"http://{host}:{port}"
+    try:
+        r = httpx.post(
+            f"{base}/predict",
+            json={"features": [{"x": 1.0, "x2": 1.0}]},
+            headers={"X-Tenant-ID": "acme"},
+        )
+        assert r.status_code == 200
+        body = httpx.get(f"{base}/debug/usage").json()
+        assert body["tenants"]["acme"]["requests"] == 1
+        assert body["tenants"]["acme"]["decode_tokens"] == 1  # rows
+        assert "acme" in _tenant_labels(registry)
+    finally:
+        app.shutdown()
+
+
+def test_fastapi_transport_tenant_round_trip(trained_model):
+    fastapi = pytest.importorskip("fastapi")
+    from fastapi.testclient import TestClient
+
+    app = fastapi.FastAPI()
+    trained_model.serve(app)
+    with TestClient(app) as client:
+        r = client.post(
+            "/predict", json={"features": [[0.1, 0.2]]},
+            headers={"X-Tenant-ID": "acme"},
+        )
+        assert r.status_code == 200
+        assert r.headers["x-tenant-id"] == "acme"
+        h = client.get("/health")
+        assert h.headers["x-tenant-id"] == "anonymous"
+        bad = client.get("/health", headers={"X-Tenant-ID": "x" * 65})
+        assert bad.status_code == 422
+        assert client.get("/debug/usage").status_code == 422
+
+
+def test_serverless_transport_tenant_round_trip(trained_model):
+    from unionml_tpu.serving.serverless import gateway_handler
+
+    handler = gateway_handler(trained_model)
+    r = handler({
+        "httpMethod": "POST", "path": "/predict",
+        "headers": {"X-Tenant-ID": "acme"},
+        "body": json.dumps({"features": [[0.1, 0.2]]}),
+    })
+    assert r["statusCode"] == 200
+    assert r["headers"]["X-Tenant-ID"] == "acme"
+    h = handler({"httpMethod": "GET", "path": "/health"})
+    assert h["headers"]["X-Tenant-ID"] == "anonymous"
+    bad = handler({
+        "httpMethod": "GET", "path": "/health",
+        "headers": {"X-Tenant-ID": "x" * 65},
+    })
+    assert bad["statusCode"] == 422
+    assert handler({
+        "httpMethod": "GET", "path": "/debug/usage",
+    })["statusCode"] == 422
+
+
+def test_debug_usage_endpoint_engine_backed(tiny_llama):
+    """The full wiring: engine ledger -> ServingApp(usage=) ->
+    GET /debug/usage serves per-tenant vectors; the flight filter
+    narrows the postmortem to one tenant."""
+    import httpx
+
+    from unionml_tpu import Dataset, Model
+    from unionml_tpu.model import ModelArtifact
+    from unionml_tpu.serving.http import ServingApp
+
+    module, params = tiny_llama
+    registry = telemetry.MetricsRegistry()
+    flight = telemetry.FlightRecorder()
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=6, prompt_buckets=(8,),
+        chunk_steps=2, registry=registry,
+        tracer=telemetry.TraceRecorder(), flight=flight, usage=True,
+    )
+    dataset = Dataset(name="usage_data", targets=[])
+
+    @dataset.reader
+    def reader() -> list:
+        return []
+
+    lm = Model(name="usage_lm", init=lambda: params, dataset=dataset)
+
+    @lm.trainer
+    def trainer(p: dict, features: list) -> dict:
+        return p
+
+    @lm.predictor
+    def predictor(p: dict, prompts: list) -> list:
+        return engine.generate(p, prompts)
+
+    lm.artifact = ModelArtifact(params, {}, {})
+    app = ServingApp(
+        lm, stats=engine.stats, health=engine.health,
+        registry=registry, flight=flight, usage=engine.usage,
+    )
+    host, port = app.serve(port=0, blocking=False)
+    base = f"http://{host}:{port}"
+    try:
+        r = httpx.post(
+            f"{base}/predict", json={"features": [[1, 2, 3]]},
+            headers={"X-Tenant-ID": "acme"}, timeout=120,
+        )
+        assert r.status_code == 200 and r.headers["x-tenant-id"] == "acme"
+        usage = httpx.get(f"{base}/debug/usage", timeout=30).json()
+        assert usage["tenants"]["acme"]["requests"] == 1
+        assert usage["tenants"]["acme"]["decode_tokens"] == 6
+        assert usage["attribution"]["token_coverage"] >= 0.95
+        assert "capacity" in usage and "headroom" in usage["capacity"]
+        flight_resp = httpx.get(
+            f"{base}/debug/flight?tenant=acme", timeout=30
+        ).json()
+        assert flight_resp["events"]
+        assert all(
+            e.get("tenant") == "acme" for e in flight_resp["events"]
+        )
+        # /stats mirrors the compact usage section
+        stats = httpx.get(f"{base}/stats", timeout=30).json()
+        assert stats["usage"]["distinct_tenants"] >= 1
+        # the scrape carries the bounded tenant series
+        text = httpx.get(f"{base}/metrics", timeout=30).text
+        assert 'tenant="acme"' in text
+    finally:
+        app.shutdown()
+        engine.close()

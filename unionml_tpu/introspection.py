@@ -346,6 +346,18 @@ class ProgramTracker:
                 pass
         self._account(prog, cost)
 
+    def cost(self, key: str, sig: Any = None) -> Tuple[float, float]:
+        """Last-known ``(flops, bytes)`` of one dispatch of program
+        ``key`` at signature ``sig`` (falling back to the program's
+        last compiled cost; ``(0, 0)`` for untracked programs) — the
+        per-dispatch numerator the usage ledger splits across tenants
+        (docs/observability.md "Usage metering & cost attribution")."""
+        with self._lock:
+            prog = self._programs.get(key)
+            if prog is None:
+                return (0.0, 0.0)
+            return prog.cost_by_sig.get(sig, prog.last_cost)
+
     def _on_call(self, prog: _Program, sig) -> None:
         with self._lock:
             cost = prog.cost_by_sig.get(sig, prog.last_cost)

@@ -47,6 +47,18 @@ failing — ``drain()`` finishes in-flight streams for graceful
 shutdown, and a deterministic :class:`~unionml_tpu.serving.faults
 .FaultInjector` makes every failure mode reproducible in CPU-only
 tests.
+
+Usage metering (:mod:`unionml_tpu.serving.usage`, docs/observability.md
+"Usage metering & cost attribution"): a :class:`~unionml_tpu.serving
+.usage.UsageLedger` assembles a per-request resource vector — queue
+wait, prefill vs. prefix-cache-saved tokens, decode tokens, attributed
+device-seconds/FLOPs (per-dispatch cost split across the shared batch
+by token share), KV block-seconds — billed to the ``X-Tenant-ID``
+tenant the transports propagate via :func:`~unionml_tpu.serving.usage
+.tenant_scope`. Per-tenant aggregates export as bounded-cardinality
+``unionml_tenant_*`` series (top-K + ``other`` rollup) and the exact
+vectors serve at ``GET /debug/usage`` — the measurement substrate for
+per-tenant quotas and fair scheduling.
 """
 
 from unionml_tpu.serving.batcher import MicroBatcher
@@ -61,10 +73,17 @@ from unionml_tpu.serving.faults import (
 from unionml_tpu.serving.http import ServingApp, create_app
 from unionml_tpu.serving.kv_pool import KVBlockPool, PoolExhausted
 from unionml_tpu.serving.prefix_cache import RadixPrefixCache
+from unionml_tpu.serving.usage import (
+    UsageLedger,
+    current_tenant,
+    tenant_scope,
+    validate_tenant,
+)
 
 __all__ = [
     "DeadlineExceeded", "DecodeEngine", "EngineUnavailable",
     "FaultInjector", "KVBlockPool", "MicroBatcher", "Overloaded",
-    "PoolExhausted", "RadixPrefixCache", "ServingApp", "create_app",
-    "deadline_scope",
+    "PoolExhausted", "RadixPrefixCache", "ServingApp", "UsageLedger",
+    "create_app", "current_tenant", "deadline_scope", "tenant_scope",
+    "validate_tenant",
 ]

@@ -34,6 +34,7 @@ from unionml_tpu.serving.faults import (
     Overloaded,
     current_deadline_ms,
 )
+from unionml_tpu.serving.usage import DEFAULT_TENANT, current_tenant
 
 DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 
@@ -108,6 +109,9 @@ class _Pending:
     # telemetry trace timeline (created on the submitting thread, so it
     # inherits the transport's W3C trace scope): queue + predict spans
     rid: str = ""
+    # usage metering: the validated tenant this entry's share of the
+    # batched device call is billed to (the ambient tenant_scope)
+    tenant: str = DEFAULT_TENANT
 
 
 class MicroBatcher:
@@ -127,6 +131,7 @@ class MicroBatcher:
         fault_injector=None,
         introspect: bool = True,
         flight=None,
+        usage=None,
     ):
         """``row_lists=True``: features/results are plain Python lists of
         per-example rows (possibly ragged — LLM token-id prompts), so the
@@ -162,7 +167,15 @@ class MicroBatcher:
         them) and record request lifecycle events into ``flight``
         (default: the process-global
         :class:`~unionml_tpu.telemetry.FlightRecorder` behind
-        ``GET /debug/flight``). ``False`` disables both."""
+        ``GET /debug/flight``). ``False`` disables both.
+
+        ``usage``: a :class:`~unionml_tpu.serving.usage.UsageLedger`
+        (or ``True`` for a default one on this batcher's registry)
+        enabling per-tenant usage metering: each entry's queue wait,
+        row count, and share of the batched device call (device-seconds
+        and tracker FLOPs, split by row share) are billed to the
+        ambient :func:`~unionml_tpu.serving.usage.tenant_scope` tenant;
+        ``None`` (default) disables metering."""
         self._predict_fn = predict_fn
         self.row_lists = row_lists
         self.max_batch_size = max_batch_size
@@ -193,6 +206,11 @@ class MicroBatcher:
         # tracked opaquely (calls only). introspect=False leaves the
         # predictor unwrapped and every flight site a single None check.
         self.introspect = bool(introspect)
+        if usage is True:
+            from unionml_tpu.serving.usage import UsageLedger
+
+            usage = UsageLedger(registry=self._registry)
+        self._usage = usage or None
         self._programs = None
         self._flight = None
         if self.introspect:
@@ -314,14 +332,18 @@ class MicroBatcher:
             deadline_ms = current_deadline_ms()
         pending = _Pending(
             features=features, rows=_leading_dim(features, self.row_lists),
-            submitted=time.perf_counter(),
+            submitted=time.perf_counter(), tenant=current_tenant(),
         )
         if deadline_ms is not None:
             pending.deadline = pending.submitted + deadline_ms / 1e3
         with self._admit_lock:
             if self._draining:
                 self._m_rejected["draining"].inc()
-                self._flight_rec("reject", reason="draining")
+                if self._usage is not None:
+                    self._usage.record_rejected(pending.tenant, "draining")
+                self._flight_rec(
+                    "reject", reason="draining", tenant=pending.tenant,
+                )
                 raise EngineUnavailable(
                     "micro-batcher is draining and not accepting requests",
                     reason="draining", retry_after_s=1.0,
@@ -330,8 +352,13 @@ class MicroBatcher:
                 depth = self._queue.qsize()
                 if depth >= self.max_queue_depth:
                     self._m_rejected["queue_full"].inc()
+                    if self._usage is not None:
+                        self._usage.record_rejected(
+                            pending.tenant, "queue_full"
+                        )
                     self._flight_rec(
-                        "reject", reason="queue_full", queue_depth=depth
+                        "reject", reason="queue_full", queue_depth=depth,
+                        tenant=pending.tenant,
                     )
                     raise Overloaded(
                         f"micro-batcher queue is full ({depth} queued >= "
@@ -349,7 +376,7 @@ class MicroBatcher:
                 "batch", batcher=self.instance, rows=pending.rows
             )
             self._flight_rec(
-                "submit", rows=pending.rows,
+                "submit", rows=pending.rows, tenant=pending.tenant,
                 queue_depth=self._queue.qsize(),
             )
             self._queue.put(pending)
@@ -426,6 +453,8 @@ class MicroBatcher:
                 "draining": self._draining,
             },
         }
+        if self._usage is not None:
+            out["usage"] = self._usage.stats()
         if self._programs is not None:
             out["programs"] = self._programs.stats()
         for name, h in (
@@ -447,6 +476,8 @@ class MicroBatcher:
             self._h_device,
         ):
             m.reset()
+        if self._usage is not None:
+            self._usage.reset_stats()
         if self._programs is not None:
             self._programs.reset()
 
@@ -474,7 +505,11 @@ class MicroBatcher:
         contract). Returns True when the entry was shed."""
         if p.abandoned:
             self._m_abandoned.inc()
-            self._flight_rec("drop", cause="abandoned", rows=p.rows)
+            if self._usage is not None:
+                self._usage.record_drop(p.tenant, "abandoned")
+            self._flight_rec(
+                "drop", cause="abandoned", rows=p.rows, tenant=p.tenant,
+            )
             self._tracer.finish_request(p.rid)
             self._dispose()
             return True
@@ -486,9 +521,11 @@ class MicroBatcher:
                 deadline_ms=(p.deadline - p.submitted) * 1e3,
             )
             self._m_deadline_shed.inc()
+            if self._usage is not None:
+                self._usage.record_deadline_shed(p.tenant)
             self._flight_rec(
                 "drop", cause="deadline_shed", rows=p.rows,
-                waited_ms=round(waited_ms, 3),
+                tenant=p.tenant, waited_ms=round(waited_ms, 3),
             )
             self._tracer.finish_request(p.rid)
             p.event.set()
@@ -594,6 +631,25 @@ class MicroBatcher:
                     self._h_queue.observe(p.queue_wait_ms)
                     self._h_device.observe(p.device_ms)
                 self._m_requests.inc(len(batch))
+                if self._usage is not None:
+                    # the shared device call split by row share; FLOPs
+                    # from the tracker's cost analysis per chunked part
+                    shares: dict = {}
+                    for p in batch:
+                        shares[p.tenant] = shares.get(p.tenant, 0) + p.rows
+                    flops = 0.0
+                    if self._programs is not None:
+                        flops = (
+                            self._programs.cost("batcher.predict")[0]
+                            * len(parts)
+                        )
+                    self._usage.attribute(
+                        shares, device_s=device_ms / 1e3, flops=flops,
+                    )
+                    for p in batch:
+                        self._usage.finish_request(
+                            p.tenant, queue_ms=p.queue_wait_ms,
+                        )
                 self._flight_rec(
                     "batch", rows=total, entries=len(batch),
                     device_ms=round(device_ms, 3),
@@ -601,6 +657,9 @@ class MicroBatcher:
             except BaseException as exc:  # surface errors to every waiter
                 logger.info(f"micro-batcher error: {exc!r}")
                 self._m_errors.inc(len(batch))
+                if self._usage is not None:
+                    for p in batch:
+                        self._usage.record_drop(p.tenant, "error")
                 self._flight_rec(
                     "error", entries=len(batch), error=repr(exc)[:200]
                 )

@@ -108,6 +108,11 @@ from unionml_tpu.serving.faults import (
     current_deadline_ms,
 )
 from unionml_tpu.serving.kv_pool import KVBlockPool, PoolExhausted
+from unionml_tpu.serving.usage import (
+    DEFAULT_TENANT,
+    current_tenant,
+    validate_tenant,
+)
 
 __all__ = ["DecodeEngine"]
 
@@ -205,6 +210,9 @@ class _Request:
     ttft_ms: float = 0.0
     abandoned: bool = False             # waiter gave up (timeout): retire asap
     rid: str = ""                       # telemetry trace-span request id
+    # usage metering (docs/observability.md "Usage metering"): the
+    # validated tenant id this request's resource vector is billed to
+    tenant: str = DEFAULT_TENANT
     # absolute perf_counter deadline (None = none): checked at DEQUEUE,
     # so an expired request is shed before it consumes prefill
     deadline: Optional[float] = None
@@ -222,6 +230,11 @@ class _Request:
     _rows_cap: int = 0                  # prompt + max_new (block budget)
     _park_logged: bool = False          # one pool_pressure event per park
     _pool_gen: int = 0                  # pool generation at reservation
+    # usage metering: pool-block take timestamps (parallel to
+    # _block_ids' take order) and dispatched-prefill FLOPs accumulated
+    # from the tracker's per-program cost analysis
+    _block_t0: List[float] = field(default_factory=list)
+    _attr_flops: float = 0.0
 
     def emit(self, chunk: List[int]) -> None:
         if self.stream is not None and chunk:
@@ -353,6 +366,21 @@ class DecodeEngine:
         flight: explicit :class:`~unionml_tpu.telemetry.FlightRecorder`
             for lifecycle events; defaults to the process-global one
             (``GET /debug/flight``). Ignored when ``introspect=False``.
+        usage: a :class:`~unionml_tpu.serving.usage.UsageLedger` (or
+            ``True`` for a default one on this engine's registry)
+            enabling per-tenant usage metering (docs/observability.md
+            "Usage metering & cost attribution"): every request's
+            queue wait, prefill/cached/decode tokens, attributed
+            device-seconds and FLOPs (per-dispatch cost split across
+            the live batch by harvested-token share), and — in paged
+            mode — KV block-seconds are billed to its tenant (the
+            ``X-Tenant-ID`` header via the ambient
+            :func:`~unionml_tpu.serving.usage.tenant_scope`, or the
+            ``tenant=`` argument of :meth:`generate`). Per-tenant
+            aggregates export as bounded-cardinality
+            ``unionml_tenant_*`` series; ``None`` (default) disables
+            metering entirely — every record site is one attr-is-None
+            check (the ``serve_usage`` bench measures the delta).
         paged/kv_pool_bytes/kv_pool_blocks/kv_block_size: BLOCK-PAGED
             device KV (docs/performance.md "Paged KV attention";
             PagedAttention lineage). Instead of ``slots`` contiguous
@@ -414,6 +442,7 @@ class DecodeEngine:
         fault_injector=None,
         introspect: bool = True,
         flight=None,
+        usage=None,
         paged: bool = False,
         kv_pool_bytes: Optional[int] = None,
         kv_pool_blocks: Optional[int] = None,
@@ -514,6 +543,18 @@ class DecodeEngine:
             (flight if flight is not None else telemetry.get_flight_recorder())
             if self.introspect else None
         )
+        # usage metering (off-switch: None leaves every record site a
+        # single attr check, measured by the serve_usage bench)
+        if usage is True:
+            from unionml_tpu.serving.usage import UsageLedger
+
+            usage = UsageLedger(registry=self._registry)
+        self._usage = usage or None
+        # harvester-thread clock: end of the previous readback, so each
+        # entry's attributed device time is the wall it exclusively
+        # occupied the device pipeline (consecutive-harvest spacing ==
+        # per-chunk device time once the pipeline saturates)
+        self._last_harvest_end = 0.0
         self._programs = None
         # shared system prefix (back-compat shim over the prefix cache):
         # the tokens are PREPENDED to every request's prompt and their
@@ -904,6 +945,27 @@ class DecodeEngine:
             self._faults.fire(point)
 
     @property
+    def usage(self):
+        """The engine's :class:`~unionml_tpu.serving.usage.UsageLedger`
+        (``None`` when metering is off) — share it with the
+        ``ServingApp`` so ``GET /debug/usage`` serves this engine's
+        per-tenant resource vectors."""
+        return self._usage
+
+    @usage.setter
+    def usage(self, ledger) -> None:
+        """Swap the metering seam on a live engine — ONLY while idle
+        (no request in flight), or a request's vector straddles two
+        ledgers. The ``serve_usage`` bench toggles this between its
+        overhead legs so both run on the SAME engine instance (two
+        separately-constructed engines differ by several percent from
+        thread/allocator placement alone, swamping a 2% bar); the
+        attribution window is clamped at each chunk's dispatch time,
+        so the off-leg's idle gap never inflates the first on-leg
+        window."""
+        self._usage = ledger or None
+
+    @property
     def breaker_open(self) -> bool:
         """True while the circuit breaker rejects submissions (the
         cooldown after ``breaker_threshold`` recoveries in the window).
@@ -927,14 +989,22 @@ class DecodeEngine:
                 # 'submit' flight event can never land after its
                 # 'prefill' in the trail. queue_depth = requests ahead.
                 self._flight_rec(
-                    "submit", rid=req.rid, prompt_tokens=len(req.prompt),
+                    "submit", rid=req.rid, tenant=req.tenant,
+                    prompt_tokens=len(req.prompt),
                     queue_depth=self._queue.qsize(),
                 )
                 self._queue.put(req)
         self._g_queue_depth.set(self._queue.qsize())
 
+    def _usage_rejected(self, reqs: List[_Request], reason: str) -> None:
+        """Tenant dimension on admission-control rejections (all reqs
+        in one submit share a tenant — one gated call per generate)."""
+        if self._usage is not None and reqs:
+            self._usage.record_rejected(reqs[0].tenant, reason, len(reqs))
+
     def _admission_gate_locked(self, reqs: List[_Request]) -> None:
         n_new = len(reqs)
+        tenant = reqs[0].tenant if reqs else DEFAULT_TENANT
         if self.paged:
             # a request whose worst case exceeds the WHOLE pool can
             # never be admitted — reject now (transient fullness parks
@@ -946,9 +1016,10 @@ class DecodeEngine:
                 )
                 if needed > self.kv_pool.capacity:
                     self._m_rejected["pool_full"].inc(n_new)
+                    self._usage_rejected(reqs, "pool_full")
                     self._flight_rec(
                         "reject", reason="pool_full", n=n_new,
-                        needed_blocks=needed,
+                        tenant=tenant, needed_blocks=needed,
                         capacity_blocks=self.kv_pool.capacity,
                     )
                     raise Overloaded(
@@ -961,7 +1032,10 @@ class DecodeEngine:
                     )
         if self._draining:
             self._m_rejected["draining"].inc(n_new)
-            self._flight_rec("reject", reason="draining", n=n_new)
+            self._usage_rejected(reqs, "draining")
+            self._flight_rec(
+                "reject", reason="draining", n=n_new, tenant=tenant,
+            )
             raise EngineUnavailable(
                 "decode engine is draining and not accepting requests",
                 reason="draining", retry_after_s=1.0,
@@ -969,7 +1043,10 @@ class DecodeEngine:
         remaining = self._breaker_open_until - time.monotonic()
         if remaining > 0:
             self._m_rejected["breaker_open"].inc(n_new)
-            self._flight_rec("reject", reason="breaker_open", n=n_new)
+            self._usage_rejected(reqs, "breaker_open")
+            self._flight_rec(
+                "reject", reason="breaker_open", n=n_new, tenant=tenant,
+            )
             raise EngineUnavailable(
                 "decode engine circuit breaker is open "
                 f"({len(self._recovery_times)} recent recovery failures); "
@@ -980,9 +1057,10 @@ class DecodeEngine:
             depth = self._queue.qsize()
             if depth + n_new > self.max_queue_depth:
                 self._m_rejected["queue_full"].inc(n_new)
+                self._usage_rejected(reqs, "queue_full")
                 self._flight_rec(
                     "reject", reason="queue_full", n=n_new,
-                    queue_depth=depth,
+                    tenant=tenant, queue_depth=depth,
                 )
                 raise Overloaded(
                     f"decode engine queue is full ({depth} queued + "
@@ -1710,6 +1788,7 @@ class DecodeEngine:
         *,
         max_new_tokens: Optional[int] = None,
         deadline_ms: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> list:
         """Generate for a list of token-id prompts; blocks until all done.
 
@@ -1723,8 +1802,17 @@ class DecodeEngine:
         still-queued requests whose deadline expires are shed at
         dequeue with :class:`~unionml_tpu.serving.faults
         .DeadlineExceeded`, before they consume prefill.
+
+        ``tenant`` (or the ambient :func:`~unionml_tpu.serving.usage
+        .tenant_scope` the transports open from ``X-Tenant-ID``) names
+        who this call's resource vector is billed to when the engine
+        runs a usage ledger; defaults to ``anonymous``.
         """
         self.bind(params)
+        tenant = (
+            validate_tenant(tenant) if tenant is not None
+            else current_tenant()
+        )
         n = max_new_tokens if max_new_tokens is not None else self.max_new_tokens
         if not 1 <= n <= self.max_new_tokens:
             raise ValueError(
@@ -1748,7 +1836,7 @@ class DecodeEngine:
             rows.append(row)
         reqs = []
         for row in rows:
-            req = _Request(prompt=row, max_new_tokens=n)
+            req = _Request(prompt=row, max_new_tokens=n, tenant=tenant)
             if deadline_ms is not None:
                 req.deadline = req.submitted + deadline_ms / 1e3
             req.rid = self._tracer.new_request("generate")
@@ -1784,6 +1872,7 @@ class DecodeEngine:
         *,
         max_new_tokens: Optional[int] = None,
         deadline_ms: Optional[float] = None,
+        tenant: Optional[str] = None,
     ):
         """Yield token chunks for ONE prompt as the engine harvests them.
 
@@ -1796,6 +1885,10 @@ class DecodeEngine:
         ``TimeoutError`` when no chunk lands within ``submit_timeout``.
         """
         self.bind(params)
+        tenant = (
+            validate_tenant(tenant) if tenant is not None
+            else current_tenant()
+        )
         n = max_new_tokens if max_new_tokens is not None else self.max_new_tokens
         if not 1 <= n <= self.max_new_tokens:
             raise ValueError(
@@ -1810,7 +1903,10 @@ class DecodeEngine:
         row = row[-self._user_max:]
         if self._prefix_tokens is not None:
             row = np.concatenate([self._prefix_tokens, row])
-        req = _Request(prompt=row, max_new_tokens=n, stream=queue.Queue())
+        req = _Request(
+            prompt=row, max_new_tokens=n, stream=queue.Queue(),
+            tenant=tenant,
+        )
         if deadline_ms is not None:
             req.deadline = req.submitted + deadline_ms / 1e3
         req.rid = self._tracer.new_request("stream")
@@ -1954,6 +2050,10 @@ class DecodeEngine:
             out["prefix_cache"] = self.prefix_cache.stats()
         if self.kv_pool is not None:
             out["kv_pool"] = self.kv_pool.stats()
+        if self._usage is not None:
+            # the compact per-tenant view (GET /debug/usage has the
+            # full per-tenant resource vectors)
+            out["usage"] = self._usage.stats()
         if self._programs is not None:
             # hardware truth per compiled program: flops/bytes, compile
             # counts, MFU/roofline ratios (docs/observability.md)
@@ -1998,6 +2098,8 @@ class DecodeEngine:
             self.prefix_cache.reset_stats()
         if self.kv_pool is not None:
             self.kv_pool.reset_stats()
+        if self._usage is not None:
+            self._usage.reset_stats()
         if self._programs is not None:
             self._programs.reset()
 
@@ -2098,6 +2200,12 @@ class DecodeEngine:
                 jnp.int32(len(req.prompt)), key,
             )
         _start_host_copy(first)
+        if self._usage is not None:
+            # the monolithic prefill's cost-analysis FLOPs, accumulated
+            # for attribution at this request's prefill harvest
+            req._attr_flops += self._program_cost(
+                "engine.prefill", tuple(padded.shape)
+            )
         with self._lock:
             if self._epoch != ep0:
                 # _recover ran (harvester thread) while this prefill was
@@ -2117,8 +2225,9 @@ class DecodeEngine:
             req._expected = 1
             self._m_slots_busy.set(self._slots_in_use_locked())
         self._flight_rec(
-            "prefill", rid=req.rid, slot=slot, bucket=_bucket,
-            tokens=req._prefilled_tokens, cached_tokens=req._saved_tokens,
+            "prefill", rid=req.rid, tenant=req.tenant, slot=slot,
+            bucket=_bucket, tokens=req._prefilled_tokens,
+            cached_tokens=req._saved_tokens,
         )
         self._inflight.put(("prefill", ep0, slot, req, first))
         self._schedule_insert(req, slot, ep0)
@@ -2196,6 +2305,32 @@ class DecodeEngine:
             lease.release()
 
     # ------------------------------------------------------------------ #
+    # usage metering helpers (no-ops when usage=None)
+    # ------------------------------------------------------------------ #
+
+    def _program_cost(self, key: str, sig=None) -> float:
+        """Cost-analysis FLOPs of one dispatch of a tracked program
+        (0 when introspection is off or the program never compiled) —
+        the per-dispatch numerator the ledger splits across tenants."""
+        if self._programs is None:
+            return 0.0
+        return self._programs.cost(key, sig)[0]
+
+    def _usage_kv_release(self, req: _Request) -> None:
+        """Integrate the request's pool-block hold times into its
+        tenant's KV block-seconds (idempotent: the stamp list drains).
+        Called on every path that gives the blocks back — retirement,
+        mid-admission drop, and recovery — so no hold window is left
+        open for an abandoned or poisoned request."""
+        if self._usage is None or not req._block_t0:
+            req._block_t0 = []
+            return
+        now = time.monotonic()
+        held = sum(now - t0 for t0 in req._block_t0)
+        req._block_t0 = []
+        self._usage.record_kv_block_seconds(req.tenant, held)
+
+    # ------------------------------------------------------------------ #
     # paged-mode pool bookkeeping (engine lock held for all of these)
     # ------------------------------------------------------------------ #
 
@@ -2226,10 +2361,13 @@ class DecodeEngine:
         covered = self.kv_pool.blocks_for_rows(len(req.prompt))
         ids = np.zeros(nbb, np.int32)
         self._table[slot, :] = 0
+        t_take = time.monotonic() if self._usage is not None else 0.0
         for j in range(covered):
             bid = self.kv_pool.take()
             req._resv_blocks -= 1
             req._block_ids.append(bid)
+            if self._usage is not None:
+                req._block_t0.append(t_take)
             ids[j] = bid
             self._table[slot, j] = bid
         self._slot_covered[slot] = covered
@@ -2259,6 +2397,8 @@ class DecodeEngine:
                 bid = self.kv_pool.take()
                 req._resv_blocks -= 1
                 req._block_ids.append(bid)
+                if self._usage is not None:
+                    req._block_t0.append(time.monotonic())
                 self._table[slot, self._slot_covered[slot]] = bid
                 self._slot_covered[slot] += 1
             used_rows += min(self._slot_rows[slot], req._rows_cap)
@@ -2272,6 +2412,7 @@ class DecodeEngine:
         dispatched before this retirement may still write them — the
         free lands only after its harvest); the untaken reservation
         releases immediately (never in any table)."""
+        self._usage_kv_release(req)
         ids, req._block_ids = list(req._block_ids), []
         unreserve, req._resv_blocks = req._resv_blocks, 0
         if slot is not None:
@@ -2290,6 +2431,7 @@ class DecodeEngine:
         """Mid-admission release (the slot never became occupied, so
         every chunk dispatched so far carried ``active=False`` for it —
         its writes are trash-routed on device): immediate free."""
+        self._usage_kv_release(req)
         ids, req._block_ids = list(req._block_ids), []
         unreserve, req._resv_blocks = req._resv_blocks, 0
         if req._pool_gen != self.kv_pool.generation:
@@ -2337,8 +2479,17 @@ class DecodeEngine:
             self._m_slots_busy.set(self._slots_in_use_locked())
             self._tracer.record_span(req.rid, "harvest", self._harvest_t0, now)
             self._tracer.finish_request(req.rid)
+            if self._usage is not None:
+                if req.abandoned:
+                    self._usage.record_drop(req.tenant, "abandoned")
+                else:
+                    self._usage.finish_request(
+                        req.tenant, queue_ms=req.queue_wait_ms,
+                        prefill_tokens=req._prefilled_tokens,
+                        cached_tokens=req._saved_tokens,
+                    )
             self._flight_rec(
-                "finish", rid=req.rid, slot=slot,
+                "finish", rid=req.rid, tenant=req.tenant, slot=slot,
                 tokens=len(req.tokens), abandoned=req.abandoned,
                 ttft_ms=round(req.ttft_ms, 3),
                 decode_ms=round(req.decode_ms, 3),
@@ -2430,6 +2581,20 @@ class DecodeEngine:
                 req.tokens.append(tok)
                 req.emit([tok])
                 self._finish_if_done(slot, tok)
+            if self._usage is not None:
+                # the prefill's exclusive pipeline window (consecutive-
+                # harvest spacing) + its dispatched programs' FLOPs,
+                # billed wholly to the admitting tenant; the sampled
+                # first token is that tenant's first served token
+                device_s = max(
+                    0.0,
+                    now - max(req._dispatch_t, self._last_harvest_end),
+                )
+                self._last_harvest_end = now
+                self._usage.attribute(
+                    {req.tenant: 1}, device_s=device_s,
+                    flops=req._attr_flops,
+                )
             return
         _, _, mask, gens, toks, dispatched, seq = entry
         if self.draft is not None:
@@ -2438,6 +2603,7 @@ class DecodeEngine:
         toks = np.asarray(toks)
         now = time.perf_counter()  # readback complete: the chunk landed
         self._h_harvest.observe((now - self._harvest_t0) * 1e3)
+        tenant_tokens: dict = {}
         with self._lock:
             # slot-major (steps for different slots are independent): each
             # request's harvested tokens form ONE streamed chunk, emitted
@@ -2459,11 +2625,15 @@ class DecodeEngine:
                     tokens=len(chunk),
                 )
                 self._flight_rec(
-                    "decode", rid=req.rid, slot=slot,
+                    "decode", rid=req.rid, tenant=req.tenant, slot=slot,
                     chunk=req._chunk_i, tokens=len(chunk),
                 )
                 req._chunk_i += 1
                 req.emit(chunk)
+                if self._usage is not None:
+                    tenant_tokens[req.tenant] = (
+                        tenant_tokens.get(req.tenant, 0) + len(chunk)
+                    )
                 self._finish_if_done(slot, chunk[-1])
             if self.paged:
                 # this chunk (and by FIFO order every earlier one) has
@@ -2471,6 +2641,20 @@ class DecodeEngine:
                 # are now safe — no in-flight program references them
                 self._harvest_seq = max(self._harvest_seq, seq)
                 self._sweep_deferred_locked()
+        if self._usage is not None:
+            # the chunk's exclusive pipeline window split by harvested-
+            # token share; a chunk whose every slot went stale still
+            # counts toward the unattributed totals (the identity
+            # denominator stays honest under slot churn)
+            device_s = max(
+                0.0, now - max(dispatched, self._last_harvest_end)
+            )
+            self._last_harvest_end = now
+            self._usage.attribute(
+                tenant_tokens, device_s=device_s,
+                flops=self._program_cost("engine.decode"),
+                slot_steps=self.chunk_steps * self.slots,
+            )
 
     def _process_spec_chunk(self, mask, gens, outs, dispatched) -> None:
         """Account one speculative chunk's readback: per round, each slot
@@ -2480,6 +2664,7 @@ class DecodeEngine:
         emit, n_emit, accepted = (np.asarray(x) for x in outs)
         now = time.perf_counter()  # after np.asarray: readback complete
         self._h_harvest.observe((now - self._harvest_t0) * 1e3)
+        tenant_tokens: dict = {}
         with self._lock:
             for slot in np.flatnonzero(mask):
                 req = self._occupant[slot]
@@ -2510,11 +2695,15 @@ class DecodeEngine:
                     tokens=len(chunk),
                 )
                 self._flight_rec(
-                    "decode", rid=req.rid, slot=slot,
+                    "decode", rid=req.rid, tenant=req.tenant, slot=slot,
                     chunk=req._chunk_i, tokens=len(chunk),
                 )
                 req._chunk_i += 1
                 req.emit(chunk)
+                if self._usage is not None and chunk:
+                    tenant_tokens[req.tenant] = (
+                        tenant_tokens.get(req.tenant, 0) + len(chunk)
+                    )
                 if chunk:
                     self._finish_if_done(slot, chunk[-1])
                 elif req.abandoned:
@@ -2524,6 +2713,16 @@ class DecodeEngine:
                     self._finish_if_done(
                         slot, req.tokens[-1] if req.tokens else self.pad_id
                     )
+        if self._usage is not None:
+            device_s = max(
+                0.0, now - max(dispatched, self._last_harvest_end)
+            )
+            self._last_harvest_end = now
+            self._usage.attribute(
+                tenant_tokens, device_s=device_s,
+                flops=self._program_cost("engine.decode"),
+                slot_steps=self.chunk_steps * self.slots,
+            )
 
     def _dispatch_chunk(self) -> bool:
         """Dispatch one decode chunk if the pipeline has a credit and any
@@ -2644,13 +2843,19 @@ class DecodeEngine:
         if req.abandoned:
             self._m_abandoned.inc()
             cause = "abandoned"
+            if self._usage is not None:
+                self._usage.record_drop(req.tenant, "abandoned")
         elif isinstance(exc, DeadlineExceeded):
             self._m_deadline_shed.inc()
             cause = "deadline_shed"
+            if self._usage is not None:
+                self._usage.record_deadline_shed(req.tenant)
         else:
             self._m_errors.inc()
             cause = f"error:{type(exc).__name__}"
-        self._flight_rec("drop", rid=req.rid, cause=cause)
+            if self._usage is not None:
+                self._usage.record_drop(req.tenant, "error")
+        self._flight_rec("drop", rid=req.rid, tenant=req.tenant, cause=cause)
         self._tracer.finish_request(req.rid)
         req.event.set()
         req.finish_stream()
@@ -2857,6 +3062,10 @@ class DecodeEngine:
                 adm.fresh = self._prefill_step(
                     self._params, adm.fresh, toks, jnp.int32(start)
                 )
+                if self._usage is not None:
+                    req._attr_flops += self._program_cost(
+                        "engine.prefill_chunk", tuple(toks.shape)
+                    )
                 self._tracer.record_span(
                     req.rid, f"prefill-chunk[{adm.next_chunk}]", t0,
                     time.perf_counter(), tokens=adm.chunk,
@@ -2891,6 +3100,10 @@ class DecodeEngine:
                     toks, jnp.int32(start), jnp.int32(len(req.prompt)), key,
                 )
             _start_host_copy(first)
+            if self._usage is not None:
+                req._attr_flops += self._program_cost(
+                    "engine.prefill_final", tuple(toks.shape)
+                )
             with self._lock:
                 if self._admission is not adm or self._epoch != ep0:
                     # raced with _recover/close mid-dispatch: the request
@@ -2906,8 +3119,8 @@ class DecodeEngine:
                 self._admitting -= 1
                 self._m_slots_busy.set(self._slots_in_use_locked())
             self._flight_rec(
-                "prefill", rid=req.rid, slot=adm.slot, bucket=adm.bucket,
-                tokens=req._prefilled_tokens,
+                "prefill", rid=req.rid, tenant=req.tenant, slot=adm.slot,
+                bucket=adm.bucket, tokens=req._prefilled_tokens,
                 cached_tokens=req._saved_tokens, chunks=adm.n_chunks,
             )
             self._inflight.put(("prefill", ep0, adm.slot, req, first))
@@ -3011,6 +3224,11 @@ class DecodeEngine:
                     self._m_errors.inc()
                     self._tracer.finish_request(req.rid)
                     self._release_lease(req)
+                    if self._usage is not None:
+                        # close the hold window and bill the drop before
+                        # the pool bookkeeping is reset under it
+                        self._usage_kv_release(req)
+                        self._usage.record_drop(req.tenant, "error")
                     # pool bookkeeping resets wholesale below — zero the
                     # per-request fields so nothing double-frees
                     req._block_ids = []

@@ -22,6 +22,7 @@ from unionml_tpu.serving.faults import (
     parse_deadline_header,
 )
 from unionml_tpu.serving.http import ServingApp
+from unionml_tpu.serving.usage import tenant_scope, validate_tenant
 
 
 def serving_app(
@@ -77,6 +78,12 @@ def serving_app(
         except ValueError as exc:
             raise HTTPException(status_code=422, detail=str(exc))
 
+    def _parse_tenant(request) -> str:
+        try:  # the shared validator: same 422 contract as stdlib
+            return validate_tenant(request.headers.get("x-tenant-id"))
+        except ValueError as exc:
+            raise HTTPException(status_code=422, detail=str(exc))
+
     def _fault_http(exc: Exception) -> "HTTPException":
         """The faults.http_fault_response contract (429/503 +
         Retry-After, 504) — same mapping the stdlib transport sends."""
@@ -103,8 +110,12 @@ def serving_app(
                 response.headers["traceparent"] = (
                     telemetry.format_traceparent(ctx)
                 )
-                with deadline_scope(_parse_deadline(request)):
-                    return core.predict(payload)
+                # tenant parsed HERE like the deadline: the scope must
+                # live on the threadpool thread that submits to the
+                # engine/batcher, not the event loop's
+                with tenant_scope(_parse_tenant(request)):
+                    with deadline_scope(_parse_deadline(request)):
+                        return core.predict(payload)
         except _FAULTS as exc:
             raise _fault_http(exc)
         except (ValueError, KeyError, TypeError) as exc:
@@ -130,8 +141,9 @@ def serving_app(
         )
         try:
             with telemetry.trace_scope(ctx):
-                with deadline_scope(_parse_deadline(request)):
-                    frames = core.predict_stream_events(payload)
+                with tenant_scope(_parse_tenant(request)):
+                    with deadline_scope(_parse_deadline(request)):
+                        frames = core.predict_stream_events(payload)
         except _FAULTS as exc:
             finish()
             raise _fault_http(exc)
@@ -198,8 +210,16 @@ def serving_app(
         n: Optional[int] = None,
         kind: Optional[str] = None,
         rid: Optional[str] = None,
+        tenant: Optional[str] = None,
     ):
-        return core.debug_flight(n=n, kind=kind, rid=rid)
+        return core.debug_flight(n=n, kind=kind, rid=rid, tenant=tenant)
+
+    @app.get("/debug/usage")
+    async def debug_usage():
+        try:
+            return core.debug_usage()
+        except ValueError as exc:
+            raise HTTPException(status_code=422, detail=str(exc))
 
     @app.get("/debug/trace")
     async def debug_trace(format: str = "chrome"):
@@ -230,6 +250,21 @@ def serving_app(
         rid = telemetry.new_request_id()
         t0 = time.perf_counter()
         try:
+            # same boundary validation as the stdlib transport: a
+            # hostile X-Tenant-ID answers 422 before any route runs
+            tenant = validate_tenant(request.headers.get("x-tenant-id"))
+        except ValueError as exc:
+            from fastapi.responses import JSONResponse
+
+            core.observe_request(
+                "fastapi", request.url.path, 422,
+                (time.perf_counter() - t0) * 1e3,
+            )
+            return JSONResponse(
+                {"detail": str(exc)}, status_code=422,
+                headers={"X-Request-ID": rid},
+            )
+        try:
             response = await call_next(request)
         except BaseException:
             # an unhandled endpoint error becomes a 500 OUTSIDE this
@@ -241,6 +276,7 @@ def serving_app(
             )
             raise
         response.headers["X-Request-ID"] = rid
+        response.headers["X-Tenant-ID"] = tenant
         if "traceparent" not in response.headers:
             response.headers["traceparent"] = telemetry.format_traceparent(
                 telemetry.server_trace_context(

@@ -36,10 +36,16 @@ Same endpoint surface as the reference's FastAPI app
   capture; returns the trace artifact directory (409 while another
   capture runs),
 - ``GET /debug/memory`` — per-device memory stats + live-buffer census,
-- ``GET /debug/flight?n=K`` — the request flight recorder's newest
-  events (admissions, decode chunks, sheds, recoveries) for
+- ``GET /debug/flight?n=K&tenant=`` — the request flight recorder's
+  newest events (admissions, decode chunks, sheds, recoveries) for
   after-the-fact explanation of a 429/504/recovery
-  (docs/observability.md),
+  (docs/observability.md); events carry the submitting tenant, so an
+  overload postmortem can filter to who was shed,
+- ``GET /debug/usage`` — per-tenant resource vectors from the usage
+  ledger (``ServingApp(usage=...)``): queue/prefill/decode splits,
+  attributed device-seconds and FLOPs, prefix-cache savings, and the
+  decode capacity-headroom estimate (docs/observability.md "Usage
+  metering & cost attribution"),
 - ``GET /debug/trace?format=chrome|jsonl`` — the trace recorder's
   Chrome-trace / JSON-lines export over HTTP (no shelling into the
   process to pull a trace),
@@ -49,6 +55,15 @@ Same endpoint surface as the reference's FastAPI app
 Every response carries an ``X-Request-ID`` header (a generated
 telemetry request id) and lands in the per-endpoint
 ``unionml_http_requests_total`` / ``unionml_http_request_ms`` series.
+
+Tenant identity (docs/observability.md "Usage metering & cost
+attribution"): every request may carry an ``X-Tenant-ID`` header
+(default ``anonymous``; values over 64 chars or with non-printable
+characters answer **422** — a hostile header must never mint a label
+value). The validated tenant is echoed on every response alongside
+``X-Request-ID``, and predict routes open a
+:func:`~unionml_tpu.serving.usage.tenant_scope` so engine/batcher
+submissions bill their resource vectors to it.
 
 Distributed tracing (docs/observability.md): every request parses an
 inbound W3C ``traceparent`` header (a fresh root is minted when absent
@@ -102,13 +117,18 @@ from unionml_tpu.serving.faults import (
     http_fault_response,
     parse_deadline_header,
 )
+from unionml_tpu.serving.usage import (
+    DEFAULT_TENANT,
+    tenant_scope,
+    validate_tenant,
+)
 
 # bound HTTP label cardinality: unknown paths share one series instead
 # of letting a scanner mint a metric per probed URL
 KNOWN_ROUTES = (
     "/", "/predict", "/predict/stream", "/health", "/stats", "/metrics",
     "/debug/profile", "/debug/memory", "/debug/flight", "/debug/trace",
-    "/debug/slo",
+    "/debug/slo", "/debug/usage",
 )
 
 # the routes that open a RECORDED trace timeline (a server span the
@@ -163,6 +183,7 @@ class ServingApp:
         tracer: Optional[telemetry.TraceRecorder] = None,
         otlp_endpoint: Optional[str] = None,
         slo: Optional[Any] = None,
+        usage: Optional[Any] = None,
         **batcher_kwargs,
     ):
         """``warmup``: optional callable invoked with the loaded model
@@ -225,7 +246,14 @@ class ServingApp:
         every ``GET /health`` (the probe cadence is the sampling
         cadence) and served at ``GET /debug/slo``; a breached
         objective flips health to ``degraded`` → 503, so load
-        balancers react to objective burn, not just crash loops."""
+        balancers react to objective burn, not just crash loops.
+
+        ``usage``: a :class:`~unionml_tpu.serving.usage.UsageLedger` —
+        the SAME ledger the engine/batcher records into (e.g.
+        ``engine.usage``) — served at ``GET /debug/usage``: per-tenant
+        resource vectors, cache savings, and the capacity-headroom
+        estimate (docs/observability.md "Usage metering & cost
+        attribution")."""
         self.model = model
         self.remote = remote
         self.app_version = app_version
@@ -248,6 +276,7 @@ class ServingApp:
         )
         self._tracer = tracer if tracer is not None else telemetry.get_tracer()
         self._slo = slo
+        self._usage = usage
         self._otlp = None
         endpoint = otlp_endpoint or os.getenv("UNIONML_TPU_OTLP_ENDPOINT")
         if endpoint:
@@ -301,13 +330,16 @@ class ServingApp:
                 predictor = jit_predictor(predictor)
             self._batcher = MicroBatcher(
                 lambda feats: predictor(model_object, feats),
-                # the app's scrape, /debug/flight, and /debug/trace must
-                # cover its own batcher even when the app was built with
-                # isolated sinks
+                # the app's scrape, /debug/flight, /debug/trace, and
+                # /debug/usage must cover its own batcher even when the
+                # app was built with isolated sinks — `usage` in
+                # particular has no other route into an app-built
+                # batcher (ServingApp(usage=) consumes the kwarg name)
                 **{
                     "registry": self.registry,
                     "flight": self._flight,
                     "tracer": self._tracer,
+                    "usage": self._usage,
                     **self._batcher_kwargs,
                 },
             )
@@ -423,15 +455,31 @@ class ServingApp:
 
     def debug_flight(
         self, n: Optional[int] = None, kind: Optional[str] = None,
-        rid: Optional[str] = None,
+        rid: Optional[str] = None, tenant: Optional[str] = None,
     ) -> dict:
         """``GET /debug/flight?n=K``: the newest ``K`` request
         lifecycle events from the flight recorder (all retained when
-        unset), optionally filtered by event kind / request id."""
+        unset), optionally filtered by event kind / request id /
+        tenant tag (``?tenant=`` names who was shed in an overload
+        postmortem)."""
         return {
             **self._flight.stats(),
-            "events": self._flight.dump(n=n, kind=kind, rid=rid),
+            "events": self._flight.dump(
+                n=n, kind=kind, rid=rid, tenant=tenant
+            ),
         }
+
+    def debug_usage(self) -> dict:
+        """``GET /debug/usage``: the usage ledger's per-tenant resource
+        vectors, attribution-identity totals, cache savings, and
+        capacity-headroom estimate. Raises ``ValueError`` (→ 422) when
+        the app has no ledger."""
+        if self._usage is None:
+            raise ValueError(
+                "no usage ledger on this app — construct "
+                "ServingApp(usage=engine.usage) with a metering engine"
+            )
+        return self._usage.report()
 
     def debug_trace(self, format: str = "chrome"):
         """``GET /debug/trace?format=chrome|jsonl``: the trace
@@ -611,6 +659,7 @@ class ServingApp:
             _rid = ""
             _status = 0
             _trace_ctx: Optional[telemetry.TraceContext] = None
+            _tenant = DEFAULT_TENANT
 
             def log_message(self, fmt, *args):
                 logger.info(f"http: {fmt % args}")
@@ -625,6 +674,7 @@ class ServingApp:
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
                 self.send_header("X-Request-ID", self._rid)
+                self.send_header("X-Tenant-ID", self._tenant)
                 if self._trace_ctx is not None:
                     self.send_header(
                         "traceparent",
@@ -653,13 +703,29 @@ class ServingApp:
                 raw_tp = self.headers.get("traceparent")
                 t0 = time.perf_counter()
                 try:
+                    try:
+                        # validated at the boundary: a hostile tenant
+                        # header answers 422 before any route logic,
+                        # and can never reach a label value
+                        self._tenant = validate_tenant(
+                            self.headers.get("X-Tenant-ID")
+                        )
+                    except ValueError as exc:
+                        self._trace_ctx = telemetry.server_trace_context(
+                            raw_tp
+                        )
+                        self._send(422, {"error": str(exc)})
+                        return
                     # method-checked: a GET probe/scan of /predict 404s
                     # without opening a recorded timeline, so probes
                     # can never churn the trace ring or the OTLP queue
                     if path in TRACED_ROUTES and self.command == "POST":
                         with app.traced_request(path, raw_tp) as ctx:
                             self._trace_ctx = ctx
-                            handler()
+                            # visible to engine/batcher submissions on
+                            # this request thread (deadline-scope-style)
+                            with tenant_scope(self._tenant):
+                                handler()
                     else:
                         self._trace_ctx = telemetry.server_trace_context(raw_tp)
                         handler()
@@ -701,10 +767,18 @@ class ServingApp:
                         )
                         kind = query.get("kind", [None])[0]
                         rid = query.get("rid", [None])[0]
+                        tenant = query.get("tenant", [None])[0]
                     except (ValueError, IndexError) as exc:
                         self._send(422, {"error": f"bad query: {exc}"})
                         return
-                    self._send(200, app.debug_flight(n=n, kind=kind, rid=rid))
+                    self._send(200, app.debug_flight(
+                        n=n, kind=kind, rid=rid, tenant=tenant,
+                    ))
+                elif path == "/debug/usage":
+                    try:
+                        self._send(200, app.debug_usage())
+                    except ValueError as exc:
+                        self._send(422, {"error": str(exc)})
                 elif path == "/debug/trace":
                     fmt = query.get("format", ["chrome"])[0]
                     try:
@@ -734,6 +808,7 @@ class ServingApp:
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
                 self.send_header("X-Request-ID", self._rid)
+                self.send_header("X-Tenant-ID", self._tenant)
                 if self._trace_ctx is not None:
                     self.send_header(
                         "traceparent",

@@ -41,6 +41,11 @@ from unionml_tpu.serving.faults import (
     parse_deadline_header,
 )
 from unionml_tpu.serving.http import ServingApp
+from unionml_tpu.serving.usage import (
+    DEFAULT_TENANT,
+    tenant_scope,
+    validate_tenant,
+)
 
 
 class ObjectStore:
@@ -98,9 +103,15 @@ def gateway_handler(
     (:mod:`unionml_tpu.serving.http` / ``fastapi``):
 
     - ``GET /metrics`` — Prometheus exposition of the app's registry,
-    - ``GET /debug/trace?format=chrome|jsonl`` and ``GET /debug/slo``
-      — the trace export and SLO burn-rate report, same contract as
-      the HTTP transports,
+    - ``GET /debug/trace?format=chrome|jsonl``, ``GET /debug/slo``,
+      and ``GET /debug/usage`` — the trace export, SLO burn-rate
+      report, and per-tenant usage report, same contract as the HTTP
+      transports,
+    - tenant identity: an ``X-Tenant-ID`` request header is validated
+      (over 64 chars / non-printable → **422**, default
+      ``anonymous``), echoed on every response, and scoped around
+      ``POST /predict`` so engine/batcher usage ledgers bill the
+      request's resource vector to it,
     - every response carries ``X-Request-ID`` (the incoming header is
       echoed when the gateway forwarded one, else a fresh id is
       minted) and lands in the ``transport="serverless"`` request
@@ -134,6 +145,7 @@ def gateway_handler(
         # echoed on every response; /predict swaps in its recorded
         # server-span context below so callers stitch the full tree
         trace_ctx = telemetry.server_trace_context(raw_traceparent)
+        tenant = DEFAULT_TENANT
         t0 = time.perf_counter()
 
         def respond(
@@ -149,6 +161,7 @@ def gateway_handler(
                 "headers": {
                     "Content-Type": content_type,
                     "X-Request-ID": rid,
+                    "X-Tenant-ID": tenant,
                     "traceparent": telemetry.format_traceparent(trace_ctx),
                     **(extra or {}),
                 },
@@ -156,6 +169,9 @@ def gateway_handler(
             }
 
         try:
+            # validated at the boundary (422 via the ValueError arm
+            # below), echoed on every response like the HTTP transports
+            tenant = validate_tenant(headers.get("x-tenant-id"))
             if method == "GET" and path == "/":
                 return respond(200, app.root(), content_type="text/html")
             if method == "GET" and path == "/health":
@@ -180,6 +196,8 @@ def gateway_handler(
                 return respond(200, body_out, content_type=content_type)
             if method == "GET" and path == "/debug/slo":
                 return respond(200, json.dumps(app.debug_slo()))
+            if method == "GET" and path == "/debug/usage":
+                return respond(200, json.dumps(app.debug_usage()))
             if method == "POST" and path == "/predict":
                 payload = json.loads(event.get("body") or "{}")
                 deadline_ms = parse_deadline_header(
@@ -187,8 +205,11 @@ def gateway_handler(
                 )
                 with app.traced_request("/predict", raw_traceparent) as ctx:
                     trace_ctx = ctx
-                    with deadline_scope(deadline_ms):
-                        return respond(200, json.dumps(app.predict(payload)))
+                    with tenant_scope(tenant):
+                        with deadline_scope(deadline_ms):
+                            return respond(
+                                200, json.dumps(app.predict(payload))
+                            )
             return respond(
                 404, json.dumps({"error": f"no route {method} {path}"})
             )

@@ -964,9 +964,13 @@ class FlightRecorder:
         n: Optional[int] = None,
         kind: Optional[str] = None,
         rid: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> List[dict]:
         """The newest ``n`` retained events (all when ``None``), oldest
-        first; optionally filtered by ``kind`` and/or request id."""
+        first; optionally filtered by ``kind``, request id, and/or
+        tenant tag (engines/batchers stamp request lifecycle events
+        with the submitting tenant — the ``/debug/flight?tenant=``
+        postmortem filter)."""
         with self._lock:
             events = list(self._events)
         if kind is not None:
@@ -976,6 +980,8 @@ class FlightRecorder:
                 e for e in events
                 if e.get("rid") == rid or rid in e.get("rids", ())
             ]
+        if tenant is not None:
+            events = [e for e in events if e.get("tenant") == tenant]
         if n is not None:
             n = int(n)
             events = events[-n:] if n > 0 else []
