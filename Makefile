@@ -37,6 +37,7 @@ bench:
 bench-all: bench
 	python benchmarks/train_throughput.py
 	UNIONML_TPU_BENCH_PRESET=train_goodput python benchmarks/train_throughput.py
+	UNIONML_TPU_BENCH_PRESET=train_overlap python benchmarks/train_throughput.py
 	python benchmarks/serve_latency.py
 	UNIONML_TPU_BENCH_PRESET=serve_moe python benchmarks/serve_latency.py
 	UNIONML_TPU_BENCH_PRESET=serve_8b python benchmarks/serve_latency.py

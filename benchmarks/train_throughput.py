@@ -278,6 +278,10 @@ def goodput_leg() -> None:
         step_fn=make_step(), state=make_state(), stream=faulted_stream,
         checkpoint_dir=tempfile.mkdtemp(prefix="train-goodput-"),
         checkpoint_every=10, goodput=tracker,
+        # this leg asserts attribution with checkpoint stalls ON the
+        # loop (see docstring); the async backend's identity is what
+        # the train_overlap leg asserts
+        checkpoint_backend="sync",
     )
     rep = tracker.report()
     bad = rep["badput_s"]
@@ -452,15 +456,181 @@ def goodput_leg() -> None:
     }))
 
 
+def overlap_leg() -> None:
+    """``UNIONML_TPU_BENCH_PRESET=train_overlap``: the overlapped-training
+    stack (docs/performance.md "Overlapped training") measured against
+    its own serial twin on the SAME workload.
+
+    Two elastic-trainer runs over an identical paced, checkpointed,
+    gradient-accumulated stream:
+
+    - **off** — inline feed, synchronous checkpoint commits
+      (``checkpoint_backend="sync"``), serial accumulation;
+    - **on**  — ``double_buffer=True`` (threaded donated feed),
+      ``overlap_grads=True`` (deferred-consumption scan), async
+      background commits.
+
+    Asserted, not just reported: bit-identical final state (overlap is
+    scheduling, never numerics), the ``checkpoint`` + ``data_wait``
+    buckets shrinking and ``host_to_device`` draining to zero,
+    attribution ≥ 95% in BOTH modes, and overlap-on finishing faster —
+    the paced feed gives the on-leg a structural, not statistical,
+    wall-clock advantage.
+    """
+    import tempfile
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from flax import linen as nn
+
+    from unionml_tpu.elastic import run_elastic_trainer
+    from unionml_tpu.goodput import GoodputTracker
+    from unionml_tpu.models.train import classification_step, create_train_state
+    from unionml_tpu.telemetry import (
+        FlightRecorder, MetricsRegistry, TraceRecorder,
+    )
+
+    class _Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(2048)(x)
+            x = nn.relu(x)
+            return nn.Dense(4)(x)
+
+    net = _Net()
+    rng = np.random.default_rng(0)
+    n_steps, accum, micro = 40, 2, 32
+    # per-batch host production cost (loader/augment), sized BELOW the
+    # ~4 ms step so the threaded feed can fully hide it — overlap can
+    # only drain host cost up to the compute duration
+    pace_s = 0.003
+    batches = [
+        (
+            rng.normal(size=(accum * micro, 256)).astype(np.float32),
+            rng.integers(0, 4, size=(accum * micro,)).astype(np.int32),
+        )
+        for _ in range(n_steps)
+    ]
+
+    def stream(start_step):
+        for i in range(start_step, n_steps):
+            time.sleep(pace_s)  # the host-side cost the feed can overlap
+            yield batches[i]
+
+    # ONE step-function object for every run: _jitted caches per function
+    # identity, so the warm-up runs below can only warm the measured legs
+    # if they share this object (each mode still compiles its own
+    # executable under its overlap/donate cache key)
+    step_fn = classification_step(net, accumulate_steps=accum)
+
+    def run(overlap: bool):
+        reg = MetricsRegistry()
+        tracker = GoodputTracker(
+            registry=reg, tracer=TraceRecorder(registry=reg),
+            flight=FlightRecorder(),
+        )
+        state = create_train_state(
+            net, batches[0][0][:4], learning_rate=1e-2, seed=1
+        )
+        t0 = time.perf_counter()
+        state, steps = run_elastic_trainer(
+            step_fn=step_fn,
+            state=state, stream=stream,
+            checkpoint_dir=tempfile.mkdtemp(prefix="train-overlap-"),
+            checkpoint_every=5, batch_size=micro, accumulate_steps=accum,
+            checkpoint_backend="async" if overlap else "sync",
+            overlap_grads=overlap, double_buffer=overlap,
+            goodput=tracker,
+        )
+        wall = time.perf_counter() - t0
+        assert steps == n_steps, f"expected {n_steps} steps, ran {steps}"
+        return tracker.report(), state, wall
+
+    # warm the jit cache out of the comparison (both modes: serial and
+    # overlapped executables live under different cache keys)
+    run(False)
+    run(True)
+    off, state_off, wall_off = run(False)
+    on, state_on, wall_on = run(True)
+
+    # 1. loss parity: overlap must be a scheduling change only
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state_off.params),
+        jax.tree_util.tree_leaves(state_on.params),
+    ):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (
+            "overlap-on final state diverged from the serial run"
+        )
+
+    # 2. the three attacked buckets shrink
+    off_bad, on_bad = off["badput_s"], on["badput_s"]
+    assert on_bad["checkpoint"] < off_bad["checkpoint"], (
+        f"async commit did not shrink the checkpoint bucket: "
+        f"{on_bad['checkpoint']:.4f}s vs {off_bad['checkpoint']:.4f}s"
+    )
+    assert off_bad["data_wait"] >= n_steps * pace_s * 0.8, (
+        f"paced stream should dominate the off-leg data_wait bucket: "
+        f"{off_bad['data_wait']:.4f}s"
+    )
+    assert on_bad["data_wait"] < off_bad["data_wait"] * 0.5, (
+        f"threaded feed did not drain data_wait: "
+        f"{on_bad['data_wait']:.4f}s vs {off_bad['data_wait']:.4f}s"
+    )
+    assert on_bad["host_to_device"] == 0.0 < off_bad["host_to_device"], (
+        "threaded feed must take the device-put dispatch off the "
+        f"critical path: on={on_bad['host_to_device']:.4f}s "
+        f"off={off_bad['host_to_device']:.4f}s"
+    )
+
+    # 3. attribution identity holds in both modes
+    for name, rep in (("off", off), ("on", on)):
+        assert rep["attributed_fraction"] >= 0.95, (
+            f"{name}-leg attribution {rep['attributed_fraction']:.1%} "
+            "below the 95% bar"
+        )
+
+    # 4. the overlap pays for itself on wall clock (structural: the
+    # paced feed + commit I/O now run behind compute)
+    assert wall_on < wall_off, (
+        f"overlap-on slower than off: {wall_on:.3f}s vs {wall_off:.3f}s"
+    )
+
+    samples = n_steps * accum * micro
+    print(json.dumps({
+        "metric": "train_overlap_samples_per_sec",
+        "off": round(samples / wall_off, 1),
+        "value": round(samples / wall_on, 1),
+        "unit": "samples/sec",
+    }))
+    print(json.dumps({
+        "metric": "train_overlap_badput_deltas_s",
+        "value": {
+            cause: round(off_bad[cause] - on_bad[cause], 4)
+            for cause in ("checkpoint", "data_wait", "host_to_device")
+        },
+        "off_badput_s": off_bad,
+        "on_badput_s": on_bad,
+        "attributed_fraction": {
+            "off": off["attributed_fraction"],
+            "on": on["attributed_fraction"],
+        },
+        "loss_parity": "bit-identical",
+        "unit": "seconds saved per 40-step run",
+    }))
+
+
 if __name__ == "__main__":
-    if os.environ.get("UNIONML_TPU_BENCH_PRESET") == "train_goodput":
+    preset = os.environ.get("UNIONML_TPU_BENCH_PRESET")
+    if preset in ("train_goodput", "train_overlap"):
         if len(sys.argv) > 1:
             # hardcoded workload, same rule as the serve_latency legs
             raise SystemExit(
-                "UNIONML_TPU_BENCH_PRESET=train_goodput takes no CLI "
-                f"flags (got {sys.argv[1:]}); its fault-injected workload "
-                "is hardcoded in goodput_leg"
+                f"UNIONML_TPU_BENCH_PRESET={preset} takes no CLI "
+                f"flags (got {sys.argv[1:]}); its workload is hardcoded"
             )
-        goodput_leg()
+        goodput_leg() if preset == "train_goodput" else overlap_leg()
     else:
         main()

@@ -62,7 +62,10 @@ MAX_LINE = 110
 # repo-relative prefixes where time.time() is banned (monotonic-clock
 # territory: queue deadlines, latency splits, drain timers — and, since
 # the goodput layer, every trainer path whose durations feed badput
-# buckets: wall clock stepping under NTP would mis-attribute seconds)
+# buckets: wall clock stepping under NTP would mis-attribute seconds).
+# The checkpoint/ prefix covers async_writer.py: its save_ms/commit_ms
+# split IS the checkpoint badput attribution, so a wall-clock duration
+# there would corrupt the caller-stall vs background-commit story.
 WALL_CLOCK_BANNED = (
     "unionml_tpu/serving/",
     "unionml_tpu/execution.py",

@@ -9,6 +9,8 @@ import pytest
 # test-quick) so iteration/CI sharding get a <5-min spec-path pass
 pytestmark = pytest.mark.quick
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -100,6 +102,124 @@ def test_prefetch_keeps_buffer_in_flight():
     second = next(it)
     assert pulled == [0, 1, 2]
     assert [int(b[0]) for b in [second] + list(it)] == [1, 2, 3]
+
+
+def test_double_buffer_parity_and_order():
+    """Threaded feed yields the same batches in the same order as the
+    inline mode, with identical sharded placement (docs/performance.md
+    "Overlapped training")."""
+    cfg = ShardingConfig(data=2, fsdp=4)
+    batches = [
+        (np.full((8, 4), i, np.float32), np.full((8,), i, np.float32))
+        for i in range(7)
+    ]
+    out = list(
+        prefetch_to_device(iter(batches), sharding=cfg, double_buffer=True)
+    )
+    assert len(out) == 7
+    for i, (x, y) in enumerate(out):
+        assert float(x[0, 0]) == i and float(y[0]) == i
+        assert x.sharding.is_equivalent_to(cfg.batch_sharding(), x.ndim)
+
+
+def test_double_buffer_source_error_propagates():
+    def bad_source():
+        yield np.ones((4,), np.float32)
+        raise RuntimeError("loader died")
+
+    it = prefetch_to_device(bad_source(), double_buffer=True)
+    assert float(next(it)[0]) == 1.0
+    with pytest.raises(RuntimeError, match="loader died"):
+        list(it)
+
+
+def test_double_buffer_abandoned_consumer_stops_feeder():
+    """Closing the generator mid-stream must unblock and stop the
+    feeder thread — an abandoned feed cannot pin device buffers (or a
+    blocked thread) until process exit."""
+    import threading
+
+    # compare thread OBJECTS, not names: a leaked feeder from an earlier
+    # test would otherwise make the assertion vacuously pass
+    before = set(threading.enumerate())
+
+    def source():
+        for i in range(100):
+            yield np.full((4,), i, np.float32)
+
+    it = prefetch_to_device(source(), buffer_size=2, double_buffer=True)
+    assert float(next(it)[0]) == 0.0
+    it.close()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        alive = {
+            t for t in set(threading.enumerate()) - before
+            if t.name == "prefetch-feed" and t.is_alive()
+        }
+        if not alive:
+            break
+        time.sleep(0.01)
+    assert not alive, "prefetch feeder thread still alive after close()"
+
+
+def test_double_buffer_goodput_drains_dispatch_bucket():
+    """In threaded mode the device-put dispatch leaves the critical
+    path: host_to_device records nothing, and data_wait sees only true
+    starvation (the consumer actually waiting on the feeder)."""
+
+    class _Phases:
+        def __init__(self):
+            self.names = []
+
+        def phase(self, name):
+            import contextlib
+
+            self.names.append(name)
+            return contextlib.nullcontext()
+
+    tracker = _Phases()
+    batches = [np.full((4,), i, np.float32) for i in range(5)]
+    out = list(
+        prefetch_to_device(iter(batches), goodput=tracker, double_buffer=True)
+    )
+    assert len(out) == 5
+    assert set(tracker.names) == {"data_wait"}  # no host_to_device phases
+
+
+def test_double_buffer_trainer_donation_parity():
+    """run_step_trainer(double_buffer=True) — which donates the fed
+    batch buffers to the step — reaches the bitwise final state of the
+    plain run: every donated buffer was fresh, none reused stale."""
+    import jax as _jax
+    from flax import linen as nn
+
+    from unionml_tpu.execution import run_step_trainer
+    from unionml_tpu.models.train import classification_step, create_train_state
+
+    class _Mlp(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(nn.relu(nn.Dense(16)(x)))
+
+    module = _Mlp()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=(128,)).astype(np.int32)
+
+    def run(**kw):
+        return run_step_trainer(
+            step_fn=classification_step(module),
+            state=create_train_state(module, x[:4], learning_rate=1e-2, seed=1),
+            features=x, targets=y, batch_size=32, num_epochs=2, seed=9, **kw
+        )
+
+    base = run()
+    dbuf = run(double_buffer=True)  # donate_batch defaults on
+    for a, b in zip(
+        _jax.tree_util.tree_leaves(base.params),
+        _jax.tree_util.tree_leaves(dbuf.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_batch_pytree_placement():

@@ -624,9 +624,13 @@ def test_elastic_array_path_goodput(tmp_path):
     assert rep["goodput_s"] > 0
     # the checkpoint I/O series the badput bucket is attributed from
     # land in the SAME registry as the goodput series, not the global
-    # one — the manager is constructed with the tracker's registry
+    # one — the manager is constructed with the tracker's registry.
+    # The elastic trainer defaults to the async writer single-process:
+    # save_ms{kind=async} is the caller stall, commit_ms the background
+    # leg (docs/observability.md "Checkpoint I/O")
     snap = reg.snapshot()
-    assert snap["unionml_checkpoint_save_ms"]["kind=sharded"]["count"] >= 2
+    assert snap["unionml_checkpoint_save_ms"]["kind=async"]["count"] >= 2
+    assert snap["unionml_checkpoint_commit_ms"]["kind=async"]["count"] >= 2
 
 
 # -------------------------------------------------------- SLO coupling

@@ -8,11 +8,23 @@ The reference's artifact layer is joblib/torch.save per framework
   (flax serialization) for the Model.save/load path,
 - :mod:`unionml_tpu.checkpoint.sharded` — Orbax sharded checkpoints of
   params + optimizer state for mid-training checkpoint/resume on a mesh,
+- :mod:`unionml_tpu.checkpoint.async_writer` — framework-owned async
+  checkpointing: ``save`` stalls the caller for the device→host
+  snapshot only; the serialize/write/commit runs on a background
+  thread with an atomic rename + commit marker, so a kill mid-commit
+  always leaves the previous checkpoint restorable
+  (:func:`make_checkpoint_manager` picks async vs. Orbax per process
+  count and what's already on disk),
 - :mod:`unionml_tpu.checkpoint.registry` — "registry = execution history"
   semantics (version = app git SHA × run id, ``latest``-or-pinned;
   reference: unionml/remote.py:150-218).
 """
 
+from unionml_tpu.checkpoint.async_writer import (
+    AsyncCheckpointManager,
+    AsyncCheckpointWriter,
+    make_checkpoint_manager,
+)
 from unionml_tpu.checkpoint.pytree_io import load_pytree, save_pytree
 from unionml_tpu.checkpoint.sharded import CheckpointManager, restore_sharded, save_sharded
 
@@ -21,5 +33,8 @@ __all__ = [
     "load_pytree",
     "save_sharded",
     "restore_sharded",
+    "AsyncCheckpointManager",
+    "AsyncCheckpointWriter",
     "CheckpointManager",
+    "make_checkpoint_manager",
 ]

@@ -1,15 +1,22 @@
-"""Checkpoint I/O telemetry, shared by pytree_io and sharded.
+"""Checkpoint I/O telemetry, shared by pytree_io, sharded and async_writer.
 
-Every save/restore on either checkpoint path publishes
+Every save/restore on any checkpoint path publishes
 ``unionml_checkpoint_{save,restore}_ms{kind}`` histograms (the wall
-time the CALLER stalled — for the async :class:`CheckpointManager`
-that is the wait-for-previous-commit plus launch, exactly the piece
-that lands in the training loop's ``checkpoint`` badput bucket) and
-``unionml_checkpoint_{save,restore}_bytes_total{kind}`` counters
-(``kind="pytree"`` for the single-file msgpack artifact,
-``kind="sharded"`` for Orbax). The series feed the goodput layer
-(docs/observability.md "Training goodput") and give ROADMAP's
-async-checkpoint work a before/after yardstick.
+time the CALLER stalled — for the async managers that is the
+wait-for-previous-commit plus the device→host snapshot/launch, exactly
+the piece that lands in the training loop's ``checkpoint`` badput
+bucket) and ``unionml_checkpoint_{save,restore}_bytes_total{kind}``
+counters (``kind="pytree"`` for the single-file msgpack artifact,
+``kind="sharded"`` for Orbax, ``kind="async"`` for the background
+commit writer). The async writer's background leg gets its own
+series — ``unionml_checkpoint_commit_ms{kind}`` (serialize + write +
+atomic rename, off the critical path) and the
+``unionml_checkpoint_pending`` gauge (launched commits not yet
+durable) — so save_ms can honestly shrink to the caller stall without
+the disk cost disappearing from the scrape. The series feed the
+goodput layer (docs/observability.md "Training goodput") and give the
+overlapped-training work (docs/performance.md "Overlapped training")
+its before/after yardstick.
 """
 
 from __future__ import annotations
@@ -22,16 +29,28 @@ from unionml_tpu import telemetry
 def checkpoint_metrics(
     registry: Optional[telemetry.MetricsRegistry] = None,
 ) -> dict:
-    """The four checkpoint I/O families on ``registry`` (default: the
+    """The checkpoint I/O families on ``registry`` (default: the
     process-global one), keyed ``save_ms`` / ``restore_ms`` /
-    ``save_bytes`` / ``restore_bytes``."""
+    ``save_bytes`` / ``restore_bytes`` / ``commit_ms`` / ``pending``."""
     reg = registry if registry is not None else telemetry.get_registry()
     return {
         "save_ms": reg.histogram(
             "unionml_checkpoint_save_ms",
             "Caller-visible checkpoint save stall (async managers: wait "
-            "for the previous commit + snapshot/launch).",
+            "for the previous commit + device->host snapshot/launch; the "
+            "background disk leg is unionml_checkpoint_commit_ms).",
             ("kind",),
+        ),
+        "commit_ms": reg.histogram(
+            "unionml_checkpoint_commit_ms",
+            "Background commit leg of an async save: serialize + write + "
+            "atomic rename, overlapped with training steps.",
+            ("kind",),
+        ),
+        "pending": reg.gauge(
+            "unionml_checkpoint_pending",
+            "Launched async checkpoint commits not yet durable (a crash "
+            "now loses only these; the previous commit stays restorable).",
         ),
         "restore_ms": reg.histogram(
             "unionml_checkpoint_restore_ms",

@@ -7,6 +7,10 @@ training loop consumes an iterator whose batches are already resident in
 HBM: ``prefetch_to_device`` keeps ``buffer_size`` batches in flight so the
 host→device DMA of batch N+1 overlaps the compute of batch N — JAX
 dispatch is async, so a buffer of 2 suffices to hide transfer latency.
+``double_buffer=True`` goes further and runs the whole feed (host batch
+pull + transfer dispatch) on a background thread, so even the host-side
+cost overlaps compute and the yielded buffers are safe to donate to the
+step (docs/performance.md "Overlapped training").
 
 When a :class:`~unionml_tpu.parallel.ShardingConfig` is given, each batch
 is placed with its data-axis NamedSharding. Multi-host execution
@@ -25,6 +29,8 @@ from __future__ import annotations
 
 import collections
 import itertools
+import queue as queue_mod
+import threading
 from typing import Any, Iterable, Iterator
 
 
@@ -143,6 +149,7 @@ def prefetch_to_device(
     sharding: Any = None,
     device: Any = None,
     goodput: Any = None,
+    double_buffer: bool = False,
 ) -> Iterator[Any]:
     """Yield device-resident batches, keeping ``buffer_size`` in flight.
 
@@ -158,7 +165,37 @@ def prefetch_to_device(
     *dispatch*; the DMA itself overlaps compute, which is the point of
     the prefetch — a transfer the compute had to wait on shows up as
     compute time, not here).
+
+    ``double_buffer=True`` moves the whole feed — host-batch pull AND
+    device-transfer dispatch — onto a background thread
+    (docs/performance.md "Overlapped training"): while the current step
+    runs, the feeder is already assembling and dispatching the next
+    batch's host→device copy, so the consumer normally finds a
+    device-resident batch waiting. Batch ORDER is identical to the
+    synchronous mode, each yielded array is fresh (safe to donate to
+    the step — no buffer is ever yielded twice), and a raising source
+    re-raises in the consumer. Goodput accounting changes shape
+    honestly: the feeder records nothing (its work overlaps compute by
+    construction), and only the consumer's wait for a ready batch —
+    true starvation, the feeder fell behind — lands in ``data_wait``;
+    the ``host_to_device`` bucket drains to zero because the dispatch
+    left the critical path.
     """
+    if double_buffer:
+        return _threaded_prefetch(
+            iterator, buffer_size=max(2, buffer_size), sharding=sharding,
+            device=device, goodput=goodput,
+        )
+    return _inline_prefetch(
+        iterator, buffer_size=buffer_size, sharding=sharding,
+        device=device, goodput=goodput,
+    )
+
+
+def _inline_prefetch(
+    iterator: Iterable[Any], *, buffer_size: int, sharding: Any,
+    device: Any, goodput: Any,
+) -> Iterator[Any]:
     feed = DeviceFeed(sharding=sharding, device=device)
     queue: collections.deque = collections.deque()
     it = iter(iterator)
@@ -180,3 +217,63 @@ def prefetch_to_device(
     while queue:
         yield queue.popleft()
         enqueue(1)
+
+
+class _FeedError:
+    """Producer-side failure envelope: re-raised at the consumer's next
+    pull, so a raising data source behaves like the inline mode."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def _threaded_prefetch(
+    iterator: Iterable[Any], *, buffer_size: int, sharding: Any,
+    device: Any, goodput: Any,
+) -> Iterator[Any]:
+    feed = DeviceFeed(sharding=sharding, device=device)
+    q: queue_mod.Queue = queue_mod.Queue(maxsize=buffer_size)
+    stop = threading.Event()
+
+    def offer(item: Any) -> bool:
+        # bounded put that notices consumer abandonment: an abandoned
+        # generator must not leave the feeder blocked forever (pinning
+        # device buffers until process exit)
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def producer() -> None:
+        try:
+            for item in iterator:
+                if not offer(feed.put(item)):
+                    return
+            offer(_EXHAUSTED)
+        except BaseException as exc:  # re-raised at the consumer
+            offer(_FeedError(exc))
+
+    thread = threading.Thread(
+        target=producer, name="prefetch-feed", daemon=True
+    )
+    thread.start()
+    try:
+        while True:
+            if goodput is None:
+                item = q.get()
+            else:
+                # only TRUE starvation lands in data_wait: the feeder
+                # fell behind and the step loop is actually waiting
+                with goodput.phase("data_wait"):
+                    item = q.get()
+            if item is _EXHAUSTED:
+                return
+            if isinstance(item, _FeedError):
+                raise item.exc
+            yield item
+    finally:
+        stop.set()
+        thread.join(timeout=5.0)

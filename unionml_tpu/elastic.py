@@ -16,18 +16,33 @@ checkpoint-based restart a framework primitive:
 - a killed-and-restarted run reaches the bit-identical final state of an
   uninterrupted run (tested by fault injection in
   tests/unit/test_diagnostics.py).
+
+Checkpointing is **async by default**
+(:func:`~unionml_tpu.checkpoint.make_checkpoint_manager`): ``save``
+stalls the loop for the device→host snapshot only, the serialize/
+write/commit overlaps the following steps on a background thread, and
+restore refuses torn checkpoints — a kill mid-commit resumes from the
+previous complete step. Batches flow through
+:func:`~unionml_tpu.data.pipeline.prefetch_to_device` (the
+``double_buffer`` knob moves the whole feed onto a background thread),
+and ``overlap_grads`` overlaps the gradient all-reduce with backward
+compute — the same overlapped-training surface as
+:func:`~unionml_tpu.execution.run_step_trainer`
+(docs/performance.md "Overlapped training").
 """
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import numpy as np
 
 from unionml_tpu._logging import logger
-from unionml_tpu.checkpoint.sharded import CheckpointManager
+from unionml_tpu.checkpoint.async_writer import make_checkpoint_manager
 from unionml_tpu.data.native import BatchLoader
+from unionml_tpu.data.pipeline import prefetch_to_device
 from unionml_tpu.goodput import phase_scope as _phase
 
 
@@ -51,9 +66,13 @@ def run_elastic_trainer(
     seed: int = 0,
     checkpoint_every: int = 100,
     max_to_keep: int = 3,
+    checkpoint_backend: str = "auto",
     sharding: Any = None,
     donate_state: bool = True,
     accumulate_steps: int = 1,
+    overlap_grads: bool = False,
+    double_buffer: bool = False,
+    donate_batch: Optional[bool] = None,
     fault_hook: Optional[Callable[[int], None]] = None,
     goodput: Any = None,
 ) -> Tuple[Any, int]:
@@ -74,6 +93,25 @@ def run_elastic_trainer(
     Global step indexes the stream ``epoch * steps_per_epoch + batch``;
     checkpoints are written under ``checkpoint_dir/step_{global_step}``
     where the state has already consumed batch ``global_step - 1``.
+
+    **Checkpointing** is async by default: ``checkpoint_backend``
+    ("auto" / "async" / "orbax" / "sync") picks the
+    :class:`~unionml_tpu.checkpoint.AsyncCheckpointManager` (host
+    snapshot is the only save stall; background commit with atomic
+    rename + commit marker; restore refuses torn checkpoints) or the
+    Orbax sharded manager (multi-process meshes, or a ``checkpoint_dir``
+    that already holds Orbax-format steps).
+
+    **Overlapped training** (docs/performance.md "Overlapped
+    training"): ``overlap_grads=True`` overlaps the dp/fsdp gradient
+    all-reduce of microbatch *i* with the backward of microbatch *i+1*
+    (loss trajectories bit-identical to the serial accumulate);
+    ``double_buffer=True`` feeds batches from a background thread —
+    host pull + device-transfer dispatch off the critical path — and
+    donates the fed buffers to the step (``donate_batch=False`` opts
+    out). Both compose with replay-after-preemption: the feed is
+    rebuilt from the deterministic ``(seed, epoch)`` order on resume,
+    so donated buffers are always fresh.
 
     **Streaming sources** (the execution.py streaming-trainer contract,
     made resumable): pass ``stream`` instead of ``arrays`` — a callable
@@ -96,8 +134,12 @@ def run_elastic_trainer(
     **Goodput accounting**: ``goodput=True`` (or a
     :class:`~unionml_tpu.goodput.GoodputTracker`) attributes the
     loop's wall time (docs/observability.md "Training goodput") —
-    jitted compute, ``data_wait`` on the batch source, ``checkpoint``
-    for the save stall on the critical path, and ``preemption`` for
+    jitted compute (including the trailing ``block_until_ready``
+    drain, so overlapped transfers are never misattributed to
+    ``data_wait``), ``data_wait`` on the batch feed, ``checkpoint``
+    for the save stall on the critical path (with the async manager:
+    snapshot only — the background commit publishes
+    ``unionml_checkpoint_commit_ms`` instead), and ``preemption`` for
     the restore + replay cost of resuming after a kill: the price of
     the preemption, measured, so "how much did that eviction cost us"
     stops being a guess.
@@ -107,6 +149,18 @@ def run_elastic_trainer(
     if accumulate_steps < 1:
         raise ValueError(f"accumulate_steps must be >= 1, got {accumulate_steps}")
     feed_rows = batch_size * accumulate_steps
+    from unionml_tpu.execution import resolve_grad_overlap, to_microbatches
+    # imported BEFORE tracker.start(): the first import of models.train
+    # is tens of ms of cold module loading — setup cost, not training
+    # wall time the goodput identity should have to explain
+    from unionml_tpu.models.train import grad_overlap_scope
+
+    overlap = (
+        resolve_grad_overlap(sharding, accumulate_steps)
+        if overlap_grads else None
+    )
+    if donate_batch is None:
+        donate_batch = double_buffer
     if accumulate_steps > 1 and sharding is not None:
         sharding = sharding.microbatched()
     tracker = None
@@ -116,22 +170,28 @@ def run_elastic_trainer(
         tracker = (
             goodput if isinstance(goodput, GoodputTracker) else GoodputTracker()
         )
-        tracker.start()
 
     if sharding is not None:
         from unionml_tpu.parallel import compile_step
 
-        step, state = compile_step(step_fn, state, sharding=sharding, donate_state=donate_state)
+        step, state = compile_step(
+            step_fn, state, sharding=sharding, donate_state=donate_state,
+            donate_batch=donate_batch,
+        )
     else:
         from unionml_tpu.execution import _jitted
 
-        step = _jitted(step_fn, donate_state)
+        step = _jitted(step_fn, donate_state, donate_batch, overlap)
 
     if tracker is not None:
-        # compile-event detection on the jitted step (must wrap BEFORE
-        # the accumulation shim below — the shim is a plain callable the
-        # tracker could only observe opaquely): recompiles debit the
-        # goodput compute bucket into the `compile` badput cause
+        # the wall window opens AFTER step construction, matching
+        # run_step_trainer: compile_step's eager placement is build-time
+        # setup, not loop wall time the identity must explain (first-call
+        # jit compiles ARE in-window, debited to `compile` by the
+        # ProgramTracker below; restore/replay lands in `preemption`)
+        tracker.start()
+        # compile-event detection on the jitted step: recompiles debit
+        # the goodput compute bucket into the `compile` badput cause
         from unionml_tpu.introspection import ProgramTracker
 
         step = ProgramTracker(
@@ -139,24 +199,31 @@ def run_elastic_trainer(
             on_compile=tracker.note_compile_ms,
         ).wrap("trainer.elastic_step", step)
 
+    # shared feeding contract with run_step_trainer: microbatch reshape
+    # happens HOST-side in the feed (so prefetch placement sees the final
+    # step shape), with to_microbatches' clear error on wrong leading dims
     if accumulate_steps > 1:
-        from unionml_tpu.execution import to_microbatches
+        def prepare(batch: Any) -> Any:
+            return to_microbatches(batch, accumulate_steps, batch_size)
+    else:
+        def prepare(batch: Any) -> Any:
+            return batch
 
-        _inner = step
-
-        def step(state, batch, _inner=_inner):  # noqa: F811
-            # shared feeding contract with run_step_trainer: clear error
-            # on wrong leading dims (e.g. an un-accumulated stream)
-            micro = to_microbatches(batch, accumulate_steps, batch_size)
-            return _inner(state, micro)
+    overlap_ctx = (
+        grad_overlap_scope(overlap) if overlap is not None
+        else contextlib.nullcontext()
+    )
 
     if stream is not None:
-        return _run_stream(
-            step, state, stream,
-            checkpoint_dir=checkpoint_dir, num_steps=num_steps,
-            checkpoint_every=checkpoint_every, max_to_keep=max_to_keep,
-            fault_hook=fault_hook, tracker=tracker,
-        )
+        with overlap_ctx:
+            return _run_stream(
+                step, state, stream,
+                checkpoint_dir=checkpoint_dir, num_steps=num_steps,
+                checkpoint_every=checkpoint_every, max_to_keep=max_to_keep,
+                checkpoint_backend=checkpoint_backend,
+                fault_hook=fault_hook, tracker=tracker, prepare=prepare,
+                sharding=sharding, double_buffer=double_buffer,
+            )
 
     loader = BatchLoader(
         list(arrays), batch_size=feed_rows, seed=seed, shuffle=True,
@@ -175,8 +242,8 @@ def run_elastic_trainer(
     # checkpoint I/O series belong in the same scrape as the goodput
     # buckets they feed (a tracker with a private registry would
     # otherwise watch unionml_checkpoint_save_ms accrue globally)
-    manager = CheckpointManager(
-        checkpoint_dir, max_to_keep=max_to_keep,
+    manager = make_checkpoint_manager(
+        checkpoint_dir, max_to_keep=max_to_keep, backend=checkpoint_backend,
         registry=tracker.registry if tracker is not None else None,
     )
     global_step = 0
@@ -192,34 +259,48 @@ def run_elastic_trainer(
     single = len(arrays) == 1
     try:
         start_epoch, start_batch = divmod(global_step, steps_per_epoch)
-        batches = iter(loader.epochs(
-            num_epochs, start_epoch=start_epoch, start_batch=start_batch
-        ))
-        while True:
-            with _phase(tracker, "data_wait"):
-                item = next(batches, _STREAM_END)
-            if item is _STREAM_END:
-                break
-            _epoch, _idx, batch = item
-            t_step = time.perf_counter()
-            with _phase(tracker, "compute"):
-                state, _metrics = step(state, batch[0] if single else batch)
-            if tracker is not None:
-                tracker.step_complete(time.perf_counter() - t_step)
-            global_step += 1
-            if global_step % checkpoint_every == 0 or global_step == total_steps:
-                # async save: device->host snapshot happens before save()
-                # returns (so donation of state buffers by the next step is
-                # safe); the disk write overlaps the following steps
-                with _phase(tracker, "checkpoint"):
-                    manager.save(global_step, state)
-            if fault_hook is not None:
-                fault_hook(global_step)
+
+        def host_batches():
+            for _epoch, _idx, batch in loader.epochs(
+                num_epochs, start_epoch=start_epoch, start_batch=start_batch
+            ):
+                yield prepare(batch[0] if single else batch)
+
+        with overlap_ctx:
+            feed = prefetch_to_device(
+                host_batches(), sharding=sharding, goodput=tracker,
+                double_buffer=double_buffer,
+            )
+            with contextlib.closing(feed):
+                for batch in feed:
+                    t_step = time.perf_counter()
+                    with _phase(tracker, "compute"):
+                        state, _metrics = step(state, batch)
+                    if tracker is not None:
+                        tracker.step_complete(time.perf_counter() - t_step)
+                    global_step += 1
+                    if global_step % checkpoint_every == 0 or global_step == total_steps:
+                        # async save: the device->host snapshot happens
+                        # before save() returns (so donation of state
+                        # buffers by the next step is safe); serialize +
+                        # disk write + commit overlap the following steps
+                        with _phase(tracker, "checkpoint"):
+                            manager.save(global_step, state)
+                    if fault_hook is not None:
+                        fault_hook(global_step)
+        # the trailing drain is device compute still in flight — it must
+        # land in the compute bucket even in overlap mode (an overlapped
+        # transfer the compute waited on is compute, not data_wait)
+        import jax
+
+        with _phase(tracker, "compute"):
+            jax.block_until_ready(state)
     finally:
         loader.close()
-        # a preemption mid-write leaves only an uncommitted tmp dir (orbax
-        # renames atomically); close() waits for the final checkpoint to
-        # commit and releases the async checkpointer's worker threads
+        # a kill mid-commit leaves only an uncommitted tmp dir (atomic
+        # rename); close() drains the background commit and releases the
+        # writer thread — best-effort, so a checkpoint failure in the
+        # drain never masks the exception that ended the loop
         with _phase(tracker, "checkpoint"):
             manager.close()
         if tracker is not None:
@@ -238,14 +319,18 @@ def _run_stream(
     num_steps: Optional[int],
     checkpoint_every: int,
     max_to_keep: int,
+    checkpoint_backend: str = "auto",
     fault_hook: Optional[Callable[[int], None]],
     tracker: Any = None,
+    prepare: Callable[[Any], Any] = lambda batch: batch,
+    sharding: Any = None,
+    double_buffer: bool = False,
 ) -> Tuple[Any, int]:
     """Step-indexed resumable loop over a streaming batch source."""
     import inspect
 
-    manager = CheckpointManager(
-        checkpoint_dir, max_to_keep=max_to_keep,
+    manager = make_checkpoint_manager(
+        checkpoint_dir, max_to_keep=max_to_keep, backend=checkpoint_backend,
         registry=tracker.registry if tracker is not None else None,
     )
     global_step = 0
@@ -287,35 +372,13 @@ def _run_stream(
     trained = 0
     try:
         it = iter(batches)
-        exhausted = False
-        while True:
-            # replay skip: producing the already-consumed batches again
-            # is preemption badput, not data starvation
-            with _phase(tracker, "preemption" if skip else "data_wait"):
+        # eager replay skip: producing the already-consumed batches again
+        # is preemption badput, not data starvation — and doing it BEFORE
+        # the prefetch feed starts keeps skipped batches off the device
+        while skip:
+            with _phase(tracker, "preemption"):
                 batch = next(it, _STREAM_END)
             if batch is _STREAM_END:
-                exhausted = True
-                break
-            if skip:
-                skip -= 1
-                continue
-            t_step = time.perf_counter()
-            with _phase(tracker, "compute"):
-                state, _metrics = step(state, batch)
-            if tracker is not None:
-                tracker.step_complete(time.perf_counter() - t_step)
-            global_step += 1
-            trained += 1
-            at_bound = num_steps is not None and global_step >= num_steps
-            if global_step % checkpoint_every == 0 or at_bound:
-                with _phase(tracker, "checkpoint"):
-                    manager.save(global_step, state)
-            if fault_hook is not None:
-                fault_hook(global_step)
-            if at_bound:
-                break
-        if exhausted:
-            if skip:
                 # the replayed stream ended BEFORE the resume position:
                 # returning "finished" would silently bless a truncated or
                 # non-deterministic source
@@ -324,6 +387,41 @@ def _run_stream(
                     f"position (step {global_step}): the replayed stream "
                     "must reproduce at least the batches already consumed"
                 )
+            skip -= 1
+
+        def host_batches():
+            for batch in it:
+                yield prepare(batch)
+
+        exhausted = True
+        feed = prefetch_to_device(
+            host_batches(), sharding=sharding, goodput=tracker,
+            double_buffer=double_buffer,
+        )
+        with contextlib.closing(feed):
+            for batch in feed:
+                t_step = time.perf_counter()
+                with _phase(tracker, "compute"):
+                    state, _metrics = step(state, batch)
+                if tracker is not None:
+                    tracker.step_complete(time.perf_counter() - t_step)
+                global_step += 1
+                trained += 1
+                at_bound = num_steps is not None and global_step >= num_steps
+                if global_step % checkpoint_every == 0 or at_bound:
+                    with _phase(tracker, "checkpoint"):
+                        manager.save(global_step, state)
+                if fault_hook is not None:
+                    fault_hook(global_step)
+                if at_bound:
+                    exhausted = False
+                    break
+        import jax
+
+        # trailing drain = in-flight device compute (see run_step_trainer)
+        with _phase(tracker, "compute"):
+            jax.block_until_ready(state)
+        if exhausted:
             # stream exhausted: persist the terminal position so a restart
             # resumes AFTER the last consumed batch instead of re-training
             # — unless nothing ran since resume (the state is unchanged and

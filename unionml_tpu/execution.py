@@ -71,14 +71,32 @@ def _publish_loss(metrics: Any, gauge: Any) -> None:
 
 
 @functools.lru_cache(maxsize=128)
-def _jitted(fn: Callable, donate_state: bool):
+def _jitted(
+    fn: Callable,
+    donate_state: bool,
+    donate_batch: bool = False,
+    overlap: Any = None,
+):
     """Per-function jit cache (bounded: entries pin user closures + XLA
     executables, which can be large for big models). Interactive sessions
     that re-define step functions churn entries that pin executables until
-    eviction — call :func:`clear_jit_cache` to drop them eagerly."""
+    eviction — call :func:`clear_jit_cache` to drop them eagerly.
+
+    ``donate_batch`` donates the batch argument too (the double-buffer
+    prefetch contract: every fed batch is a fresh device buffer consumed
+    exactly once, so XLA may recycle it for step temporaries — HBM holds
+    the in-flight batches, not the consumed ones). ``overlap`` (a
+    :class:`~unionml_tpu.models.train.GradOverlap` or None) is part of
+    the cache key ONLY: the overlap strategy is read at trace time from
+    the ambient :func:`~unionml_tpu.models.train.grad_overlap_scope`,
+    and keying on it keeps serial and overlapped executables from
+    aliasing when the same step function is trained both ways."""
     import jax
 
-    return jax.jit(fn, donate_argnums=(0,) if donate_state else ())
+    donate = (0,) if donate_state else ()
+    if donate_batch:
+        donate = donate + (1,)
+    return jax.jit(fn, donate_argnums=donate)
 
 
 def clear_jit_cache() -> None:
@@ -95,6 +113,42 @@ def jit_predictor(fn: Callable) -> Callable:
     shape/dtype polymorphism across calls.
     """
     return _jitted(fn, False)
+
+
+def resolve_grad_overlap(sharding: Any, accumulate_steps: int) -> Any:
+    """The :class:`~unionml_tpu.models.train.GradOverlap` strategy for a
+    trainer run with ``overlap_grads=True`` — ONE selection rule shared
+    by :func:`run_step_trainer` and the elastic trainer.
+
+    - ``accumulate_steps == 1``: None (no microbatch pipeline exists to
+      overlap; the step is one fused forward/backward).
+    - pure data parallelism (every mesh axis but ``data`` trivial, no
+      partition rules): ``mode="shard_map"`` — the scan runs under
+      ``shard_map`` and issues explicit deferred
+      :func:`~unionml_tpu.parallel.collectives.bucketed_psum` chunks.
+    - anything else (fsdp/tensor/… sharded params, or no mesh at all):
+      ``mode="defer"`` — GSPMD keeps inserting the collectives and the
+      scan defers their consumption one microbatch, the structure
+      XLA's collective pipeliner hides latency in.
+    """
+    from unionml_tpu.models.train import GradOverlap
+
+    if accumulate_steps <= 1:
+        logger.info(
+            "overlap_grads: accumulate_steps=1 has no microbatch "
+            "pipeline to overlap — running the serial step"
+        )
+        return None
+    if sharding is None:
+        return GradOverlap(mode="defer")
+    mesh = sharding.mesh()
+    model_axes = {
+        name: size for name, size in dict(mesh.shape).items()
+        if name != "data" and size > 1
+    }
+    if not model_axes and not tuple(sharding.rules) and mesh.shape.get("data", 1) > 1:
+        return GradOverlap(mode="shard_map", mesh=mesh, axes=("data",))
+    return GradOverlap(mode="defer")
 
 
 def _num_examples(features: Any) -> int:
@@ -185,6 +239,9 @@ def run_step_trainer(
     sharding: Any = None,
     donate_state: bool = True,
     accumulate_steps: int = 1,
+    overlap_grads: bool = False,
+    double_buffer: bool = False,
+    donate_batch: Optional[bool] = None,
     profile_dir: Optional[str] = None,
     registry: Optional[Any] = None,
     goodput: Any = None,
@@ -248,6 +305,26 @@ def run_step_trainer(
     spans, the step-time regression detector, and (every
     ``skew_every`` steps under ``jax.process_count() > 1``) per-host
     step-skew gauges with straggler flight events.
+
+    **Overlapped training** (docs/performance.md "Overlapped
+    training"): ``overlap_grads=True`` restructures the gradient
+    accumulation so the dp/fsdp all-reduce of microbatch *i* overlaps
+    the backward of microbatch *i+1* (:func:`resolve_grad_overlap`
+    picks the shard_map bucketed-psum or GSPMD deferred-consumption
+    form; loss trajectories stay bit-identical to the serial scan —
+    no-op at ``accumulate_steps=1`` or for steps not built on
+    :func:`~unionml_tpu.models.train.accumulated_value_and_grad`).
+    ``double_buffer=True`` moves the whole data feed (host batch pull
+    + device transfer dispatch) to a background thread, draining the
+    ``data_wait``/``host_to_device`` badput buckets, and — unless
+    ``donate_batch=False`` — donates the fed batch buffers to the step
+    so prefetch depth does not double batch HBM. Donation is only
+    unsafe for sources that YIELD already-device-resident arrays they
+    retain (the feed would hand the same buffer to the step twice);
+    host-side sources (numpy arrays, loaders, generators) are always
+    safe. In overlap mode the trailing ``block_until_ready`` drain
+    still lands in the ``compute`` bucket — overlapped transfers are
+    never misattributed to ``data_wait``.
     """
     import jax
 
@@ -270,6 +347,12 @@ def run_step_trainer(
     if accumulate_steps < 1:
         raise ValueError(f"accumulate_steps must be >= 1, got {accumulate_steps}")
     feed_rows = batch_size * accumulate_steps
+    overlap = (
+        resolve_grad_overlap(sharding, accumulate_steps)
+        if overlap_grads else None
+    )
+    if donate_batch is None:
+        donate_batch = double_buffer
     if accumulate_steps > 1:
         if not streaming and n < feed_rows:
             raise ValueError(
@@ -286,10 +369,11 @@ def run_step_trainer(
         from unionml_tpu.parallel import compile_step
 
         step, state = compile_step(
-            step_fn, state, sharding=sharding, donate_state=donate_state
+            step_fn, state, sharding=sharding,
+            donate_state=donate_state, donate_batch=donate_batch,
         )
     else:
-        step = _jitted(step_fn, donate_state)
+        step = _jitted(step_fn, donate_state, donate_batch, overlap)
 
     from unionml_tpu.data.pipeline import prefetch_to_device
 
@@ -397,21 +481,34 @@ def run_step_trainer(
         on_compile=tracker.note_compile_ms if tracker is not None else None,
     ).wrap("trainer.step", step)
 
+    # the overlap scope must be open while the loop runs: jit traces the
+    # step at its FIRST call, and accumulated_value_and_grad reads the
+    # ambient GradOverlap at trace time. Imported BEFORE tracker.start():
+    # a cold models.train import is tens of ms of setup the goodput
+    # identity should not have to explain
+    from unionml_tpu.models.train import grad_overlap_scope
+
     timer = StepTimer()
     steps = 0
     metrics = None
     if tracker is not None:
         tracker.start()
     ctx = trace(profile_dir) if profile_dir else contextlib.nullcontext()
+    overlap_ctx = (
+        grad_overlap_scope(overlap) if overlap is not None
+        else contextlib.nullcontext()
+    )
     # finish() must run on the exception path too (mirrors elastic.py):
     # a raising stream would otherwise leave the trainer trace timeline
     # open forever, and a retry with the same tracker would count the
     # crash-to-retry gap as unattributed wall time
+    feed = prefetch_to_device(
+        host_batches(), sharding=sharding, goodput=tracker,
+        double_buffer=double_buffer,
+    )
     try:
-        with ctx:
-            for batch in prefetch_to_device(
-                host_batches(), sharding=sharding, goodput=tracker
-            ):
+        with ctx, overlap_ctx, contextlib.closing(feed):
+            for batch in feed:
                 t_step = time.perf_counter()
                 with phase_scope(tracker, "compute"):
                     state, metrics = step(state, batch)

@@ -301,9 +301,13 @@ class Model(TrackedInstance):
         sharding: Any = None,
         donate_state: bool = True,
         accumulate_steps: int = 1,
+        overlap_grads: bool = False,
+        double_buffer: bool = False,
+        donate_batch: Optional[bool] = None,
         checkpoint_dir: Optional[str] = None,
         save_every: int = 100,
         max_checkpoints: int = 3,
+        checkpoint_backend: str = "auto",
         goodput: Any = None,
         measure_device_time: bool = False,
         **train_task_kwargs,
@@ -323,6 +327,15 @@ class Model(TrackedInstance):
         ``accumulate_steps`` or
         :func:`unionml_tpu.models.train.accumulated_value_and_grad`).
         The HBM knob for effective batch at long context.
+
+        ``overlap_grads`` / ``double_buffer`` / ``donate_batch``
+        (docs/performance.md "Overlapped training"): overlap the
+        gradient all-reduce of microbatch *i* with the backward of
+        *i+1* (loss-trajectory-identical to the serial accumulate),
+        move the data feed — host batch pull + device-transfer
+        dispatch — onto a background thread, and donate the fed batch
+        buffers to the step. All three plumb through to whichever
+        trainer loop the route below synthesizes.
 
         ``goodput``: training goodput accounting
         (docs/observability.md "Training goodput") — ``True`` or a
@@ -355,8 +368,11 @@ class Model(TrackedInstance):
             return lambda f: self.train_step(
                 f, sharding=sharding, donate_state=donate_state,
                 accumulate_steps=accumulate_steps,
+                overlap_grads=overlap_grads, double_buffer=double_buffer,
+                donate_batch=donate_batch,
                 checkpoint_dir=checkpoint_dir, save_every=save_every,
-                max_checkpoints=max_checkpoints, goodput=goodput,
+                max_checkpoints=max_checkpoints,
+                checkpoint_backend=checkpoint_backend, goodput=goodput,
                 measure_device_time=measure_device_time,
                 **train_task_kwargs
             )
@@ -366,9 +382,13 @@ class Model(TrackedInstance):
             "sharding": sharding,
             "donate_state": donate_state,
             "accumulate_steps": accumulate_steps,
+            "overlap_grads": overlap_grads,
+            "double_buffer": double_buffer,
+            "donate_batch": donate_batch,
             "checkpoint_dir": checkpoint_dir,
             "save_every": save_every,
             "max_checkpoints": max_checkpoints,
+            "checkpoint_backend": checkpoint_backend,
             "goodput": goodput,
             "measure_device_time": measure_device_time,
         }
@@ -410,11 +430,15 @@ class Model(TrackedInstance):
                     checkpoint_dir=checkpoint_dir,
                     checkpoint_every=opts.get("save_every", 100),
                     max_to_keep=opts.get("max_checkpoints", 3),
+                    checkpoint_backend=opts.get("checkpoint_backend", "auto"),
                     batch_size=batch_size,
                     seed=seed,
                     sharding=opts.get("sharding"),
                     donate_state=opts.get("donate_state", True),
                     accumulate_steps=opts.get("accumulate_steps", 1),
+                    overlap_grads=opts.get("overlap_grads", False),
+                    double_buffer=opts.get("double_buffer", False),
+                    donate_batch=opts.get("donate_batch"),
                     goodput=opts.get("goodput"),
                 )
                 if is_stream(features):
@@ -466,6 +490,9 @@ class Model(TrackedInstance):
                 sharding=opts.get("sharding"),
                 donate_state=opts.get("donate_state", True),
                 accumulate_steps=opts.get("accumulate_steps", 1),
+                overlap_grads=opts.get("overlap_grads", False),
+                double_buffer=opts.get("double_buffer", False),
+                donate_batch=opts.get("donate_batch"),
                 goodput=opts.get("goodput"),
                 measure_device_time=opts.get("measure_device_time", False),
             )

@@ -81,10 +81,12 @@ from unionml_tpu.models.pipeline_lm import (
 )
 from unionml_tpu.models.quantization import LLAMA_QUANT_PATTERNS, QuantizedDenseGeneral, quantize_params
 from unionml_tpu.models.train import (
+    GradOverlap,
     TrainState,
     adamw,
     classification_step,
     create_train_state,
+    grad_overlap_scope,
     lm_step,
     make_evaluator,
     make_predictor,
@@ -105,6 +107,7 @@ __all__ = [
     "LoRADenseGeneral", "LoRATrainState", "create_lora_train_state",
     "merge_lora", "merge_param_trees", "split_lora_params",
     "TrainState", "create_train_state", "classification_step", "lm_step",
+    "GradOverlap", "grad_overlap_scope",
     "make_evaluator", "make_predictor",
     "make_speculative_generator", "make_speculative_predictor",
     "make_generator", "make_lm_predictor", "serving_params", "adamw",

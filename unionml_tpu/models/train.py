@@ -11,7 +11,9 @@ program — XLA fuses the whole step into one executable per shape.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Tuple
+import contextlib
+import contextvars
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -103,8 +105,74 @@ def _bind_frozen(loss_fn: Callable, state: Any) -> Callable:
     return lambda params, batch: loss_fn(merge_param_trees(frozen, params), batch)
 
 
+class GradOverlap(NamedTuple):
+    """How the accumulation scan should overlap gradient collectives
+    with compute (docs/performance.md "Overlapped training").
+
+    ``mode="defer"`` keeps GSPMD's automatic collectives but moves the
+    *consumption* of microbatch *i*'s (already-reduced) grads into
+    iteration *i+1*'s carry-add, giving XLA's collective pipeliner a
+    full microbatch of backward compute to hide each all-reduce behind.
+    Works under any mesh (dp/fsdp/tensor/…) and is bitwise identical to
+    the serial scan (same adds in the same order, plus one exact +0).
+
+    ``mode="shard_map"`` additionally takes the data-axis all-reduce
+    manual: the scan runs inside ``shard_map`` over ``axes`` (params
+    replicated across them) and issues a deferred
+    :func:`~unionml_tpu.parallel.collectives.bucketed_psum` per
+    microbatch — one chunked collective stream XLA's async collectives
+    can pipeline. Only valid when every non-``axes`` mesh axis is
+    trivial (params must be replicated across ``axes``); loss/grad
+    trajectories are bitwise identical to serial for power-of-two
+    per-device microbatch rows and device counts (exact fp scaling).
+    """
+
+    mode: str
+    mesh: Any = None
+    axes: Tuple[str, ...] = ()
+    #: None = bucketed_psum's own DEFAULT_PSUM_BUCKET_BYTES (no stale
+    #: duplicate of the canonical constant here)
+    bucket_bytes: Optional[int] = None
+
+
+_GRAD_OVERLAP: contextvars.ContextVar = contextvars.ContextVar(
+    "unionml_grad_overlap", default=None
+)
+
+
+@contextlib.contextmanager
+def grad_overlap_scope(overlap: Optional[GradOverlap]):
+    """Make ``overlap`` the ambient accumulation strategy: any
+    :func:`accumulated_value_and_grad` TRACED inside this scope (i.e.
+    any zoo-factory step compiled by a trainer loop running in it)
+    adopts it without the step author plumbing a parameter through.
+    The trainer loops open this scope for ``overlap_grads=True``; the
+    jit cache keys on the ambient overlap so serial and overlapped
+    executables never alias."""
+    token = _GRAD_OVERLAP.set(overlap)
+    try:
+        yield overlap
+    finally:
+        _GRAD_OVERLAP.reset(token)
+
+
+def current_grad_overlap() -> Optional[GradOverlap]:
+    """The ambient :class:`GradOverlap` (None = serial accumulation)."""
+    return _GRAD_OVERLAP.get()
+
+
+def _zeros_like_shapes(tree: Any) -> Any:
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, jnp.float32), tree
+    )
+
+
 def accumulated_value_and_grad(
-    loss_fn: Callable, params: Any, batch: Any
+    loss_fn: Callable,
+    params: Any,
+    batch: Any,
+    *,
+    overlap: Optional[GradOverlap] = None,
 ) -> Tuple[Tuple[jnp.ndarray, Any], Any]:
     """Mean (loss, aux) and grads of ``loss_fn(params, microbatch)`` over
     the leading microbatch axis of ``batch``, via one ``lax.scan``.
@@ -117,36 +185,174 @@ def accumulated_value_and_grad(
     sizes and mean-style losses, the averaged grads equal the one-shot
     big-batch grads up to float summation order (tested). ``aux`` must be
     a pytree of scalars (metrics) — it is averaged the same way.
+
+    ``overlap`` (default: the ambient :func:`grad_overlap_scope`, set by
+    ``run_step_trainer(overlap_grads=True)``) restructures the scan so
+    gradient collectives overlap the next microbatch's backward — see
+    :class:`GradOverlap`; every mode is loss-trajectory-identical to
+    the serial scan.
     """
+    if overlap is None:
+        overlap = _GRAD_OVERLAP.get()
+    if overlap is not None and overlap.mode == "shard_map":
+        return _shard_map_accumulated(loss_fn, params, batch, overlap)
+    defer = overlap is not None and overlap.mode == "defer"
+    if overlap is not None and overlap.mode not in ("defer", "shard_map"):
+        raise ValueError(
+            f"unknown GradOverlap mode {overlap.mode!r}: "
+            "expected 'defer' or 'shard_map'"
+        )
+
     vg = jax.value_and_grad(loss_fn, has_aux=True)
     n = jax.tree_util.tree_leaves(batch)[0].shape[0]
     first = jax.tree_util.tree_map(lambda x: x[0], batch)
     # trace-time structure probe: zero accumulators for loss/aux/grads
     (loss_s, aux_s), grad_s = jax.eval_shape(vg, params, first)
-    zeros = lambda t: jax.tree_util.tree_map(  # noqa: E731
-        lambda s: jnp.zeros(s.shape, jnp.float32), t
-    )
+    zeros = _zeros_like_shapes
 
-    def body(carry, microbatch):
-        loss_acc, aux_acc, grad_acc = carry
-        (loss, aux), grads = vg(params, microbatch)
-        loss_acc = loss_acc + loss.astype(jnp.float32)
-        aux_acc = jax.tree_util.tree_map(
-            lambda a, b: a + jnp.asarray(b, jnp.float32), aux_acc, aux
-        )
-        grad_acc = jax.tree_util.tree_map(
-            lambda a, g: a + g.astype(jnp.float32), grad_acc, grads
-        )
-        return (loss_acc, aux_acc, grad_acc), None
+    if defer:
+        # deferred consumption: iteration i adds iteration i-1's grads
+        # (the `pending` carry) into the accumulator BEFORE computing
+        # its own, so the collectives GSPMD attached to microbatch i's
+        # grads are not needed until a whole microbatch of backward
+        # compute later — the window XLA's collective pipeliner hides
+        # them in. Same adds in the same order as the serial scan (plus
+        # an exact leading +0): bitwise-identical trajectories.
+        def body(carry, microbatch):
+            loss_acc, aux_acc, grad_acc, pending = carry
+            grad_acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), grad_acc, pending
+            )
+            (loss, aux), grads = vg(params, microbatch)
+            loss_acc = loss_acc + loss.astype(jnp.float32)
+            aux_acc = jax.tree_util.tree_map(
+                lambda a, b: a + jnp.asarray(b, jnp.float32), aux_acc, aux
+            )
+            return (loss_acc, aux_acc, grad_acc, grads), None
 
-    (loss, aux, grads), _ = jax.lax.scan(
-        body, (zeros(loss_s), zeros(aux_s), zeros(grad_s)), batch
-    )
+        pending0 = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), grad_s
+        )
+        (loss, aux, grads, pending), _ = jax.lax.scan(
+            body, (zeros(loss_s), zeros(aux_s), zeros(grad_s), pending0),
+            batch,
+        )
+        grads = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32), grads, pending
+        )
+    else:
+        def body(carry, microbatch):
+            loss_acc, aux_acc, grad_acc = carry
+            (loss, aux), grads = vg(params, microbatch)
+            loss_acc = loss_acc + loss.astype(jnp.float32)
+            aux_acc = jax.tree_util.tree_map(
+                lambda a, b: a + jnp.asarray(b, jnp.float32), aux_acc, aux
+            )
+            grad_acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), grad_acc, grads
+            )
+            return (loss_acc, aux_acc, grad_acc), None
+
+        (loss, aux, grads), _ = jax.lax.scan(
+            body, (zeros(loss_s), zeros(aux_s), zeros(grad_s)), batch
+        )
     mean = lambda t: jax.tree_util.tree_map(lambda x: x / n, t)  # noqa: E731
     grads = jax.tree_util.tree_map(
         lambda g, p: (g / n).astype(p.dtype), grads, params
     )
     return (loss / n, mean(aux)), grads
+
+
+def _shard_map_accumulated(
+    loss_fn: Callable, params: Any, batch: Any, overlap: GradOverlap
+) -> Tuple[Tuple[jnp.ndarray, Any], Any]:
+    """The manual-collective accumulation: scan inside ``shard_map``
+    over the batch axes, per-microbatch deferred ``bucketed_psum``.
+
+    Params are replicated across ``overlap.axes`` (the pure-DP layout;
+    the trainer only selects this mode when every other mesh axis is
+    trivial), each device runs ``loss_fn`` on its local microbatch
+    rows, and the data-axis all-reduce of microbatch *i*'s grads is
+    issued in iteration *i* but consumed in *i+1* — an explicit,
+    chunked collective stream for XLA's async collectives to pipeline
+    behind the next backward.
+    """
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from unionml_tpu.parallel.collectives import bucketed_psum
+
+    axes = tuple(overlap.axes)
+    if overlap.mesh is None or not axes:
+        raise ValueError(
+            "GradOverlap(mode='shard_map') needs a mesh and at least one "
+            "reduce axis (the batch axes the grads all-reduce over)"
+        )
+    axis_arg = axes if len(axes) > 1 else axes[0]
+
+    def local(params, batch):
+        vg = jax.value_and_grad(loss_fn, has_aux=True)
+        n = jax.tree_util.tree_leaves(batch)[0].shape[0]
+        first = jax.tree_util.tree_map(lambda x: x[0], batch)
+        (loss_s, aux_s), grad_s = jax.eval_shape(vg, params, first)
+        zeros = _zeros_like_shapes
+
+        def body(carry, microbatch):
+            loss_acc, aux_acc, grad_acc, pending = carry
+            # consume the PREVIOUS microbatch's reduced grads first …
+            grad_acc = jax.tree_util.tree_map(
+                lambda a, g: a + g, grad_acc, pending
+            )
+            (loss, aux), grads = vg(params, microbatch)
+            # … and issue this one's all-reduce, bucketed so the chunks
+            # pipeline; its result is not needed until the next
+            # iteration's carry-add
+            bucket_kw = (
+                {} if overlap.bucket_bytes is None
+                else {"bucket_bytes": overlap.bucket_bytes}
+            )
+            reduced = bucketed_psum(
+                jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), grads
+                ),
+                axis_arg, **bucket_kw,
+            )
+            loss_acc = loss_acc + lax.pmean(
+                loss.astype(jnp.float32), axis_arg
+            )
+            aux_acc = jax.tree_util.tree_map(
+                lambda a, b: a + lax.pmean(
+                    jnp.asarray(b, jnp.float32), axis_arg
+                ),
+                aux_acc, aux,
+            )
+            return (loss_acc, aux_acc, grad_acc, reduced), None
+
+        (loss, aux, grads, pending), _ = jax.lax.scan(
+            body,
+            (zeros(loss_s), zeros(aux_s), zeros(grad_s), zeros(grad_s)),
+            batch,
+        )
+        grads = jax.tree_util.tree_map(lambda a, g: a + g, grads, pending)
+        ndev = lax.psum(1, axis_arg)
+        mean = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: x / n, t
+        )
+        # /(n*ndev) in ONE division: ndev is a power of two on real
+        # meshes, so the extra scale vs the serial path's /n is exact
+        grads = jax.tree_util.tree_map(
+            lambda g, p: (g / (n * ndev)).astype(p.dtype), grads, params
+        )
+        return (loss / n, mean(aux)), grads
+
+    fn = shard_map(
+        local, overlap.mesh,
+        in_specs=(P(), P(None, axes if len(axes) > 1 else axes[0])),
+        out_specs=((P(), P()), P()),
+        check_rep=False,
+    )
+    return fn(params, batch)
 
 
 def masked_cross_entropy(

@@ -14,6 +14,7 @@ first-class replacement: strategies compose as axes of one
 - :mod:`unionml_tpu.parallel.pipeline` — pipeline-parallel stage executor.
 """
 
+from unionml_tpu.parallel.collectives import bucketed_psum
 from unionml_tpu.parallel.mesh import make_mesh, mesh_devices, multihost_initialize
 from unionml_tpu.parallel.pipeline import (
     pipeline_apply,
@@ -30,6 +31,7 @@ from unionml_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "bucketed_psum",
     "make_mesh",
     "mesh_devices",
     "multihost_initialize",

@@ -195,6 +195,7 @@ def compile_step(
     *,
     sharding: ShardingConfig,
     donate_state: bool = True,
+    donate_batch: bool = False,
 ) -> Tuple[Callable, Any]:
     """Compile ``step_fn(state, batch) -> (state, metrics)`` over the mesh.
 
@@ -203,7 +204,10 @@ def compile_step(
     compiled step constrains state in/out shardings so XLA keeps parameters
     resident and inserts gradient collectives (psum over 'data'/'fsdp',
     all-gathers for fsdp params) automatically. State buffers are donated —
-    parameter memory is updated in place.
+    parameter memory is updated in place. ``donate_batch`` additionally
+    donates the batch argument (the double-buffered prefetch feeds each
+    device batch exactly once, so XLA may recycle its buffer for step
+    temporaries instead of holding consumed batches in HBM).
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
@@ -214,11 +218,14 @@ def compile_step(
     bspec = sharding.batch_sharding()
     replicated = NamedSharding(mesh, PartitionSpec())
 
+    donate = (0,) if donate_state else ()
+    if donate_batch:
+        donate = donate + (1,)
     compiled = jax.jit(
         step_fn,
         in_shardings=(ss, bspec),
         out_shardings=(ss, replicated),
-        donate_argnums=(0,) if donate_state else (),
+        donate_argnums=donate,
     )
 
     if mesh.devices.flat[0].platform == "cpu":
