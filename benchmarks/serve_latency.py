@@ -37,6 +37,14 @@ Usage::
     #   cardinality <= top_k + 1 under a 40-distinct-tenant burst, and
     #   ledger-on vs -off p99 overhead <= 2% at token parity
     #   (docs/observability.md "Usage metering & cost attribution")
+    UNIONML_TPU_BENCH_PRESET=serve_router python benchmarks/serve_latency.py
+    # ^ fleet router (cluster front door): 3 engine replicas under a
+    #   concurrent stream with a mid-run replica KILL (OOM-shaped
+    #   device fault) plus a drain→rejoin cycle — asserts ZERO
+    #   caller-visible failures with per-request token parity, retry
+    #   amplification within the fleet retry budget; then a 1-replica
+    #   passthrough leg asserting <= 2% p99 overhead vs the direct
+    #   engine (docs/robustness.md "Fleet robustness")
 """
 
 from __future__ import annotations
@@ -1434,6 +1442,258 @@ def overload_leg() -> None:
         engine.close()
 
 
+def router_leg() -> None:
+    """Fleet-router robustness + overhead
+    (``UNIONML_TPU_BENCH_PRESET=serve_router``).
+
+    Phase 1 — **chaos under traffic**: 3 engine replicas behind a
+    ``FleetRouter``, concurrent clients streaming requests. Mid-run,
+    one replica takes an OOM-shaped device fault on a decode dispatch
+    (the poisoned batch dies inside that engine; the router's retry
+    envelope absorbs it) and another replica is drained and rejoined
+    (the rolling-restart choreography). Asserts: ZERO caller-visible
+    failures, every response token-identical to its solo run, and
+    total retries within the fleet retry budget
+    (``burst + ratio * requests`` — the storm-control bound,
+    docs/robustness.md "Fleet robustness").
+
+    Phase 2 — **passthrough overhead**: the same engine serves the
+    same requests directly and through a 1-replica router,
+    interleaved per request in alternating order (the PR 8 estimator
+    lessons: whole-pass legs drift percents at minute scale; pairing
+    per request cancels it), per-request MIN over rounds, nearest-rank
+    p99 computed UNROUNDED. Asserts the router adds <= 2% p99 and
+    bit-identical tokens.
+    """
+    import gc
+    import threading
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu import telemetry
+    from unionml_tpu.models import Llama
+    from unionml_tpu.serving.engine import DecodeEngine
+    from unionml_tpu.serving.faults import FaultInjector, xla_oom_error
+    from unionml_tpu.serving.router import (
+        EngineReplica, FleetRouter, RouterPolicy,
+    )
+
+    backend = jax.default_backend()
+    if backend == "cpu":
+        cfg = serving_config("tiny")
+        module = Llama(cfg)
+        tokens0 = jnp.zeros((1, 8), jnp.int32)
+        params = jax.jit(module.init)(jax.random.PRNGKey(0), tokens0)["params"]
+        n_req, clients, slots = 48, 6, 2
+        new_tokens, bucket, chunk_steps = 16, 16, 4
+        overhead_reqs, overhead_rounds = 40, 6
+    else:
+        cfg = serving_config("serve_1p5b")
+        module = Llama(cfg)
+        params = random_quantized_params(module)
+        n_req, clients, slots = 192, 24, 8
+        new_tokens, bucket, chunk_steps = 32, 64, 8
+        overhead_reqs, overhead_rounds = 120, 8
+
+    n_replicas = 3
+    ratio, burst = 0.2, 3.0
+    fis = [FaultInjector() for _ in range(n_replicas)]
+    engines = [
+        DecodeEngine(
+            module, slots=slots, max_new_tokens=new_tokens,
+            prompt_buckets=(bucket,), chunk_steps=chunk_steps,
+            max_queue_depth=64, fault_injector=fis[i],
+        )
+        for i in range(n_replicas)
+    ]
+    registry = telemetry.MetricsRegistry()
+    flight = telemetry.FlightRecorder()
+    router = FleetRouter(
+        [
+            EngineReplica(engines[i], params, name=f"r{i}")
+            for i in range(n_replicas)
+        ],
+        policy=RouterPolicy(
+            retry_budget_ratio=ratio, retry_budget_burst=burst,
+            backoff_base_s=0.001, jitter_s=0.0, health_ttl_s=0.05,
+        ),
+        registry=registry,
+        flight=flight,
+    )
+    rng = np.random.default_rng(0)
+    # a small distinct-prompt set reused across the stream keeps the
+    # solo-parity oracle cheap (one solo run per distinct prompt)
+    distinct = [
+        rng.integers(1, cfg.vocab_size, bucket // 2).tolist()
+        for _ in range(8)
+    ]
+    try:
+        for e in engines:
+            e.warmup(params)
+        solo = {
+            tuple(p): engines[0].generate(params, [p])[0] for p in distinct
+        }
+        for e in engines:
+            e.reset_stats()
+
+        results, failures, lock = [], [], threading.Lock()
+        started = threading.Event()
+
+        def client(idx):
+            for j, p in enumerate(
+                distinct[(idx + k) % len(distinct)]
+                for k in range(n_req // clients)
+            ):
+                if idx == 0 and j == 1:
+                    started.set()  # traffic confirmed in flight
+                try:
+                    out = router.generate(p)
+                    with lock:
+                        results.append((tuple(p), out))
+                except BaseException as exc:  # EVERY failure counts
+                    with lock:
+                        failures.append(f"{type(exc).__name__}: {exc}")
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        started.wait(timeout=60)
+        # mid-run: KILL r0 (next decode dispatch dies OOM-shaped) ...
+        fis[0].arm("engine.dispatch", exc=xla_oom_error())
+        time.sleep(0.05)
+        # ... and roll r2: drain (in-flight streams finish), rejoin
+        router.drain_replica("r2", timeout=120)
+        time.sleep(0.02)
+        router.rejoin_replica("r2")
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads), "clients hung"
+
+        assert not failures, (
+            f"{len(failures)} caller-visible failures (want 0): "
+            f"{sorted(set(failures))[:3]}"
+        )
+        bad = sum(1 for key, out in results if out != solo[key])
+        assert bad == 0, f"{bad}/{len(results)} responses lost token parity"
+        assert fis[0].injected("engine.dispatch") == 1, (
+            "the replica kill must actually have fired"
+        )
+        retries = sum(
+            child.value
+            for _, child in router._m_retries.children()
+        )
+        budget_cap = burst + ratio * n_req
+        assert retries <= budget_cap, (
+            f"retry amplification {retries} exceeds budget {budget_cap}"
+        )
+        kinds = {e["kind"] for e in flight.dump()}
+        assert {"route", "retry", "drain", "rejoin"} <= kinds, kinds
+        print(json.dumps({
+            "metric": "serve_router_failover",
+            "replicas": n_replicas,
+            "offered": n_req,
+            "clients": clients,
+            "completed": len(results),
+            "caller_visible_failures": len(failures),
+            "retries": retries,
+            "retry_budget_cap": budget_cap,
+            "recoveries_r0": engines[0].stats()["robustness"]["recoveries"],
+            "drain_rejoin_cycles": 1,
+            "token_parity": "exact",
+            "unit": "requests",
+        }))
+    finally:
+        for e in engines:
+            e.close()
+
+    # ---- phase 2: 1-replica passthrough overhead vs direct engine ----
+    engine = DecodeEngine(
+        module, slots=slots, max_new_tokens=new_tokens,
+        prompt_buckets=(bucket,), chunk_steps=chunk_steps,
+    )
+    router1 = FleetRouter(
+        [EngineReplica(engine, params, name="solo")],
+        policy=RouterPolicy(health_ttl_s=0.05),
+        registry=telemetry.MetricsRegistry(),
+        flight=telemetry.FlightRecorder(),
+    )
+    try:
+        engine.warmup(params)
+        prompts = [
+            rng.integers(1, cfg.vocab_size, bucket // 2).tolist()
+            for _ in range(overhead_reqs)
+        ]
+        direct_min = [math.inf] * overhead_reqs
+        routed_min = [math.inf] * overhead_reqs
+        token_mismatch = 0
+        gc_was_enabled = gc.isenabled()
+        gc.disable()
+        try:
+            for r in range(overhead_rounds):
+                for i, p in enumerate(prompts):
+                    legs = [("direct", i), ("routed", i)]
+                    if (r + i) % 2:
+                        legs.reverse()  # drift cancels inside the pair
+                    outs = {}
+                    for legname, idx in legs:
+                        t0 = time.perf_counter()
+                        if legname == "direct":
+                            out = engine.generate(params, [p])[0]
+                            dt = time.perf_counter() - t0
+                            direct_min[idx] = min(direct_min[idx], dt)
+                        else:
+                            out = router1.generate(p)
+                            dt = time.perf_counter() - t0
+                            routed_min[idx] = min(routed_min[idx], dt)
+                        outs[legname] = out
+                    if outs["direct"] != outs["routed"]:
+                        token_mismatch += 1
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+        assert token_mismatch == 0, (
+            f"{token_mismatch} routed responses diverged from direct"
+        )
+
+        def p99(vals):  # nearest-rank, UNROUNDED (0.1 ms rounding is
+            v = sorted(vals)  # percents of this workload)
+            return v[max(0, math.ceil(0.99 * len(v)) - 1)]
+
+        d99, r99 = p99(direct_min), p99(routed_min)
+        overhead = (r99 - d99) / d99 if d99 > 0 else 0.0
+        assert overhead <= 0.02, (
+            f"router passthrough adds {overhead:.1%} p99 "
+            f"(direct {d99 * 1e3:.2f} ms vs routed {r99 * 1e3:.2f} ms); "
+            "bar is 2%"
+        )
+        print(json.dumps({
+            "metric": "serve_router_passthrough_p99_overhead",
+            "requests": overhead_reqs,
+            "rounds": overhead_rounds,
+            "direct_p99_ms": round(d99 * 1e3, 3),
+            "routed_p99_ms": round(r99 * 1e3, 3),
+            "value": round(overhead * 100, 2),
+            "token_parity": "exact",
+            "unit": "percent",
+        }))
+        print(json.dumps({
+            "metric": "serve_router_summary",
+            "failover": "0 caller-visible failures, parity exact",
+            "retry_budget": "bounded",
+            "passthrough_p99_overhead_pct": round(overhead * 100, 2),
+        }))
+    finally:
+        engine.close()
+
+
 if __name__ == "__main__":
     if os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_tracing":
         if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
@@ -1468,6 +1728,17 @@ if __name__ == "__main__":
                 "workload is hardcoded in paged_leg"
             )
         paged_leg()
+    elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_router":
+        if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
+            os.environ.get("UNIONML_TPU_BENCH_PREFIX")
+        ):
+            # hardcoded workload, same rule as the other engine legs
+            raise SystemExit(
+                "UNIONML_TPU_BENCH_PRESET=serve_router takes no CLI "
+                f"flags or KV/PREFIX env legs (got {sys.argv[1:]}); its "
+                "workload is hardcoded in router_leg"
+            )
+        router_leg()
     elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_usage":
         if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
             os.environ.get("UNIONML_TPU_BENCH_PREFIX")

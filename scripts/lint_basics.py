@@ -66,6 +66,9 @@ MAX_LINE = 110
 # The checkpoint/ prefix covers async_writer.py: its save_ms/commit_ms
 # split IS the checkpoint badput attribution, so a wall-clock duration
 # there would corrupt the caller-stall vs background-commit story.
+# The serving/ prefix covers router.py: the fleet router's ejection
+# cooldowns, hedge delays, and backoff timers are exactly the durations
+# an NTP step would corrupt into spurious ejections or storms.
 WALL_CLOCK_BANNED = (
     "unionml_tpu/serving/",
     "unionml_tpu/execution.py",

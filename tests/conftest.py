@@ -20,3 +20,19 @@ os.environ.setdefault("UNIONML_TPU_CACHE_DIR", "/tmp/unionml_tpu_test_cache")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compilation cache for the test suite: dozens of tests
+# build fresh DecodeEngines/trainers over the SAME tiny-model geometry,
+# and each re-jits byte-identical HLO (the in-memory jit cache is
+# per-closure, so engine instances never share it). The persistent
+# cache keys on HLO hash, so repeats hit even WITHIN one cold suite
+# run, and the whole suite warms across runs. Scoped to the test
+# harness — production code paths never see this config.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get(
+        "UNIONML_TPU_TEST_JAX_CACHE", "/tmp/unionml_tpu_test_jax_cache"
+    ),
+)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)

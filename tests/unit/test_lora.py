@@ -176,16 +176,20 @@ def test_lora_sharded_step_matches_serial():
     for _ in range(3):
         sharded_state, sharded_metrics = compiled(sharded_state, sharded_batch)
 
-    # bf16 activations + cross-device psum reorder the reductions, so a
-    # few-per-mille drift over 3 compounding steps is the float floor,
-    # not a logic bug (the fp32 SP/EP tests pin 1e-6-level equality)
+    # bf16 activations (2^-8 ~ 4e-3 relative rounding) + cross-device
+    # psum reorder the reductions; that per-step few-e-3 activation
+    # drift feeds grads that 3 compounding SGD steps at lr=0.5 amplify
+    # to ~1e-2 absolute on O(1) params — so 2e-2 is the bf16 compounding
+    # floor with 2x margin (was 5e-3 = barely one bf16 ulp, seen flaking
+    # at clean HEAD), while a sharding bug (missing/doubled psum) moves
+    # params at O(1). The fp32 SP/EP tests keep the tight bounds.
     np.testing.assert_allclose(
         float(sharded_metrics["loss"]), float(serial_metrics["loss"]),
-        rtol=5e-3,
+        rtol=1e-2,
     )
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), atol=5e-3
+            np.asarray(a), np.asarray(b), atol=2e-2
         ),
         serial_state.params, jax.device_get(sharded_state.params),
     )

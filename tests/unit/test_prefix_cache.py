@@ -93,6 +93,29 @@ def test_prefix_cache_match_insert_roundtrip():
 
 
 @pytest.mark.quick
+def test_prefix_cache_peek_is_readonly():
+    """peek() (the fleet router's affinity probe) reports the cached
+    prefix length in tokens WITHOUT leasing, LRU-bumping, or counting
+    a hit/miss — N router probes per request must not distort the
+    cache telemetry or pin paths."""
+    cache = RadixPrefixCache(block_size=4, max_bytes=1 << 20,
+                        registry=telemetry.MetricsRegistry())
+    toks = np.arange(1, 13, dtype=np.int32)          # 3 full blocks
+    assert cache.peek(toks) == 0
+    cache.insert(toks, 0, [_block_tree(fill=float(i)) for i in range(3)])
+    assert cache.peek(toks) == 12
+    assert cache.peek(toks[:7]) == 4                  # block granularity
+    assert cache.peek(np.concatenate([toks[:8], [90, 91, 92, 93]])) == 8
+    assert cache.peek([50, 51]) == 0                  # sub-block prompt
+    s = cache.stats()
+    # no peek landed in the hit/miss counters, and nothing is leased:
+    # full-pressure eviction can still reclaim every block
+    assert s["hits"] == 0 and s["misses"] == 0 and s["partial_hits"] == 0
+    for node in list(cache._root.children.values()):
+        assert node.refcount == 0
+
+
+@pytest.mark.quick
 def test_prefix_cache_insert_requires_ancestors():
     """Blocks whose prefix path is missing are dropped — a child's rows
     are meaningless without the blocks above them."""

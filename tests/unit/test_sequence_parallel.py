@@ -27,10 +27,20 @@ def _setup(dtype="float32", vocab=64):
 
 @pytest.mark.parametrize("attn", ["ring", "ring_flash", "ulysses"])
 def test_sp_step_matches_serial(attn):
+    import optax
+
     cfg, module, tokens, params = _setup()
     mesh = make_mesh({"data": 2, "sequence": 2}, devices=jax.devices()[:4])
 
-    serial_state = create_train_state(module, tokens[:1], learning_rate=1e-2)
+    # SGD, not adam: updates are linear in grads, so the comparison
+    # tests the grad plumbing itself (adam's g/sqrt(v) amplifies the
+    # sharded reduction-order noise on near-zero grads into ~4e-4 param
+    # diffs — observed on CPU shard_map — which no per-element atol can
+    # separate from a real plumbing bug); same convention as
+    # test_sp_moe_step_matches_serial below
+    serial_state = create_train_state(
+        module, tokens[:1], optimizer=optax.sgd(1e-2)
+    )
     serial_state = serial_state.replace(params=params)
     # serial reference with the SAME loss convention (last position
     # masked): lm_step's shifted (inputs, targets) tuple form
@@ -41,7 +51,7 @@ def test_sp_step_matches_serial(attn):
         serial_state, (tokens, jnp.asarray(targets))
     )
 
-    sp_state = create_train_state(module, tokens[:1], learning_rate=1e-2)
+    sp_state = create_train_state(module, tokens[:1], optimizer=optax.sgd(1e-2))
     sp_state = sp_state.replace(params=params)
     step = jax.jit(sequence_parallel_lm_step(cfg, mesh=mesh, attn=attn))
     sp_state, sp_metrics = step(sp_state, tokens)

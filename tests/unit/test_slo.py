@@ -139,6 +139,28 @@ def test_gauge_objective_needs_sustained_violation():
     assert wd.evaluate(now=46.0)["breached"] == ["decode_mfu"]
 
 
+def test_burn_score_is_max_fast_window_burn():
+    """burn_score() — the router's load-shifting scalar — is the max
+    fast-window burn across objectives from the LAST evaluation, 0.0
+    before any sampling (no hidden evaluate: the health-probe cadence
+    is the refresh cadence)."""
+    reg = MetricsRegistry()
+    h = reg.histogram("unionml_engine_ttft_ms", "ttft", ("engine",))
+    wd = _ttft_watchdog(reg)
+    assert wd.burn_score() == 0.0  # never evaluated
+    for _ in range(20):
+        h.labels("engine-0").observe(50.0)
+    wd.evaluate(now=0.0)
+    wd.evaluate(now=2.0)
+    assert wd.burn_score() == 0.0  # healthy traffic
+    for _ in range(20):
+        h.labels("engine-0").observe(500.0)
+    wd.evaluate(now=4.0)
+    # the window delta vs the now=0 baseline is 20 bad / 20 total:
+    # bad fraction 1.0 over the 0.1 budget -> burn 10.0
+    assert wd.burn_score() == pytest.approx(10.0)
+
+
 def test_watchdog_publishes_slo_series_and_rejects_duplicates():
     reg = MetricsRegistry()
     reg.histogram("unionml_engine_ttft_ms", "ttft", ("engine",))

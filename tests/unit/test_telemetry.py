@@ -589,6 +589,29 @@ def test_percentile_summary_moved_to_telemetry_with_compat_shim():
     assert s["samples_per_sec_median"] == s["samples_per_sec"]["p50"]
 
 
+def test_sliding_samples_quantiles():
+    """SlidingSamples (the router's hedge-delay tracker): bounded
+    window, nearest-rank percentiles (the repo-wide formula), default
+    before any sample, old regimes age out."""
+    from unionml_tpu.telemetry import SlidingSamples
+
+    with pytest.raises(ValueError):
+        SlidingSamples(maxlen=0)
+    s = SlidingSamples(maxlen=4)
+    assert s.percentile(0.95, default=1.5) == 1.5
+    with pytest.raises(ValueError):
+        s.percentile(0.0)
+    for v in (10.0, 20.0, 30.0, 40.0):
+        s.add(v)
+    assert len(s) == 4
+    assert s.percentile(0.5) == 20.0      # ceil(0.5*4)-1 = index 1
+    assert s.percentile(0.95) == 40.0
+    # a new regime pushes the old one out of the bounded window
+    for v in (1.0, 1.0, 1.0, 1.0):
+        s.add(v)
+    assert s.percentile(0.95) == 1.0
+
+
 def test_metrics_smoke_servingapp_scrape():
     """CI smoke (tier-1-safe, JAX_PLATFORMS=cpu, no TPU): start a
     ServingApp over a stub predictor, scrape GET /metrics on a real

@@ -210,8 +210,23 @@ class AsyncCheckpointWriter:
                 self.commit_hook(final)
             # the atomic point: a crash strictly before this line leaves
             # only the tmp dir (ignored by restore); after it, the final
-            # dir is complete WITH its marker
-            os.replace(tmp, final)
+            # dir is complete WITH its marker. Re-saving an existing
+            # step (manual manager use, a rolled-back run re-reaching
+            # the step number): os.replace cannot replace a non-empty
+            # directory (ENOTEMPTY kills the commit), so the committed
+            # dir is first moved aside onto the tmp namespace — restore
+            # ignores *.tmp-* names, and a crash inside the two-rename
+            # window loses only this step (latest_step falls back to
+            # the previous committed one; the old behavior failed the
+            # whole run instead)
+            if final.is_dir():
+                stale = final.parent / f"{final.name}.tmp-resave"
+                shutil.rmtree(stale, ignore_errors=True)
+                os.replace(final, stale)
+                os.replace(tmp, final)
+                shutil.rmtree(stale, ignore_errors=True)
+            else:
+                os.replace(tmp, final)
             _fsync_dir(final.parent)
             self._metrics["commit_ms"].labels(self.kind).observe(
                 (time.perf_counter() - t0) * 1e3

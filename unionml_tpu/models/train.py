@@ -278,7 +278,7 @@ def _shard_map_accumulated(
     behind the next backward.
     """
     from jax import lax
-    from jax.experimental.shard_map import shard_map
+    from unionml_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from unionml_tpu.parallel.collectives import bucketed_psum

@@ -15,6 +15,8 @@ import functools
 from typing import Optional
 
 import jax.numpy as jnp
+
+from unionml_tpu.parallel import compat
 from jax import lax
 
 
@@ -47,7 +49,7 @@ def ulysses_attention_sharded(
     """
     from unionml_tpu.ops.attention import attention
 
-    n = lax.axis_size(axis)
+    n = compat.axis_size(axis)
     for name, t in (("q", q), ("k", k), ("v", v)):
         if t.shape[2] % n:
             raise ValueError(
@@ -77,7 +79,7 @@ def ulysses_attention(
     block_size: int = 512,
 ) -> jnp.ndarray:
     """Ulysses attention over globally-shaped [B,S,H,D] tensors."""
-    from jax import shard_map
+    from unionml_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, axis, None, None)

@@ -15,6 +15,7 @@ first-class replacement: strategies compose as axes of one
 """
 
 from unionml_tpu.parallel.collectives import bucketed_psum
+from unionml_tpu.parallel.compat import shard_map
 from unionml_tpu.parallel.mesh import make_mesh, mesh_devices, multihost_initialize
 from unionml_tpu.parallel.pipeline import (
     pipeline_apply,
@@ -32,6 +33,7 @@ from unionml_tpu.parallel.sharding import (
 
 __all__ = [
     "bucketed_psum",
+    "shard_map",
     "make_mesh",
     "mesh_devices",
     "multihost_initialize",

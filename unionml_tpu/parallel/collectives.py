@@ -18,6 +18,8 @@ from typing import Any, List, Sequence, Union
 
 from jax import lax
 
+from unionml_tpu.parallel import compat
+
 AxisName = Union[str, Sequence[str]]
 
 #: Default all-reduce bucket size for :func:`bucketed_psum`. Big enough
@@ -93,7 +95,7 @@ def reduce_scatter(x: Any, axis: AxisName, *, scatter_axis: int = 0):
 
 def ppermute_shift(x: Any, axis: str, *, shift: int = 1):
     """Rotate shards around a ring (ring-attention KV rotation over ICI)."""
-    n = lax.axis_size(axis)
+    n = compat.axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 
@@ -109,4 +111,4 @@ def axis_index(axis: str):
 
 
 def axis_size(axis: str):
-    return lax.axis_size(axis)
+    return compat.axis_size(axis)

@@ -1,0 +1,71 @@
+"""JAX version-compatibility shims for the parallelism layer.
+
+``shard_map`` moved twice across the JAX versions this repo must run
+under: modern releases export it as ``jax.shard_map`` with a
+``check_vma`` kwarg, while older ones only have
+``jax.experimental.shard_map.shard_map`` with the same knob named
+``check_rep``. Every shard_map kernel in this repo imports the wrapper
+below instead of touching either location directly, so a JAX upgrade
+(or downgrade) is a one-file change rather than a grep across ops/,
+models/, and parallel/.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+__all__ = ["axis_size", "shard_map"]
+
+try:  # modern JAX: top-level export, `check_vma` kwarg
+    from jax import shard_map as _shard_map_impl
+
+    _CHECK_KWARG = "check_vma"
+except ImportError:  # older JAX: experimental module, `check_rep` kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    _CHECK_KWARG = "check_rep"
+
+
+def axis_size(axis: str) -> int:
+    """Size of a bound mesh axis, portable across JAX versions.
+
+    Modern JAX has ``lax.axis_size``; older releases rely on the
+    documented constant-fold of ``lax.psum(1, axis)`` (a Python int at
+    trace time, so it stays usable in shape math and loop bounds).
+    """
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)
+
+
+def shard_map(
+    f: Callable,
+    mesh: Any = None,
+    *,
+    in_specs: Any,
+    out_specs: Any,
+    check_vma: Optional[bool] = None,
+    check_rep: Optional[bool] = None,
+    **kwargs: Any,
+):
+    """Version-portable ``shard_map``.
+
+    Accepts the replication-check flag under either its modern name
+    (``check_vma``) or its legacy name (``check_rep``) — passing both
+    is an error — and forwards it under whichever spelling the
+    installed JAX understands. ``mesh`` may be positional or keyword,
+    matching both historical signatures.
+    """
+    if check_vma is not None and check_rep is not None:
+        raise ValueError(
+            "pass only one of check_vma/check_rep (they are the same "
+            "flag under different JAX versions)"
+        )
+    check = check_vma if check_vma is not None else check_rep
+    if check is not None:
+        kwargs[_CHECK_KWARG] = check
+    return _shard_map_impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

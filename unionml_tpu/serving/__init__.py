@@ -59,6 +59,17 @@ tenant the transports propagate via :func:`~unionml_tpu.serving.usage
 ``unionml_tenant_*`` series (top-K + ``other`` rollup) and the exact
 vectors serve at ``GET /debug/usage`` — the measurement substrate for
 per-tenant quotas and fair scheduling.
+
+Above all of it sits the cluster front door
+(:mod:`unionml_tpu.serving.router`, docs/robustness.md "Fleet
+robustness"): a :class:`~unionml_tpu.serving.router.FleetRouter`
+fronts N engine replicas — picking by prefix-cache locality, queue
+depth/breaker state, and SLO burn — and wraps every dispatch in a
+robustness envelope (budgeted retries with backoff + ``Retry-After``,
+optional tail-latency hedging, passive outlier ejection with half-open
+rejoin, drain/join choreography), so a replica loss, hang, or drain is
+invisible to callers. :func:`~unionml_tpu.serving.router
+.make_router_app` mounts it on either transport.
 """
 
 from unionml_tpu.serving.batcher import MicroBatcher
@@ -73,6 +84,14 @@ from unionml_tpu.serving.faults import (
 from unionml_tpu.serving.http import ServingApp, create_app
 from unionml_tpu.serving.kv_pool import KVBlockPool, PoolExhausted
 from unionml_tpu.serving.prefix_cache import RadixPrefixCache
+from unionml_tpu.serving.router import (
+    EngineReplica,
+    FleetRouter,
+    HttpReplica,
+    ReplicaHandle,
+    RouterPolicy,
+    make_router_app,
+)
 from unionml_tpu.serving.usage import (
     UsageLedger,
     current_tenant,
@@ -81,9 +100,10 @@ from unionml_tpu.serving.usage import (
 )
 
 __all__ = [
-    "DeadlineExceeded", "DecodeEngine", "EngineUnavailable",
-    "FaultInjector", "KVBlockPool", "MicroBatcher", "Overloaded",
-    "PoolExhausted", "RadixPrefixCache", "ServingApp", "UsageLedger",
-    "create_app", "current_tenant", "deadline_scope", "tenant_scope",
-    "validate_tenant",
+    "DeadlineExceeded", "DecodeEngine", "EngineReplica",
+    "EngineUnavailable", "FaultInjector", "FleetRouter", "HttpReplica",
+    "KVBlockPool", "MicroBatcher", "Overloaded", "PoolExhausted",
+    "RadixPrefixCache", "ReplicaHandle", "RouterPolicy", "ServingApp",
+    "UsageLedger", "create_app", "current_tenant", "deadline_scope",
+    "make_router_app", "tenant_scope", "validate_tenant",
 ]

@@ -33,21 +33,30 @@ def serving_app(
     app_version: Optional[str] = None,
     model_version: str = "latest",
     batch: bool = False,
+    core: Optional[ServingApp] = None,
     **batcher_kwargs,
 ):
     """Mount ``/``, ``/predict``, ``/health`` (reference: fastapi.py:15-70).
 
     With ``app=None`` returns the dependency-free :class:`ServingApp`;
     otherwise ``app`` must be a FastAPI instance.
+
+    ``core``: mount a pre-built :class:`ServingApp` (or subclass —
+    e.g. the fleet router's :func:`~unionml_tpu.serving.router
+    .make_router_app` front door) instead of constructing one from
+    ``model``; every route below dispatches through the core, so the
+    router speaks FastAPI exactly as it speaks the stdlib transport.
+    ``model`` and the construction kwargs are ignored when given.
     """
-    core = ServingApp(
-        model,
-        remote=remote,
-        app_version=app_version,
-        model_version=model_version,
-        batch=batch,
-        **batcher_kwargs,
-    )
+    if core is None:
+        core = ServingApp(
+            model,
+            remote=remote,
+            app_version=app_version,
+            model_version=model_version,
+            batch=batch,
+            **batcher_kwargs,
+        )
     if app is None:
         return core
 

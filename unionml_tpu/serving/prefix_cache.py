@@ -273,6 +273,27 @@ class RadixPrefixCache:
         self._h_lookup.observe((time.perf_counter() - t0) * 1e3)
         return PrefixLease(self, nodes)
 
+    def peek(self, tokens: Sequence[int]) -> int:
+        """Longest cached block-prefix of ``tokens``, in TOKENS — a
+        read-only probe for routing-affinity decisions (the fleet
+        router scores replicas by who holds the longest prefix,
+        SGLang-style). Unlike :meth:`match` it takes no lease, bumps no
+        LRU clock, and records no hit/miss counters: a router peeking
+        N replicas per request must not distort the per-replica cache
+        telemetry or pin paths it never admits against."""
+        tokens = np.ascontiguousarray(tokens, np.int32).ravel()
+        cacheable = len(tokens) // self.block_size
+        matched = 0
+        with self._lock:
+            node = self._root
+            for i in range(cacheable):
+                child = node.children.get(self._block_key(tokens, i))
+                if child is None:
+                    break
+                matched += 1
+                node = child
+        return matched * self.block_size
+
     def insert(
         self,
         tokens: Sequence[int],

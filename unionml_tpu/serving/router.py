@@ -1,0 +1,1435 @@
+"""Cluster front door: a fault-tolerant multi-replica router.
+
+Everything below one process is production-grade — admission control,
+circuit breaker, supervised recovery, tracing, SLO watchdog, per-tenant
+metering — but a replica dying still means every client pointed at it
+fails. This module is the tier above: a :class:`FleetRouter` fronts N
+engine replicas (in-process handles first, HTTP upstreams behind the
+same :class:`ReplicaHandle` interface) and makes the *fleet* survive
+what one process cannot (docs/robustness.md "Fleet robustness").
+
+Routing composes signals the stack already emits:
+
+- **prefix-cache locality** — the replica holding the longest cached
+  prefix of the prompt wins (SGLang-style; the read-only
+  :meth:`~unionml_tpu.serving.prefix_cache.RadixPrefixCache.peek`
+  probe, so scoring never distorts per-replica cache telemetry);
+- **queue depth + breaker state** — from each replica's ``health()``;
+- **SLO burn** — :meth:`~unionml_tpu.slo.SloWatchdog.burn_score`
+  deprioritizes replicas burning error budget *before* they breach.
+
+Every dispatch is wrapped in a robustness envelope:
+
+- **retry policy** — exponential backoff + deterministic seeded jitter,
+  honoring typed ``Retry-After`` hints, retrying only errors that are
+  safe and useful to retry (a 422 or a deadline miss is not);
+- **retry budget** — a fleet-wide token bucket (deposits a fraction of
+  live traffic, each retry spends one token) so a degraded fleet sees
+  bounded retry amplification instead of a melt-down retry storm;
+- **hedging** (opt-in) — a second dispatch to a *different* replica
+  once the first exceeds the observed latency quantile; first answer
+  wins, the loser's stream is closed (→ engine-side abandonment);
+- **passive outlier ejection** — consecutive failures eject a replica
+  with exponential-cooldown hysteresis; after cooldown exactly one
+  probe request flows half-open, success rejoins it, failure re-ejects
+  with doubled cooldown;
+- **drain/join choreography** — ``drain_replica()`` stops new routes,
+  delegates to the replica's own ``drain()`` (PR 3) so in-flight
+  streams finish, and ``rejoin_replica()`` resumes + re-admits it;
+  when the live set thins below ``min_live`` the router itself answers
+  ``degraded`` health instead of blackholing.
+
+Context propagates through the hop: in-process replicas inherit the
+caller thread's ``deadline_scope``/``tenant_scope``/``trace_scope``
+(hedge threads re-open them), and :class:`HttpReplica` re-emits them as
+``X-Deadline-Ms`` / ``X-Tenant-ID`` / ``traceparent`` / ``X-Request-ID``
+headers — so PR 5's trace tree and PR 8's ledger span the fleet.
+
+Observability: ``unionml_router_*`` series (per-replica route/retry/
+hedge/eject counters, live-replica gauge, pick-latency histogram) and
+flight-recorder ``route``/``retry``/``hedge``/``eject``/``probe``/
+``rejoin``/``drain``/``join`` events make every failover explainable
+post-hoc.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from contextlib import contextmanager
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
+
+from unionml_tpu import telemetry
+from unionml_tpu._logging import logger
+from unionml_tpu.serving.faults import (
+    DeadlineExceeded,
+    EngineUnavailable,
+    Overloaded,
+    current_deadline_ms,
+    deadline_scope,
+)
+from unionml_tpu.serving.usage import current_tenant, tenant_scope
+
+# the router's request id, exposed to replica dispatches on this thread
+# (deadline-scope-style): HttpReplica re-emits it as X-Request-ID so the
+# remote flight recorder tags the same rid and cross-hop correlation
+# ("follow one request") works over HTTP replicas too
+_rid_tls = threading.local()
+
+
+@contextmanager
+def _rid_scope(rid: str) -> Iterator[None]:
+    prev = getattr(_rid_tls, "rid", None)
+    _rid_tls.rid = rid
+    try:
+        yield
+    finally:
+        _rid_tls.rid = prev
+
+
+def current_route_rid() -> Optional[str]:
+    """The routing request id of the dispatch on this thread, if any."""
+    return getattr(_rid_tls, "rid", None)
+
+
+__all__ = [
+    "EngineReplica",
+    "FleetRouter",
+    "HttpReplica",
+    "ReplicaHandle",
+    "RouterPolicy",
+    "make_router_app",
+]
+
+
+class ReplicaHandle:
+    """The interface one replica presents to the router.
+
+    Subclass for each transport; :class:`EngineReplica` wraps an
+    in-process :class:`~unionml_tpu.serving.engine.DecodeEngine`,
+    :class:`HttpReplica` a remote serving process. All methods may be
+    called concurrently from router worker threads.
+    """
+
+    name: str = "replica"
+
+    def generate_stream(
+        self, prompt: Sequence[int], *, max_new_tokens: Optional[int] = None,
+    ) -> Iterator[List[int]]:
+        """Yield token chunks for one prompt — the streaming dispatch
+        primitive (hedged losers are cancelled by closing the
+        iterator, and mid-stream failover replays past emitted
+        chunks)."""
+        raise NotImplementedError
+
+    def generate(
+        self, prompt: Sequence[int], *, max_new_tokens: Optional[int] = None,
+    ) -> List[int]:
+        """All tokens for one prompt, blocking — the non-streaming
+        dispatch primitive. Default collects :meth:`generate_stream`;
+        in-process replicas override with the engine's native blocking
+        call (one event wait instead of per-chunk queue hops — the
+        passthrough-overhead bench leg rides on this)."""
+        out: List[int] = []
+        for chunk in self.generate_stream(
+            prompt, max_new_tokens=max_new_tokens
+        ):
+            out.extend(chunk)
+        return out
+
+    def health(self) -> dict:
+        """The replica's ``/health`` dict: at least ``status`` and
+        ``queue_depth``; ``burn`` (SLO burn score) when known."""
+        raise NotImplementedError
+
+    def cached_prefix_len(self, prompt: Sequence[int]) -> int:
+        """Tokens of ``prompt`` this replica holds a cached KV prefix
+        for (0 when unknown — remote replicas without a peek API)."""
+        return 0
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Finish in-flight work; stop admitting. True when drained."""
+        return True
+
+    def resume(self) -> None:
+        """Reopen admissions after :meth:`drain`."""
+
+    def close(self) -> None:
+        """Release any resources the handle itself owns."""
+
+
+class EngineReplica(ReplicaHandle):
+    """An in-process :class:`~unionml_tpu.serving.engine.DecodeEngine`
+    behind the replica interface.
+
+    ``params`` are the replica's bound serving weights. ``slo`` is an
+    optional per-replica :class:`~unionml_tpu.slo.SloWatchdog` whose
+    :meth:`~unionml_tpu.slo.SloWatchdog.burn_score` rides the health
+    dict as the router's load-shifting signal. Ambient deadline/tenant/
+    trace scopes propagate by construction: the dispatch runs on the
+    caller's (or hedge worker's re-scoped) thread.
+    """
+
+    def __init__(self, engine, params, *, name: str, slo=None):
+        self.engine = engine
+        self.params = params
+        self.name = name
+        self._slo = slo
+
+    def generate_stream(self, prompt, *, max_new_tokens=None):
+        return self.engine.generate_stream(
+            self.params, prompt, max_new_tokens=max_new_tokens
+        )
+
+    def generate(self, prompt, *, max_new_tokens=None):
+        return self.engine.generate(
+            self.params, [prompt], max_new_tokens=max_new_tokens
+        )[0]
+
+    def health(self) -> dict:
+        out = dict(self.engine.health())
+        if self._slo is not None:
+            self._slo.evaluate()
+            out["burn"] = self._slo.burn_score()
+            breached = self._slo.breached()
+            if breached and out.get("status") == "ok":
+                out["status"] = "degraded"
+        return out
+
+    def cached_prefix_len(self, prompt) -> int:
+        cache = getattr(self.engine, "prefix_cache", None)
+        if cache is None:
+            return 0
+        return int(cache.peek(prompt))
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        return self.engine.drain(timeout)
+
+    def resume(self) -> None:
+        self.engine.resume()
+
+
+class HttpReplica(ReplicaHandle):
+    """A remote serving process (stdlib/FastAPI transport) behind the
+    replica interface.
+
+    Dispatch is ``POST {base_url}/predict/stream`` (SSE), health is
+    ``GET /health``. Ambient scopes re-emit as headers — the remote
+    transport re-opens them, so deadlines keep shedding, tenants keep
+    getting billed, and the trace tree stays connected across the hop.
+    Connection errors surface as :class:`~unionml_tpu.serving.faults
+    .EngineUnavailable` (retryable); the typed 429/503/504 statuses map
+    back to their local exceptions, ``Retry-After`` included, so the
+    router's retry policy sees one error vocabulary for both replica
+    kinds.
+    """
+
+    def __init__(
+        self, base_url: str, *, name: Optional[str] = None,
+        timeout_s: float = 60.0,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.name = name if name is not None else self.base_url
+        self.timeout_s = timeout_s
+
+    def _headers(self) -> dict:
+        headers = {"Content-Type": "application/json"}
+        deadline_ms = current_deadline_ms()
+        if deadline_ms is not None:
+            headers["X-Deadline-Ms"] = str(deadline_ms)
+        tenant = current_tenant()
+        if tenant:
+            headers["X-Tenant-ID"] = tenant
+        ctx = telemetry.current_trace_context()
+        if ctx is not None:
+            headers["traceparent"] = telemetry.format_traceparent(ctx)
+        rid = current_route_rid()
+        if rid:
+            headers["X-Request-ID"] = rid
+        return headers
+
+    def _raise_typed(self, status: int, body: str, headers) -> None:
+        retry_after = 1.0
+        try:
+            retry_after = float(headers.get("Retry-After", "1"))
+        except (TypeError, ValueError):
+            pass
+        if status == 429:
+            raise Overloaded(
+                f"{self.name}: {body}", retry_after_s=retry_after
+            )
+        if status == 503:
+            raise EngineUnavailable(
+                f"{self.name}: {body}", retry_after_s=retry_after
+            )
+        if status == 504:
+            raise DeadlineExceeded(f"{self.name}: {body}")
+        if 400 <= status < 500:
+            # a 4xx (e.g. 422 validation) is deterministic: the same
+            # request fails on every replica — ValueError is the
+            # NON-retryable class, so the router surfaces it instead
+            # of burning budget re-sending a bad prompt
+            raise ValueError(f"{self.name}: HTTP {status}: {body}")
+        raise EngineUnavailable(  # other 5xx: possibly transient
+            f"{self.name}: HTTP {status}: {body}",
+            reason="http_error", retry_after_s=retry_after,
+        )
+
+    @staticmethod
+    def _refuse_cap(max_new_tokens) -> None:
+        """The ``/predict`` payload contract has no per-request token
+        cap, so a non-None ``max_new_tokens`` CANNOT cross this hop —
+        refusing loudly beats silently decoding to the remote default
+        (which would break token parity the moment a failover lands a
+        capped request here)."""
+        if max_new_tokens is not None:
+            raise ValueError(
+                "HttpReplica cannot forward max_new_tokens — the remote "
+                "/predict contract has no field for it; configure the "
+                "cap on the remote engine instead"
+            )
+
+    def generate_stream(self, prompt, *, max_new_tokens=None):
+        self._refuse_cap(max_new_tokens)
+        payload = {"features": [list(int(t) for t in prompt)]}
+        req = urllib.request.Request(
+            f"{self.base_url}/predict/stream",
+            data=json.dumps(payload).encode(),
+            headers=self._headers(),
+            method="POST",
+        )
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.timeout_s)
+        except urllib.error.HTTPError as exc:
+            body = exc.read().decode(errors="replace")
+            self._raise_typed(exc.code, body, exc.headers)
+        except (urllib.error.URLError, OSError, TimeoutError) as exc:
+            raise EngineUnavailable(
+                f"{self.name}: unreachable ({exc})", reason="unreachable",
+            ) from exc
+        return self._sse_chunks(resp)
+
+    @staticmethod
+    def _sse_chunks(resp) -> Iterator[List[int]]:
+        """Decode the shared SSE wire protocol (one ``{"tokens"}``
+        event per chunk, then ``{"done"}``) back into token chunks. A
+        connection dropped before ``done`` raises — mid-stream replica
+        death must surface as a retryable error, not silent
+        truncation."""
+        try:
+            done = False
+            for raw in resp:
+                line = raw.decode(errors="replace").strip()
+                if not line.startswith("data:"):
+                    continue
+                event = json.loads(line[len("data:"):])
+                if event.get("done"):
+                    done = True
+                    return
+                yield [int(t) for t in event["tokens"]]
+            if not done:
+                raise EngineUnavailable(
+                    "stream dropped before done event",
+                    reason="stream_dropped",
+                )
+        except (OSError, TimeoutError) as exc:
+            raise EngineUnavailable(
+                f"stream aborted mid-flight ({exc})", reason="stream_dropped",
+            ) from exc
+        finally:
+            resp.close()
+
+    def generate(self, prompt, *, max_new_tokens=None):
+        self._refuse_cap(max_new_tokens)
+        payload = {"features": [list(int(t) for t in prompt)]}
+        req = urllib.request.Request(
+            f"{self.base_url}/predict",
+            data=json.dumps(payload).encode(),
+            headers=self._headers(),
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                rows = json.loads(resp.read().decode())
+        except urllib.error.HTTPError as exc:
+            body = exc.read().decode(errors="replace")
+            self._raise_typed(exc.code, body, exc.headers)
+        except (urllib.error.URLError, OSError, TimeoutError) as exc:
+            raise EngineUnavailable(
+                f"{self.name}: unreachable ({exc})", reason="unreachable",
+            ) from exc
+        return [int(t) for t in rows[0]]
+
+    def _get_json(self, path: str) -> dict:
+        req = urllib.request.Request(f"{self.base_url}{path}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as exc:
+            # /health answers 503 WITH the body when degraded/draining
+            try:
+                return json.loads(exc.read().decode())
+            except (json.JSONDecodeError, OSError):
+                raise EngineUnavailable(
+                    f"{self.name}: HTTP {exc.code} on {path}",
+                    reason="unreachable",
+                ) from exc
+        except (urllib.error.URLError, OSError, TimeoutError) as exc:
+            raise EngineUnavailable(
+                f"{self.name}: unreachable ({exc})", reason="unreachable",
+            ) from exc
+
+    def health(self) -> dict:
+        return self._get_json("/health")
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        # remote drain is an operator action on the remote process;
+        # the router-side contract is just "stop routing here"
+        return True
+
+
+class RouterPolicy:
+    """Tunables for :class:`FleetRouter` (one object so bench/test
+    sweeps name their configuration in one place).
+
+    Retry: up to ``max_attempts`` total dispatches per request,
+    exponential backoff ``backoff_base_s * 2^(attempt-1)`` capped at
+    ``backoff_max_s``, plus deterministic seeded jitter in
+    ``[0, jitter_s)``; a typed ``Retry-After`` hint raises the floor.
+    Retries draw on a fleet-wide budget: the bucket starts at
+    ``retry_budget_burst`` tokens, each *admitted* request deposits
+    ``retry_budget_ratio`` tokens (capped back at the burst), each
+    retry spends one — so over any horizon
+    ``retries <= burst + ratio * requests`` and a degraded fleet sees
+    bounded amplification (Finagle/Envoy lineage; docs/robustness.md
+    derives the bound).
+
+    Hedging: off by default. When ``hedge=True``, a non-streaming
+    request whose first dispatch exceeds the observed
+    ``hedge_quantile`` latency (floored at ``hedge_min_s``, and only
+    once ``hedge_warmup`` samples exist) dispatches once more to a
+    different replica; first finished answer wins, the loser's stream
+    is closed (engine-side abandonment reaps the slot). Hedges spend
+    retry-budget tokens too — a hedge IS speculative retry load.
+
+    Ejection: ``eject_consecutive`` consecutive retryable failures
+    eject a replica for ``eject_cooldown_s``; each re-ejection doubles
+    the cooldown (capped at ``eject_cooldown_max_s`` — the hysteresis
+    that keeps a flapping replica from oscillating), a successful
+    half-open probe rejoins it and resets the cooldown ladder.
+
+    ``min_live``: below this many live replicas the router's own
+    ``health()`` degrades — a thin fleet should shed at the balancer
+    above, not blackhole at the router.
+    """
+
+    def __init__(
+        self,
+        *,
+        max_attempts: int = 3,
+        backoff_base_s: float = 0.05,
+        backoff_max_s: float = 2.0,
+        jitter_s: float = 0.02,
+        retry_budget_ratio: float = 0.2,
+        retry_budget_burst: float = 3.0,
+        hedge: bool = False,
+        hedge_quantile: float = 0.95,
+        hedge_min_s: float = 0.05,
+        hedge_warmup: int = 20,
+        eject_consecutive: int = 3,
+        eject_cooldown_s: float = 5.0,
+        eject_cooldown_max_s: float = 60.0,
+        min_live: int = 1,
+        cache_weight: float = 1.0,
+        queue_weight: float = 2.0,
+        burn_weight: float = 4.0,
+        health_ttl_s: float = 0.25,
+        seed: int = 0,
+    ):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+        if not 0.0 <= retry_budget_ratio <= 1.0:
+            raise ValueError(
+                f"retry_budget_ratio must be in [0, 1], got "
+                f"{retry_budget_ratio}"
+            )
+        if not 0.0 < hedge_quantile < 1.0:
+            raise ValueError(
+                f"hedge_quantile must be in (0, 1), got {hedge_quantile}"
+            )
+        if eject_consecutive < 1:
+            raise ValueError(
+                f"eject_consecutive must be >= 1, got {eject_consecutive}"
+            )
+        self.max_attempts = max_attempts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.jitter_s = jitter_s
+        self.retry_budget_ratio = retry_budget_ratio
+        self.retry_budget_burst = retry_budget_burst
+        self.hedge = hedge
+        self.hedge_quantile = hedge_quantile
+        self.hedge_min_s = hedge_min_s
+        self.hedge_warmup = hedge_warmup
+        self.eject_consecutive = eject_consecutive
+        self.eject_cooldown_s = eject_cooldown_s
+        self.eject_cooldown_max_s = eject_cooldown_max_s
+        self.min_live = min_live
+        self.cache_weight = cache_weight
+        self.queue_weight = queue_weight
+        self.burn_weight = burn_weight
+        self.health_ttl_s = health_ttl_s
+        self.seed = seed
+
+
+# replica lifecycle states the router tracks (the replica's OWN health
+# is a separate, composed signal)
+_LIVE = "live"
+_EJECTED = "ejected"
+_HALF_OPEN = "half_open"
+_DRAINING = "draining"
+
+
+class _ReplicaState:
+    """Router-side bookkeeping for one replica (all mutation under the
+    router lock)."""
+
+    __slots__ = (
+        "handle", "state", "consecutive_failures", "eject_count",
+        "rejoin_at", "probe_inflight", "health_cache", "health_at",
+    )
+
+    def __init__(self, handle: ReplicaHandle):
+        self.handle = handle
+        self.state = _LIVE
+        self.consecutive_failures = 0
+        self.eject_count = 0           # lifetime ejections → cooldown ladder
+        self.rejoin_at = 0.0           # monotonic time the cooldown ends
+        self.probe_inflight = False    # half-open: exactly one probe
+        self.health_cache: dict = {}
+        self.health_at = float("-inf")
+
+
+def _retryable(exc: BaseException) -> bool:
+    """Errors worth retrying on ANOTHER replica: overload/unavailable/
+    transport failures and engine-side crashes. NOT retryable: the
+    caller's own deadline (a second attempt arrives just as late),
+    and validation errors (deterministically wrong on every
+    replica)."""
+    if isinstance(exc, (Overloaded, EngineUnavailable, TimeoutError)):
+        # DeadlineExceeded subclasses TimeoutError — exclude it
+        return not isinstance(exc, DeadlineExceeded)
+    return isinstance(exc, RuntimeError) and not isinstance(exc, ValueError)
+
+
+class FleetRouter:
+    """Routes requests over N :class:`ReplicaHandle` s with failover,
+    retry budgets, optional hedging, outlier ejection, and drain/join
+    choreography (module docstring has the full story).
+
+    ``clock`` is injectable (monotonic seconds) so ejection-cooldown
+    tests are deterministic; production uses ``time.monotonic``.
+    ``sleep`` likewise for backoff.
+    """
+
+    def __init__(
+        self,
+        replicas: Sequence[ReplicaHandle],
+        *,
+        policy: Optional[RouterPolicy] = None,
+        registry: Optional[telemetry.MetricsRegistry] = None,
+        flight: Optional[telemetry.FlightRecorder] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique, got {names}")
+        self.policy = policy if policy is not None else RouterPolicy()
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, _ReplicaState] = {
+            r.name: _ReplicaState(r) for r in replicas
+        }
+        self._rr = 0  # round-robin tie-break counter
+        self._draining = False
+        self._rng = random.Random(self.policy.seed)
+        self._budget_tokens = self.policy.retry_budget_burst
+        self._latency = telemetry.SlidingSamples(maxlen=512)
+        self._registry = (
+            registry if registry is not None else telemetry.get_registry()
+        )
+        self._flight = (
+            flight if flight is not None else telemetry.get_flight_recorder()
+        )
+        self._build_instruments()
+        self._g_live.set_function(self._live_count)
+
+    # -- instruments -------------------------------------------------------
+
+    def _build_instruments(self) -> None:
+        reg = self._registry
+        self._m_routed = reg.counter(
+            "unionml_router_requests_total",
+            "Requests dispatched by the fleet router, by replica and "
+            "outcome (ok/error/retried_away).",
+            ("replica", "outcome"),
+        )
+        self._m_retries = reg.counter(
+            "unionml_router_retries_total",
+            "Retry dispatches, by the replica the retry was sent TO.",
+            ("replica",),
+        )
+        self._m_hedges = reg.counter(
+            "unionml_router_hedges_total",
+            "Hedge dispatches, by replica and result (win/lose).",
+            ("replica", "result"),
+        )
+        self._m_ejections = reg.counter(
+            "unionml_router_ejections_total",
+            "Outlier ejections, by replica.",
+            ("replica",),
+        )
+        self._m_rejoins = reg.counter(
+            "unionml_router_rejoins_total",
+            "Replicas rejoined after a successful half-open probe or "
+            "drain cycle, by replica.",
+            ("replica",),
+        )
+        self._m_budget_exhausted = reg.counter(
+            "unionml_router_retry_budget_exhausted_total",
+            "Retries NOT attempted because the fleet-wide retry budget "
+            "was empty (the storm-control activation count).",
+        )
+        self._g_live = reg.gauge(
+            "unionml_router_live_replicas",
+            "Replicas currently routable (live or half-open probing).",
+        )
+        self._h_pick_ms = reg.histogram(
+            "unionml_router_pick_ms",
+            "Replica-selection latency (health peeks + cache peeks + "
+            "scoring).",
+        )
+
+    def _live_count(self) -> float:
+        with self._lock:
+            return float(sum(
+                1 for s in self._replicas.values()
+                if s.state in (_LIVE, _HALF_OPEN)
+            ))
+
+    # -- membership / choreography ----------------------------------------
+
+    def add_replica(self, handle: ReplicaHandle) -> None:
+        """Join a new replica into the live set (scale-out, or a
+        rebuilt process re-registering)."""
+        with self._lock:
+            if handle.name in self._replicas:
+                raise ValueError(f"replica {handle.name!r} already present")
+            self._replicas[handle.name] = _ReplicaState(handle)
+        self._flight.record("join", replica=handle.name)
+
+    def remove_replica(self, name: str, *, drain_timeout: float = 30.0) -> bool:
+        """Permanently remove ``name``: drain it first (in-flight
+        streams finish), then drop it from the set. True when the
+        drain completed within ``drain_timeout``."""
+        drained = self.drain_replica(name, timeout=drain_timeout)
+        with self._lock:
+            self._replicas.pop(name, None)
+        self._flight.record("leave", replica=name, drained=drained)
+        return drained
+
+    def drain_replica(self, name: str, timeout: Optional[float] = None) -> bool:
+        """Stop routing new work to ``name`` and delegate to the
+        replica's own ``drain()`` so in-flight streams finish. The
+        replica stays in the set (``rejoin_replica`` reverses); True
+        when its drain reported complete."""
+        with self._lock:
+            state = self._replicas.get(name)
+            if state is None:
+                raise KeyError(f"unknown replica {name!r}")
+            state.state = _DRAINING
+        self._flight.record("drain", replica=name)
+        return bool(state.handle.drain(timeout))
+
+    def rejoin_replica(self, name: str) -> None:
+        """Resume a drained replica and route to it again (the join
+        half of rolling-restart choreography). Clears ejection
+        bookkeeping: an operator rejoin is a statement the replica is
+        believed healthy."""
+        with self._lock:
+            state = self._replicas.get(name)
+            if state is None:
+                raise KeyError(f"unknown replica {name!r}")
+            state.handle.resume()
+            state.state = _LIVE
+            state.consecutive_failures = 0
+            state.eject_count = 0
+            state.probe_inflight = False
+            state.health_at = float("-inf")
+        self._m_rejoins.labels(name).inc()
+        self._flight.record("rejoin", replica=name, cause="operator")
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Drain the WHOLE fleet (router stops admitting; every replica
+        drains). Reversible with :meth:`resume`."""
+        self._draining = True
+        with self._lock:
+            states = list(self._replicas.values())
+        ok = True
+        for state in states:
+            with self._lock:
+                state.state = _DRAINING
+            self._flight.record("drain", replica=state.handle.name)
+            ok = bool(state.handle.drain(timeout)) and ok
+        return ok
+
+    def resume(self) -> None:
+        """Reopen the router and every drained replica."""
+        self._draining = False
+        with self._lock:
+            names = [
+                n for n, s in self._replicas.items() if s.state == _DRAINING
+            ]
+        for name in names:
+            self.rejoin_replica(name)
+
+    def close(self) -> None:
+        for state in list(self._replicas.values()):
+            state.handle.close()
+
+    # -- health / stats ----------------------------------------------------
+
+    def health(self) -> dict:
+        """The router's OWN readiness: ``ok`` while at least
+        ``policy.min_live`` replicas are routable, ``degraded`` below
+        the floor (shed at the balancer above instead of blackholing
+        here), ``draining`` during a fleet drain. Per-replica states
+        ride along for operators."""
+        with self._lock:
+            replicas = {
+                name: {
+                    "state": s.state,
+                    "consecutive_failures": s.consecutive_failures,
+                    "eject_count": s.eject_count,
+                }
+                for name, s in self._replicas.items()
+            }
+            live = sum(
+                1 for s in self._replicas.values()
+                if s.state in (_LIVE, _HALF_OPEN)
+            )
+        if self._draining:
+            status = "draining"
+        elif live < self.policy.min_live:
+            status = "degraded"
+        else:
+            status = "ok"
+        return {
+            "status": status,
+            "live_replicas": live,
+            "min_live": self.policy.min_live,
+            "replicas": replicas,
+        }
+
+    def stats(self) -> dict:
+        with self._lock:
+            budget = self._budget_tokens
+            replicas = {
+                name: {
+                    "state": s.state,
+                    "consecutive_failures": s.consecutive_failures,
+                    "eject_count": s.eject_count,
+                }
+                for name, s in self._replicas.items()
+            }
+        return {
+            "engine": "router",
+            "router": {
+                "replicas": replicas,
+                "retry_budget_tokens": round(budget, 3),
+                "hedge_delay_s": round(self._hedge_delay_s(), 4),
+                "latency_samples": len(self._latency),
+            },
+        }
+
+    # -- retry budget ------------------------------------------------------
+
+    def _deposit_budget(self) -> None:
+        with self._lock:
+            self._budget_tokens = min(
+                self.policy.retry_budget_burst,
+                self._budget_tokens + self.policy.retry_budget_ratio,
+            )
+
+    def _spend_budget(self) -> bool:
+        with self._lock:
+            if self._budget_tokens >= 1.0:
+                self._budget_tokens -= 1.0
+                return True
+        self._m_budget_exhausted.inc()
+        return False
+
+    # -- ejection lifecycle ------------------------------------------------
+
+    def _record_failure(self, name: str, exc: BaseException) -> None:
+        with self._lock:
+            state = self._replicas.get(name)
+            if state is None:
+                return
+            state.consecutive_failures += 1
+            if state.state == _HALF_OPEN:
+                # failed probe: immediately re-eject, doubled cooldown
+                state.probe_inflight = False
+                self._eject_locked(state, cause="probe_failed")
+                return
+            if (
+                state.state == _LIVE
+                and state.consecutive_failures >= self.policy.eject_consecutive
+            ):
+                self._eject_locked(state, cause=type(exc).__name__)
+
+    def _eject_locked(self, state: _ReplicaState, *, cause: str) -> None:
+        state.eject_count += 1
+        cooldown = min(
+            self.policy.eject_cooldown_s * (2 ** (state.eject_count - 1)),
+            self.policy.eject_cooldown_max_s,
+        )
+        state.state = _EJECTED
+        state.rejoin_at = self._clock() + cooldown
+        name = state.handle.name
+        self._m_ejections.labels(name).inc()
+        self._flight.record(
+            "eject", replica=name, cause=cause,
+            consecutive=state.consecutive_failures,
+            cooldown_s=round(cooldown, 3),
+        )
+        logger.info(
+            f"router: ejected {name} ({cause}, "
+            f"{state.consecutive_failures} consecutive, "
+            f"cooldown {cooldown:.1f}s)"
+        )
+
+    def _has_routable(self, exclude: Sequence[str] = ()) -> bool:
+        """Cheap existence check: is any un-excluded replica routable
+        right now? (Used by hedging to avoid spending a retry-budget
+        token on a lane whose pick would fail instantly — e.g. a
+        1-replica fleet with a slow request every tail.)"""
+        now = self._clock()
+        with self._lock:
+            for state in self._replicas.values():
+                if state.handle.name in exclude:
+                    continue
+                if state.state == _LIVE:
+                    return True
+                if state.state == _EJECTED and now >= state.rejoin_at:
+                    return True
+                if state.state == _HALF_OPEN and not state.probe_inflight:
+                    return True
+        return False
+
+    def _release_probe(self, name: str) -> None:
+        """Free a half-open replica's probe slot without resolving the
+        probe either way — for dispatch exits that say nothing about
+        the replica's health (caller abandoned the stream, non-
+        retryable caller error). No-op unless the replica is still
+        half-open (success rejoins, retryable failure re-ejects)."""
+        with self._lock:
+            state = self._replicas.get(name)
+            if state is not None and state.state == _HALF_OPEN:
+                state.probe_inflight = False
+
+    def _record_success(self, name: str) -> None:
+        with self._lock:
+            state = self._replicas.get(name)
+            if state is None:
+                return
+            state.consecutive_failures = 0
+            if state.state == _HALF_OPEN:
+                state.state = _LIVE
+                state.probe_inflight = False
+                state.eject_count = 0  # probe succeeded: reset the ladder
+                self._m_rejoins.labels(name).inc()
+                self._flight.record("rejoin", replica=name, cause="probe_ok")
+                logger.info(f"router: {name} rejoined after probe")
+
+    # -- picking -----------------------------------------------------------
+
+    def _health_of(self, state: _ReplicaState, now: float) -> dict:
+        """Cached replica health (TTL ``policy.health_ttl_s``): pick
+        runs per request, HTTP health is a network call. Strict ``<``
+        so ``health_ttl_s=0`` means "always fresh" (tests with a
+        frozen clock rely on this)."""
+        if now - state.health_at < self.policy.health_ttl_s:
+            return state.health_cache
+        try:
+            h = state.handle.health()
+        except BaseException as exc:
+            h = {"status": "unreachable", "error": str(exc)}
+        with self._lock:
+            state.health_cache = h
+            state.health_at = now
+        return h
+
+    def _pick(
+        self, prompt: Sequence[int], exclude: Sequence[str] = (),
+    ) -> ReplicaHandle:
+        """Choose the dispatch target: over routable candidates, score
+        ``cache_w * cached_fraction - queue_w * queue_depth -
+        burn_w * burn`` and take the max (ties: round-robin). Raises
+        :class:`EngineUnavailable` when nothing is routable."""
+        t0 = time.perf_counter()
+        now = self._clock()
+        with self._lock:
+            candidates: List[_ReplicaState] = []
+            for state in self._replicas.values():
+                if state.handle.name in exclude:
+                    continue
+                if state.state == _EJECTED and now >= state.rejoin_at:
+                    state.state = _HALF_OPEN
+                    self._flight.record(
+                        "probe", replica=state.handle.name
+                    )
+                if state.state == _LIVE:
+                    candidates.append(state)
+                elif state.state == _HALF_OPEN and not state.probe_inflight:
+                    # exactly one in-flight probe through a half-open
+                    # replica; it is picked ONLY when no live replica
+                    # remains un-excluded, or as the probe trickle below
+                    candidates.append(state)
+            rr = self._rr
+            self._rr += 1
+        if not candidates:
+            raise EngineUnavailable(
+                "no live replicas (all ejected, draining, or excluded)",
+                reason="no_live_replicas",
+                retry_after_s=self.policy.eject_cooldown_s,
+            )
+        half_open = [c for c in candidates if c.state == _HALF_OPEN]
+        live = [c for c in candidates if c.state == _LIVE]
+        # route the probe when a half-open replica is due one: the
+        # probe IS how it rejoins — starving it keeps capacity ejected.
+        # The claim is check-and-set UNDER the lock: two concurrent
+        # picks must not both probe the same replica.
+        chosen = None
+        if half_open and (not live or rr % 8 == 0):
+            with self._lock:
+                for c in half_open:
+                    if c.state == _HALF_OPEN and not c.probe_inflight:
+                        c.probe_inflight = True
+                        chosen = c
+                        break
+            if chosen is None and not live:
+                raise EngineUnavailable(
+                    "no live replicas (half-open probes already in "
+                    "flight)", reason="no_live_replicas",
+                    retry_after_s=1.0,
+                )
+        if chosen is None:
+            # reachable only with live candidates: the no-live case
+            # either claimed a probe above or raised
+            pool = live
+            prompt_len = max(1, len(prompt))
+            best, best_score = None, None
+            for i, state in enumerate(pool):
+                h = self._health_of(state, now)
+                if h.get("status") in ("draining", "unreachable"):
+                    continue
+                try:
+                    cached = state.handle.cached_prefix_len(prompt)
+                except BaseException:
+                    cached = 0
+                score = (
+                    self.policy.cache_weight * (cached / prompt_len)
+                    - self.policy.queue_weight * float(h.get("queue_depth", 0))
+                    - self.policy.burn_weight * float(h.get("burn", 0.0))
+                )
+                if h.get("breaker_open"):
+                    score -= 100.0
+                if h.get("status") == "degraded":
+                    score -= 10.0
+                # deterministic round-robin tie-break
+                if best_score is None or score > best_score + 1e-12:
+                    best, best_score = state, score
+                elif abs(score - best_score) <= 1e-12 and best is not None:
+                    if (i + rr) % len(pool) < (pool.index(best) + rr) % len(pool):
+                        best = state
+            if best is None:
+                # every candidate's own health said draining/unreachable
+                raise EngineUnavailable(
+                    "no routable replicas (all draining or unreachable)",
+                    reason="no_live_replicas",
+                    retry_after_s=1.0,
+                )
+            chosen = best
+        self._h_pick_ms.observe((time.perf_counter() - t0) * 1e3)
+        return chosen.handle
+
+    # -- dispatch envelope -------------------------------------------------
+
+    def _backoff_s(self, attempt: int, retry_after_s: float) -> float:
+        base = min(
+            self.policy.backoff_base_s * (2 ** (attempt - 1)),
+            self.policy.backoff_max_s,
+        )
+        jitter = (
+            self._rng.random() * self.policy.jitter_s
+            if self.policy.jitter_s > 0 else 0.0
+        )
+        return max(base + jitter, retry_after_s)
+
+    def _hedge_delay_s(self) -> float:
+        if len(self._latency) < self.policy.hedge_warmup:
+            return max(self.policy.hedge_min_s, 1.0)
+        return max(
+            self.policy.hedge_min_s,
+            self._latency.percentile(self.policy.hedge_quantile),
+        )
+
+    def generate_stream(
+        self, prompt: Sequence[int], *, max_new_tokens: Optional[int] = None,
+    ) -> Iterator[List[int]]:
+        """Stream token chunks with transparent mid-stream failover: a
+        replica dying after K emitted tokens re-dispatches on a
+        survivor and replays past the first K (engines decode
+        deterministically for a fixed prompt, so the survivor's tokens
+        are the same stream — chaos-tested for token parity). The
+        caller sees one uninterrupted stream or, only once every
+        attempt is exhausted, the last error."""
+        if self._draining:
+            raise EngineUnavailable(
+                "router is draining", reason="draining",
+            )
+        self._deposit_budget()
+        rid = telemetry.new_request_id()
+        return self._stream_with_failover(
+            rid, prompt, max_new_tokens=max_new_tokens
+        )
+
+    def _stream_with_failover(self, rid, prompt, *, max_new_tokens,
+                              dispatch=None, initial_exclude=()):
+        """The retry envelope. ``dispatch(replica) -> chunk iterator``
+        defaults to the replica's streaming primitive; the blocking
+        path passes a single-yield wrapper over ``replica.generate``
+        so both surfaces share one pick/retry/budget/ejection
+        implementation. ``initial_exclude`` seeds the exclusion list
+        with replicas a caller already saw fail (the hedge fallback) —
+        the soft exclusion: if nothing else is routable, the pick
+        fallback below relaxes it."""
+        emitted = 0          # tokens already yielded to the caller
+        attempt = 1
+        tried: List[str] = list(initial_exclude)
+        last_exc: Optional[BaseException] = None
+        while attempt <= self.policy.max_attempts:
+            try:
+                replica = self._pick(prompt, exclude=tried)
+            except EngineUnavailable:
+                # every distinct replica tried: allow a repeat pick
+                # (the survivor set may have recovered) only if some
+                # replica exists at all
+                if not tried:
+                    raise
+                tried = tried[-1:]
+                try:
+                    replica = self._pick(prompt, exclude=tried)
+                except EngineUnavailable:
+                    if last_exc is not None:
+                        raise last_exc
+                    raise
+            name = replica.name
+            if attempt == 1:
+                self._flight.record("route", rid=rid, replica=name)
+            else:
+                self._m_retries.labels(name).inc()
+            t0 = time.perf_counter()
+            skip = emitted
+            try:
+                with _rid_scope(rid):
+                    # the rid scope covers dispatch: HttpReplica builds
+                    # its X-Request-ID header here, so the remote
+                    # flight recorder tags the SAME rid as ours
+                    source = (
+                        dispatch(replica) if dispatch is not None
+                        else replica.generate_stream(
+                            prompt, max_new_tokens=max_new_tokens
+                        )
+                    )
+                for chunk in source:
+                    # replay-skip: a retry regenerates from the start;
+                    # tokens the caller already holds are dropped here
+                    if skip >= len(chunk):
+                        skip -= len(chunk)
+                        continue
+                    out = chunk[skip:] if skip else chunk
+                    skip = 0
+                    emitted += len(out)
+                    yield out
+                self._latency.add(time.perf_counter() - t0)
+                self._record_success(name)
+                self._m_routed.labels(name, "ok").inc()
+                return
+            except BaseException as exc:
+                if not _retryable(exc):
+                    # includes GeneratorExit (caller abandoned the
+                    # stream): if this dispatch was a half-open probe,
+                    # free the probe slot — a vanished consumer must
+                    # not pin the replica half-open forever
+                    self._release_probe(name)
+                    self._m_routed.labels(name, "error").inc()
+                    raise
+                last_exc = exc
+                self._record_failure(name, exc)
+                tried.append(name)
+                if (
+                    attempt >= self.policy.max_attempts
+                    or not self._spend_budget()
+                ):
+                    # the FINAL failure was not hidden from the caller:
+                    # it counts as error, never also as retried_away
+                    # (sum over outcomes == dispatches)
+                    self._m_routed.labels(name, "error").inc()
+                    raise last_exc
+                self._m_routed.labels(name, "retried_away").inc()
+                delay = self._backoff_s(
+                    attempt, getattr(exc, "retry_after_s", 0.0)
+                )
+                self._flight.record(
+                    "retry", rid=rid, replica=name, attempt=attempt,
+                    reason=type(exc).__name__, backoff_s=round(delay, 4),
+                    emitted=emitted,
+                )
+                self._sleep(delay)
+                attempt += 1
+        raise last_exc if last_exc is not None else EngineUnavailable(
+            "retry attempts exhausted", reason="no_live_replicas",
+        )
+
+    def generate(
+        self, prompt: Sequence[int], *, max_new_tokens: Optional[int] = None,
+    ) -> List[int]:
+        """Blocking single-prompt generate through the full robustness
+        envelope: routed, retried, and (when ``policy.hedge``) hedged
+        against tail latency — the second dispatch goes to a different
+        replica after the observed ``hedge_quantile`` delay; first
+        finished answer wins and the loser is cancelled.
+
+        Dispatches via the replica's BLOCKING primitive (one event
+        wait, not per-chunk queue hops): the 1-replica passthrough
+        must cost ~a pick, not a streaming detour — the bench holds
+        it under 2% p99 vs the direct engine."""
+        if self.policy.hedge:
+            return self._hedged_generate(prompt, max_new_tokens=max_new_tokens)
+        if self._draining:
+            raise EngineUnavailable(
+                "router is draining", reason="draining",
+            )
+        self._deposit_budget()
+        rid = telemetry.new_request_id()
+        return self._collect(self._stream_with_failover(
+            rid, prompt, max_new_tokens=max_new_tokens,
+            dispatch=lambda rep: iter(
+                [rep.generate(prompt, max_new_tokens=max_new_tokens)]
+            ),
+        ))
+
+    @staticmethod
+    def _collect(stream: Iterator[List[int]]) -> List[int]:
+        out: List[int] = []
+        for chunk in stream:
+            out.extend(chunk)
+        return out
+
+    def _hedged_generate(self, prompt, *, max_new_tokens) -> List[int]:
+        if self._draining:
+            raise EngineUnavailable("router is draining", reason="draining")
+        self._deposit_budget()
+        rid = telemetry.new_request_id()
+        delay_s = self._hedge_delay_s()
+        done = threading.Event()
+        results: List = [None, None]   # per-lane (tokens | exception)
+        lanes: List[Optional[str]] = [None, None]
+        winner_lock = threading.Lock()
+        winner: List[Optional[int]] = [None]
+
+        # scopes are thread-local: capture the caller's and re-open
+        # them inside each lane so deadlines/tenants/traces survive
+        # the hop onto worker threads
+        deadline = current_deadline_ms()
+        tenant = current_tenant()
+        trace_ctx = telemetry.current_trace_context()
+
+        def lane(idx: int, exclude: List[str]) -> None:
+            try:
+                with deadline_scope(deadline), tenant_scope(tenant), \
+                        telemetry.trace_scope(trace_ctx), _rid_scope(rid):
+                    replica = self._pick(prompt, exclude=exclude)
+                    lanes[idx] = replica.name
+                    t0 = time.perf_counter()
+                    out: List[int] = []
+                    for chunk in replica.generate_stream(
+                        prompt, max_new_tokens=max_new_tokens
+                    ):
+                        # abandon on the WINNER flag alone: `done` is
+                        # cleared by the coordinator's wait loop, so a
+                        # done.is_set() condition here would race it
+                        # and let the loser decode to completion —
+                        # doubling device work on exactly the degraded
+                        # fleet hedging protects. winner[0] is set
+                        # once, never cleared. A failed sibling leaves
+                        # it None, so a healthy lane never aborts for
+                        # a sibling's error.
+                        with winner_lock:
+                            lost = (
+                                winner[0] is not None and winner[0] != idx
+                            )
+                        if lost:
+                            return  # lost: stop consuming (abandon)
+                        out.extend(chunk)
+                    self._latency.add(time.perf_counter() - t0)
+                    self._record_success(replica.name)
+                    results[idx] = out
+            except BaseException as exc:  # noqa: BLE001 — relayed below
+                results[idx] = exc
+                if lanes[idx] is not None and _retryable(exc):
+                    self._record_failure(lanes[idx], exc)
+            finally:
+                if lanes[idx] is not None:
+                    # lost-and-abandoned or non-retryable exits say
+                    # nothing about health: free the probe slot if this
+                    # lane was a half-open probe (no-op otherwise)
+                    self._release_probe(lanes[idx])
+                with winner_lock:
+                    if winner[0] is None and not isinstance(
+                        results[idx], BaseException
+                    ) and results[idx] is not None:
+                        winner[0] = idx
+                done.set()
+
+        self._flight.record("route", rid=rid, replica="<hedged>")
+        t_first = threading.Thread(target=lane, args=(0, []), daemon=True)
+        t_first.start()
+        t_first.join(timeout=delay_s)
+        hedged = False
+        exclude = [lanes[0]] if lanes[0] else []
+        # a second routable replica must EXIST before a budget token is
+        # spent: on a 1-replica fleet every slow request would otherwise
+        # drain the shared bucket on lanes whose pick fails instantly,
+        # starving genuine retries exactly when the fleet is thin
+        if (
+            t_first.is_alive()
+            and self._has_routable(exclude=exclude)
+            and self._spend_budget()
+        ):
+            hedged = True
+            self._flight.record(
+                "hedge", rid=rid, after_s=round(delay_s, 4),
+                exclude=exclude,
+            )
+            t_second = threading.Thread(
+                target=lane, args=(1, exclude), daemon=True
+            )
+            t_second.start()
+        while True:
+            # short-timeout wait: a lane's done.set() landing between
+            # our clear() and wait() must not strand this loop
+            done.wait(timeout=0.05)
+            done.clear()
+            with winner_lock:
+                w = winner[0]
+            if w is not None:
+                break
+            # a lane finished with an error; if the other lane is
+            # still running, keep waiting for it
+            alive = t_first.is_alive() or (
+                hedged and t_second.is_alive()
+            )
+            if not alive:
+                break
+        with winner_lock:
+            w = winner[0]
+        if w is None:
+            # every lane failed. A retryable failure falls back to the
+            # sequential retry envelope (the hedge must not WEAKEN the
+            # robustness contract — without this, one transient
+            # Overloaded before the hedge delay would surface to the
+            # caller that the non-hedged path retries transparently);
+            # the fallback's extra dispatch draws a budget token like
+            # any other retry. Ejection was already recorded per lane.
+            errs = [r for r in results if isinstance(r, BaseException)]
+            last = errs[-1] if errs else None
+            retrying = (
+                last is not None and _retryable(last) and self._spend_budget()
+            )
+            # account both lanes' dispatches (outcome disjointness:
+            # every dispatch lands in exactly one bucket, hedged or
+            # not): hidden by the fallback retry -> retried_away,
+            # surfaced to the caller -> error
+            for name in lanes:
+                if name:
+                    self._m_routed.labels(
+                        name, "retried_away" if retrying else "error"
+                    ).inc()
+            if retrying:
+                failed = [n for n in lanes if n]
+                self._flight.record(
+                    "retry", rid=rid, replica=",".join(failed) or "none",
+                    attempt=1, reason=type(last).__name__,
+                    backoff_s=0.0, emitted=0,
+                )
+                # the fallback must not immediately re-pick the lanes
+                # that JUST failed (cache affinity still scores an
+                # un-ejected primary highest) — seed the envelope's
+                # exclusion with them
+                return self._collect(self._stream_with_failover(
+                    rid, prompt, max_new_tokens=max_new_tokens,
+                    dispatch=lambda rep: iter(
+                        [rep.generate(prompt, max_new_tokens=max_new_tokens)]
+                    ),
+                    initial_exclude=failed,
+                ))
+            if last is not None:
+                raise last
+            raise EngineUnavailable(
+                "hedged dispatch produced no result", reason="hedge_failed",
+            )
+        win_name = lanes[w] or "none"
+        self._m_routed.labels(win_name, "ok").inc()
+        if hedged:
+            self._m_hedges.labels(win_name, "win").inc()
+            lose = lanes[1 - w]
+            if lose:
+                self._m_hedges.labels(lose, "lose").inc()
+                # the loser's dispatch gets its own disjoint outcome
+                # (it was neither ok nor an error — it was sacrificed)
+                self._m_routed.labels(lose, "hedge_lose").inc()
+        return results[w]
+
+
+class _RouterModel:
+    """The minimal model-shaped object :class:`RouterApp` mounts on the
+    transports (a router has no artifact of its own — its replicas
+    do)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.artifact = object()  # "loaded": the fleet is the artifact
+
+
+def make_router_app(router: FleetRouter, *, name: str = "fleet-router",
+                    **kwargs):
+    """The fleet router behind the standard serving surface.
+
+    Returns a :class:`~unionml_tpu.serving.http.ServingApp` subclass
+    instance whose predict paths dispatch through ``router`` — so BOTH
+    transports (stdlib ``serve()``, :func:`~unionml_tpu.serving
+    .fastapi.create_fastapi_app`) mount the front door unchanged: it
+    speaks the same HTTP dialect as the replicas behind it — 429/503/
+    504 fault mapping, ``traceparent``/``X-Tenant-ID``/``X-Request-ID``
+    echo, ``X-Deadline-Ms`` scope, ``/metrics``, ``/debug/flight``,
+    ``/debug/trace`` included. ``health``/``stats``/``drain`` default
+    to the router's own (override via kwargs like any ServingApp).
+
+    Subclassing (not transport changes) keeps the transports' single
+    dispatch seam: everything the handlers know about routing an app
+    applies verbatim to the router app.
+    """
+    # imported here, not at module top: http.py must stay importable
+    # without router.py and vice versa (no cycle)
+    from unionml_tpu.serving.http import ServingApp
+
+    class _RouterServingApp(ServingApp):
+        def __init__(self, router: FleetRouter, **kw):
+            kw.setdefault("stats", router.stats)
+            kw.setdefault("health", router.health)
+            kw.setdefault("drain", router.drain)
+            super().__init__(_RouterModel(name), **kw)
+            self.router = router
+
+        def setup_model(self):  # the fleet needs no artifact load
+            return None
+
+        def predict(self, payload: dict):
+            if self._draining:
+                raise EngineUnavailable(
+                    "router app is draining", reason="draining",
+                )
+            rows = _prompt_rows(payload)
+            if len(rows) == 1:
+                return [self.router.generate(rows[0])]
+            # multi-prompt: dispatch rows CONCURRENTLY so the replica
+            # engines continuous-batch them, instead of serializing N
+            # full generations behind one another (each worker re-opens
+            # the caller's thread-local scopes, hedge-lane style)
+            deadline = current_deadline_ms()
+            tenant = current_tenant()
+            trace_ctx = telemetry.current_trace_context()
+            results: List = [None] * len(rows)
+
+            def run(i: int) -> None:
+                try:
+                    with deadline_scope(deadline), tenant_scope(tenant), \
+                            telemetry.trace_scope(trace_ctx):
+                        results[i] = self.router.generate(rows[i])
+                except BaseException as exc:  # relayed in submit order
+                    results[i] = exc
+
+            threads = [
+                threading.Thread(target=run, args=(i,), daemon=True)
+                for i in range(len(rows))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for r in results:
+                if isinstance(r, BaseException):
+                    raise r
+            return results
+
+        def predict_stream(self, payload: dict):
+            if self._draining:
+                raise EngineUnavailable(
+                    "router app is draining", reason="draining",
+                )
+            rows = _prompt_rows(payload)
+            if len(rows) != 1:
+                raise ValueError(
+                    f"streaming serves one prompt per request, "
+                    f"got {len(rows)}"
+                )
+            return self.router.generate_stream(rows[0])
+
+        def resume(self):
+            super().resume()
+            self.router.resume()
+
+    return _RouterServingApp(router, **kwargs)
+
+
+def _prompt_rows(payload: dict) -> List[List[int]]:
+    """Token-prompt rows from a ``{"features": ...}`` payload (one
+    prompt, or a list of prompts). The router tier speaks token ids —
+    feature readers live on the replicas."""
+    features = payload.get("features")
+    if not features:
+        raise ValueError(
+            "router predict requires non-empty 'features' (a token-id "
+            "prompt or a list of prompts)"
+        )
+    rows = (
+        features
+        if isinstance(features[0], (list, tuple)) else [features]
+    )
+    out = []
+    for row in rows:
+        if not row:
+            raise ValueError("empty prompt")
+        out.append([int(t) for t in row])
+    return out

@@ -551,6 +551,25 @@ class SloWatchdog:
         ``"ok"`` — the contribution ``ServingApp.health`` merges."""
         return "degraded" if self.breached() else "ok"
 
+    def burn_score(self) -> float:
+        """Max fast-window burn rate across objectives as of the LAST
+        evaluation (0.0 before any) — a scalar load-shifting signal:
+        the fleet router deprioritizes replicas whose objectives are
+        burning budget even before they formally breach, so traffic
+        shifts ahead of the page, not after it. No sampling happens
+        here; the health-probe cadence (which calls :meth:`evaluate`)
+        is the refresh cadence."""
+        with self._lock:
+            if self._last_report is None:
+                return 0.0
+            return max(
+                (
+                    obj["windows"]["fast"]["burn_rate"]
+                    for obj in self._last_report["objectives"]
+                ),
+                default=0.0,
+            )
+
     # -- optional background ticker ---------------------------------------
 
     def start(self, interval_s: float = 15.0) -> None:

@@ -86,6 +86,7 @@ __all__ = [
     "new_span_id",
     "new_trace_id",
     "parse_traceparent",
+    "SlidingSamples",
     "percentile_summary",
     "publish_process_metrics",
     "server_trace_context",
@@ -117,6 +118,44 @@ def percentile_summary(values: Sequence[float]) -> dict:
         "mean": round(sum(vals) / n, 1),
         "n": n,
     }
+
+
+class SlidingSamples:
+    """A bounded sliding window of float samples with nearest-rank
+    percentile reads — the live-quantile primitive behind adaptive
+    decisions (the fleet router's hedge delay tracks the request p95
+    through one of these; a Histogram can't serve that read because
+    its buckets quantize to the grid and never age out old regimes).
+
+    Thread-safe; O(1) add, O(n log n) percentile (n <= maxlen, read on
+    decision paths that already cost a dispatch)."""
+
+    def __init__(self, maxlen: int = 512):
+        if maxlen < 1:
+            raise ValueError(f"maxlen must be >= 1, got {maxlen}")
+        self._samples: "deque[float]" = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def add(self, value: float) -> None:
+        with self._lock:
+            self._samples.append(float(value))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._samples)
+
+    def percentile(self, q: float, default: float = 0.0) -> float:
+        """Nearest-rank q-quantile (``ceil(q*n) - 1``, the repo-wide
+        formula — see :func:`percentile_summary`); ``default`` when no
+        samples have landed yet."""
+        if not 0.0 < q <= 1.0:
+            raise ValueError(f"q must be in (0, 1], got {q}")
+        with self._lock:
+            if not self._samples:
+                return default
+            vals = sorted(self._samples)
+        return vals[max(0, math.ceil(q * len(vals)) - 1)]
+
 
 # log-spaced ms buckets (1 / 2.5 / 5 per decade, 100 µs .. 1 min): wide
 # enough for a fused decode step (~2 ms) and a cold XLA compile (~20 s)
