@@ -43,6 +43,7 @@ bench-all: bench
 	UNIONML_TPU_BENCH_PRESET=serve_8b python benchmarks/serve_latency.py
 	UNIONML_TPU_BENCH_PRESET=serve_paged python benchmarks/serve_latency.py
 	UNIONML_TPU_BENCH_PRESET=serve_usage python benchmarks/serve_latency.py
+	UNIONML_TPU_BENCH_PRESET=serve_preempt python benchmarks/serve_latency.py
 	UNIONML_TPU_BENCH_PRESET=serve_router python benchmarks/serve_latency.py
 	python benchmarks/serve_http.py
 	UNIONML_TPU_BENCH_PRESET=serve_8b python benchmarks/serve_http.py

@@ -37,6 +37,13 @@ Usage::
     #   cardinality <= top_k + 1 under a 40-distinct-tenant burst, and
     #   ledger-on vs -off p99 overhead <= 2% at token parity
     #   (docs/observability.md "Usage metering & cost attribution")
+    UNIONML_TPU_BENCH_PRESET=serve_preempt python benchmarks/serve_latency.py
+    # ^ preemptive priority scheduling: a low-priority bulk tenant
+    #   floods the paged KV pool while a high-priority tenant streams
+    #   — asserts premium p99 holds within 1.5x of its unloaded
+    #   baseline, preempted streams resume with exact token parity,
+    #   and zero caller-visible failures (docs/robustness.md
+    #   "Preemption & fairness")
     UNIONML_TPU_BENCH_PRESET=serve_router python benchmarks/serve_latency.py
     # ^ fleet router (cluster front door): 3 engine replicas under a
     #   concurrent stream with a mid-run replica KILL (OOM-shaped
@@ -1000,6 +1007,235 @@ def paged_leg() -> None:
         }))
 
 
+def preempt_leg() -> None:
+    """Preemptive, priority-aware scheduling under pool overload
+    (``UNIONML_TPU_BENCH_PRESET=serve_preempt``; docs/robustness.md
+    "Preemption & fairness").
+
+    The workload preemption exists for: a low-priority BULK tenant
+    floods the paged KV pool (more concurrent long decodes than the
+    pool can hold resident) while a high-priority PREMIUM tenant keeps
+    sending short interactive requests. Without the scheduler the
+    premium requests queue FIFO behind the bulk backlog and a full
+    pool; with it they jump the parked bulk head (promote), evict a
+    bulk resident to the host prefix-cache store when blocks are short
+    (preempt), and the victims resume via the splice path.
+
+    Phase 1 — **unloaded baseline**: the premium stream alone on the
+    warmed engine; per-request wall-time p99 recorded (min over
+    rounds — CPU scheduler tails).
+
+    Phase 2 — **overload**: the bulk flood saturates the pool, then
+    the same premium stream runs high-priority through the contention.
+
+    Acceptance: premium p99 under overload holds within **1.5x** of
+    its unloaded baseline, at least one preemption actually fired,
+    every preempted bulk stream reaches exact token parity with its
+    solo run, and there are ZERO caller-visible failures.
+    """
+    import threading
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu import telemetry
+    from unionml_tpu.models import Llama, LlamaConfig
+    from unionml_tpu.models.generate import make_generator
+    from unionml_tpu.serving.engine import DecodeEngine
+    from unionml_tpu.serving.prefix_cache import RadixPrefixCache
+
+    backend = jax.default_backend()
+    if backend == "cpu":
+        cfg = serving_config("tiny")
+        module = Llama(
+            LlamaConfig(**{**cfg.__dict__, "paged_impl": "reference"})
+        )
+        tokens0 = jnp.zeros((1, 8), jnp.int32)
+        params = jax.jit(module.init)(jax.random.PRNGKey(0), tokens0)["params"]
+        bulk_clients, bulk_per_client, premium_n = 4, 3, 12
+        bulk_len, bulk_new, prem_len, prem_new = 16, 48, 8, 8
+        bucket, blk, slots, rounds = 64, 16, 4, 3
+        # capacity fits TWO bulk residents (ceil((16+48)/16)=4 blocks
+        # each): the 4-client flood keeps the pool exhausted, and the
+        # long bulk decodes make waiting for a natural retirement
+        # strictly worse than preempting
+        pool_blocks = 9
+    else:
+        cfg = serving_config("serve_1p5b")
+        qcfg = LlamaConfig(**{
+            **cfg.__dict__, "quantized": True, "paged_impl": "reference",
+        })
+        module = Llama(qcfg)
+        params = random_quantized_params(module)
+        bulk_clients, bulk_per_client, premium_n = 8, 4, 32
+        bulk_len, bulk_new, prem_len, prem_new = 128, 128, 32, 16
+        bucket, blk, slots, rounds = 512, 16, 8, 3
+        pool_blocks = 1 + 4 * ((bulk_len + bulk_new) // blk)
+
+    registry = telemetry.MetricsRegistry()
+    engine = DecodeEngine(
+        module, slots=slots, max_new_tokens=max(bulk_new, prem_new),
+        prompt_buckets=(bucket,), chunk_steps=4, paged=True,
+        # a shallow pipeline bounds the deferred-free fence an evicted
+        # victim's blocks wait behind — the dominant term in the
+        # premium tenant's preempt-then-admit latency
+        pipeline_depth=2,
+        kv_block_size=blk, kv_pool_blocks=pool_blocks,
+        prefix_cache=RadixPrefixCache(block_size=blk, registry=registry),
+        registry=registry,
+    )
+    rng = np.random.default_rng(0)
+    bulk_prompts = [
+        rng.integers(1, cfg.vocab_size, bulk_len).tolist()
+        for _ in range(bulk_clients * bulk_per_client)
+    ]
+    prem_prompts = [
+        rng.integers(1, cfg.vocab_size, prem_len).tolist()
+        for _ in range(premium_n)
+    ]
+    solo_bulk = make_generator(
+        module, max_new_tokens=bulk_new, max_len=engine.cache_len
+    )
+    solo_prem = make_generator(
+        module, max_new_tokens=prem_new, max_len=engine.cache_len
+    )
+
+    def solo(gen, prompt):
+        return np.asarray(
+            gen(params, jnp.asarray([prompt], jnp.int32))
+        )[0].tolist()
+
+    # ONE solo reference per distinct prompt (the premium stream
+    # re-runs rounds x 2 times — recomputing its references each pass
+    # would multiply the oracle's device work for identical answers)
+    prem_solo = {tuple(p): solo(solo_prem, p) for p in prem_prompts}
+
+    def premium_pass():
+        """Sequential premium stream; per-request DECODE latency
+        (first harvested chunk → stream end, measured client-side via
+        the SSE-shaped generator — the ISSUE's bar: queue/admission
+        wait under overload is what the promote/preempt machinery
+        spends, decode-lane progress is what it protects)."""
+        decode_ms = []
+        for p in prem_prompts:
+            out: list = []
+            t_first = None
+            for chunk in engine.generate_stream(
+                params, p, max_new_tokens=prem_new,
+                tenant="premium", priority="high",
+            ):
+                if t_first is None:
+                    t_first = time.perf_counter()
+                out.extend(chunk)
+            decode_ms.append((time.perf_counter() - t_first) * 1e3)
+            assert out == prem_solo[tuple(p)], "premium token parity"
+        return decode_ms
+
+    def premium_phase():
+        """Per-request MIN over rounds, then nearest-rank p99 across
+        requests (the PR 8 estimator lessons: a nearest-rank p99 of a
+        dozen samples IS the max, so one CPU-scheduler tail decides
+        the stat — the per-request min cancels it while keeping the
+        loaded-vs-unloaded contrast the bar is about)."""
+        per_req = None
+        for _ in range(rounds):
+            ms = premium_pass()
+            per_req = (
+                ms if per_req is None
+                else [min(a, b) for a, b in zip(per_req, ms)]
+            )
+        per_req.sort()
+        return per_req[max(0, math.ceil(0.99 * len(per_req)) - 1)]
+
+    try:
+        engine.warmup(params)
+        engine.prefix_cache.clear()
+
+        # ---- phase 1: unloaded premium baseline ----
+        p99_base = premium_phase()
+
+        # ---- phase 2: bulk flood + premium through the contention --
+        failures: list = []
+        bulk_outs: dict = {}
+        lock = threading.Lock()
+
+        def bulk_client(idx: int):
+            for j in range(bulk_per_client):
+                p = bulk_prompts[idx * bulk_per_client + j]
+                try:
+                    out = engine.generate(
+                        params, [p], max_new_tokens=bulk_new,
+                        tenant="bulk", priority="low",
+                    )[0]
+                    with lock:
+                        bulk_outs[tuple(p)] = out
+                except Exception as exc:  # ZERO of these allowed
+                    with lock:
+                        failures.append(repr(exc))
+
+        threads = [
+            threading.Thread(target=bulk_client, args=(i,), daemon=True)
+            for i in range(bulk_clients)
+        ]
+        for t in threads:
+            t.start()
+        # wait for real pool pressure before measuring the premium leg
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if engine.stats()["kv_pool"]["alloc_failures"] > 0:
+                break
+            time.sleep(0.002)
+        p99_loaded = premium_phase()
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads), "bulk stream hung"
+        assert not failures, f"caller-visible failures: {failures}"
+        # preempted bulk streams reached exact token parity
+        for p in bulk_prompts:
+            assert bulk_outs[tuple(p)] == solo(solo_bulk, p), (
+                "preempted bulk stream lost token parity"
+            )
+        stats = engine.stats()
+        preemptions = stats["scheduler"]["preemptions"]
+        pool = stats["kv_pool"]
+        ratio = p99_loaded / max(1e-9, p99_base)
+        print(json.dumps({
+            "metric": "serve_preempt_premium_decode_p99_ms",
+            "unloaded": round(p99_base, 2),
+            "overloaded": round(p99_loaded, 2),
+            "ratio": round(ratio, 3),
+            "bound": 1.5,
+            "unit": "ms",
+        }))
+        print(json.dumps({
+            "metric": "serve_preempt_summary",
+            "preemptions": preemptions,
+            "preempted_blocks": pool["preempted_blocks"],
+            "alloc_failures": pool["alloc_failures"],
+            "bulk_requests": len(bulk_prompts),
+            "premium_requests": premium_n * rounds * 2,
+            "caller_visible_failures": 0,
+            "tokens_identical": True,
+            "unit": "",
+        }))
+        assert preemptions >= 1, (
+            "the overload never triggered a preemption — the scenario "
+            "is not exercising the scheduler"
+        )
+        assert pool["blocks_in_use"] == 0, f"leaked pool blocks: {pool}"
+        assert ratio <= 1.5, (
+            f"premium p99 decode latency {p99_loaded:.1f} ms under "
+            f"overload exceeds 1.5x its unloaded baseline "
+            f"{p99_base:.1f} ms"
+        )
+    finally:
+        engine.close()
+
+
 def usage_leg() -> None:
     """Per-tenant usage metering: attribution identity, cardinality
     bound, and ledger overhead
@@ -1739,6 +1975,17 @@ if __name__ == "__main__":
                 "workload is hardcoded in router_leg"
             )
         router_leg()
+    elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_preempt":
+        if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
+            os.environ.get("UNIONML_TPU_BENCH_PREFIX")
+        ):
+            # hardcoded workload, same rule as the other engine legs
+            raise SystemExit(
+                "UNIONML_TPU_BENCH_PRESET=serve_preempt takes no CLI "
+                f"flags or KV/PREFIX env legs (got {sys.argv[1:]}); its "
+                "workload is hardcoded in preempt_leg"
+            )
+        preempt_leg()
     elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_usage":
         if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
             os.environ.get("UNIONML_TPU_BENCH_PREFIX")

@@ -69,6 +69,10 @@ MAX_LINE = 110
 # The serving/ prefix covers router.py: the fleet router's ejection
 # cooldowns, hedge delays, and backoff timers are exactly the durations
 # an NTP step would corrupt into spurious ejections or storms.
+# The serving/ prefix also covers scheduler.py: the preemptive
+# scheduler's resume-wait spans and KV hold windows feed latency
+# attribution and per-tenant billing — wall-clock stepping there would
+# corrupt preemption accounting and the deficit queues' fairness.
 WALL_CLOCK_BANNED = (
     "unionml_tpu/serving/",
     "unionml_tpu/execution.py",

@@ -36,3 +36,47 @@ jax.config.update(
 )
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+
+import os.path
+
+import pytest
+
+# Modules whose executables are UNSAFE under a WARM persistent cache on
+# this jax 0.4.37/CPU stack: the elastic/trainer family's donated step
+# functions intermittently crash (SIGSEGV/SIGBUS) or return garbage in
+# donated outputs when the process has read warm cache entries —
+# reproduced at clean HEAD with a 3-line repro (warm dir + one early
+# dispatch + the elastic chaos tests; a fresh dir passes 100%). The
+# fixture below turns the persistent cache OFF for these modules only.
+# It must also call compilation_cache.reset_cache(): jax's
+# is_cache_used() FREEZES its decision process-wide on first use
+# (_cache_checked is sticky), so a config flip alone is ignored once
+# any earlier test — or a collection-time jnp dispatch — touched the
+# cache. These modules are all tiny-MLP suites that re-compile in
+# seconds; everything else keeps the warm-cache speed the suite budget
+# depends on.
+_PERSISTENT_CACHE_UNSAFE = (
+    "test_async_checkpoint.py",
+    "test_train_step.py",
+    "test_diagnostics.py",
+    "test_goodput.py",
+    "test_overlap_training.py",
+    "test_data_pipeline.py",
+    "test_grad_accumulation.py",
+)
+
+
+@pytest.fixture(autouse=True)
+def _elastic_family_skips_persistent_cache(request):
+    path = os.path.basename(str(getattr(request.node, "fspath", "")))
+    if path not in _PERSISTENT_CACHE_UNSAFE:
+        yield
+        return
+    from jax._src import compilation_cache as _cc
+
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    _cc.reset_cache()  # un-stick the frozen is_cache_used() decision
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)
+    _cc.reset_cache()  # re-arm the cache for the modules that keep it

@@ -206,12 +206,12 @@ def test_engine_queue_full_sheds_with_typed_overload(tiny_llama):
         _wait_for(lambda: _resident(engine) == 1, what="slot occupied")
         t2 = threading.Thread(target=run, args=("b", [4, 5, 6]))
         t2.start()
-        _wait_for(lambda: engine._queue.qsize() == 1, what="one queued")
+        _wait_for(lambda: engine._room.qsize() == 1, what="one queued")
         with pytest.raises(Overloaded, match="queue is full"):
             engine.generate(params, [[7, 8, 9]])
         assert engine.stats()["robustness"]["rejected"]["queue_full"] == 1
         # a multi-prompt call is all-or-nothing: nothing was enqueued
-        assert engine._queue.qsize() == 1
+        assert engine._room.qsize() == 1
         t1.join(timeout=120)
         t2.join(timeout=120)
         # the admitted requests were untouched by the shed
@@ -572,7 +572,7 @@ def test_http_overload_answers_429_with_retry_after():
         _wait_for(lambda: _resident(engine) == 1, what="slot occupied")
         t2 = threading.Thread(target=post, args=("b", [4, 5, 6]))
         t2.start()
-        _wait_for(lambda: engine._queue.qsize() == 1, what="one queued")
+        _wait_for(lambda: engine._room.qsize() == 1, what="one queued")
         # /health reports the backlog the balancer would act on
         assert httpx.get(f"{url}/health").json()["queue_depth"] == 1
         r = httpx.post(

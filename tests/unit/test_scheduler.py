@@ -1,0 +1,616 @@
+"""Preemptive, priority-aware scheduling tests (docs/robustness.md
+"Preemption & fairness").
+
+The contract under test: the ``X-Priority`` class is validated at
+every transport boundary (closed value set, 422 on garbage, echoed on
+responses, carried across the router hop), the waiting room drains
+per-(priority, tenant) queues at the configured class weights with a
+starvation bound (never strict-priority starvation), and — THE chaos
+acceptance — under pool exhaustion with mixed priorities a
+lower-priority mid-decode stream is evicted to the host prefix-cache
+store, re-admitted via the splice path, and finishes with tokens
+bit-identical to its uncontended solo run, with zero caller-visible
+failures; preemption composing with ``_recover`` leaks zero pool
+blocks or cache leases.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unionml_tpu import telemetry
+from unionml_tpu.models import Llama, LlamaConfig
+from unionml_tpu.models.generate import make_generator
+from unionml_tpu.serving.engine import DecodeEngine
+from unionml_tpu.serving.faults import FaultInjector, xla_oom_error
+from unionml_tpu.serving.prefix_cache import RadixPrefixCache
+from unionml_tpu.serving.scheduler import (
+    DEFAULT_PRIORITY,
+    PRIORITIES,
+    SchedulerConfig,
+    WaitingRoom,
+    current_priority,
+    priority_scope,
+    validate_priority,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    cfg = LlamaConfig.tiny(vocab_size=97)
+    module = Llama(cfg)
+    params = module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return module, params
+
+
+@pytest.fixture
+def trained_model(model):
+    model.train(
+        hyperparameters={"max_iter": 500}, sample_frac=1.0, random_state=123
+    )
+    return model
+
+
+def _solo(module, params, prompt, n_new, max_len=256):
+    gen = make_generator(module, max_new_tokens=n_new, max_len=max_len)
+    return np.asarray(gen(params, jnp.asarray([prompt], jnp.int32)))[0].tolist()
+
+
+def _assert_pool_drained(engine, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = engine.stats()["kv_pool"]
+        if st["blocks_in_use"] == 0 and st["blocks_reserved"] == 0:
+            return st
+        time.sleep(0.02)
+    raise AssertionError(f"kv pool leaked blocks: {engine.stats()['kv_pool']}")
+
+
+def _assert_no_live_leases(cache):
+    """Every node's refcount back to zero: no admission or resume pin
+    outlived its request (the lease-leak acceptance gauge)."""
+    stack = list(cache._root.children.values())
+    while stack:
+        node = stack.pop()
+        assert node.refcount == 0, (
+            f"leaked lease refcount {node.refcount} at depth {node.depth}"
+        )
+        stack.extend(node.children.values())
+
+
+class _FakeReq:
+    _n = 0
+
+    def __init__(self, priority="normal", tenant="anonymous", cost=24):
+        self.priority = priority
+        self.tenant = tenant
+        self.prompt = [0] * (cost - 16)
+        self.max_new_tokens = 16
+        _FakeReq._n += 1
+        self.rid = f"r{_FakeReq._n}"
+
+
+# ---------------------------------------------------------- validator
+
+
+def test_validate_priority_contract():
+    assert validate_priority(None) == DEFAULT_PRIORITY
+    assert validate_priority("") == DEFAULT_PRIORITY
+    for p in PRIORITIES:
+        assert validate_priority(p) == p
+        assert validate_priority(p.upper()) == p  # case-insensitive
+    for bad in ("urgent", "0", "hi gh", "normal "):
+        with pytest.raises(ValueError, match="X-Priority"):
+            validate_priority(bad)
+
+
+def test_priority_scope_nesting():
+    assert current_priority() == DEFAULT_PRIORITY
+    with priority_scope("low"):
+        assert current_priority() == "low"
+        with priority_scope("high"):
+            assert current_priority() == "high"
+        with priority_scope(None):  # None leaves the outer scope visible
+            assert current_priority() == "low"
+        assert current_priority() == "low"
+    assert current_priority() == DEFAULT_PRIORITY
+
+
+def test_scheduler_config_validation():
+    with pytest.raises(ValueError, match="class_weights"):
+        SchedulerConfig(class_weights={"high": 1})
+    with pytest.raises(ValueError, match="quantum"):
+        SchedulerConfig(quantum_tokens=0)
+    with pytest.raises(ValueError, match="mix_prefill_tokens"):
+        SchedulerConfig(mix_prefill_tokens=0)
+
+
+# ------------------------------------------------------- waiting room
+
+
+def test_waiting_room_fifo_within_class_and_tenant():
+    room = WaitingRoom()
+    reqs = [_FakeReq() for _ in range(5)]
+    for r in reqs:
+        room.put(r)
+    assert room.qsize() == 5
+    assert [room.pop() for _ in range(5)] == reqs
+    assert room.pop() is None
+    assert room.empty()
+
+
+def test_waiting_room_class_shares_follow_weights():
+    """Stride scheduling: under full backlog the admitted-token shares
+    converge to class_weights — high dominates, low drains at its
+    weight share (the starvation bound: low is slowed, never stopped)."""
+    room = WaitingRoom(SchedulerConfig(
+        class_weights={"high": 16, "normal": 4, "low": 1},
+    ))
+    for _ in range(200):
+        room.put(_FakeReq("high"))
+        room.put(_FakeReq("normal"))
+        room.put(_FakeReq("low"))
+    popped = [room.pop().priority for _ in range(210)]
+    # the most urgent class serves first
+    assert popped[0] == "high"
+    counts = {p: popped.count(p) for p in PRIORITIES}
+    # equal costs -> pop shares == token shares == weight shares (21
+    # pops per full cycle: 16 high, 4 normal, 1 low)
+    assert counts["high"] == pytest.approx(210 * 16 / 21, abs=2)
+    assert counts["normal"] == pytest.approx(210 * 4 / 21, abs=2)
+    assert counts["low"] >= 8  # never starved
+    # a backlogged low request waits at most one full weight cycle
+    first_low = popped.index("low")
+    assert first_low <= 21
+
+
+def test_waiting_room_idle_class_banks_no_credit():
+    """A class that was idle joins at the current virtual time: it
+    cannot monopolize admissions to 'catch up' on its idle period."""
+    room = WaitingRoom(SchedulerConfig(
+        class_weights={"high": 4, "normal": 4, "low": 1},
+    ))
+    for _ in range(50):
+        room.put(_FakeReq("high"))
+    for _ in range(30):
+        room.pop()
+    for _ in range(50):
+        room.put(_FakeReq("normal"))  # joins late
+    window = [room.pop().priority for _ in range(20)]
+    # equal weights -> roughly alternating, not 20 straight normals
+    assert 5 <= window.count("normal") <= 15
+
+
+def test_waiting_room_tenant_drr_interleaves():
+    """Within one class, two tenants with equal fair weights admit in
+    DRR turns — a bulk tenant's deep queue cannot lock out a light
+    tenant that arrived later."""
+    room = WaitingRoom()
+    for _ in range(10):
+        room.put(_FakeReq(tenant="bulk"))
+    room.put(_FakeReq(tenant="light"))
+    first_six = [room.pop().tenant for _ in range(6)]
+    assert "light" in first_six
+
+
+def test_waiting_room_usage_weighted_tenant_quota():
+    """The ledger feeds DRR refill: a tenant holding ~all attributed
+    device time refills at the floor weight, so the light tenant's
+    head request is served first despite arriving second."""
+
+    class _Ledger:
+        def fair_share(self, tenant):
+            return 0.99 if tenant == "heavy" else 0.0
+
+    room = WaitingRoom(
+        SchedulerConfig(quantum_tokens=24), usage=_Ledger()
+    )
+    for _ in range(4):
+        room.put(_FakeReq(tenant="heavy"))
+    room.put(_FakeReq(tenant="light"))
+    # heavy refills 24 * 0.05 = 1.2/visit (cost 24 -> ~20 visits);
+    # light refills 24/visit and serves on its first visit
+    assert room.pop().tenant == "light"
+
+
+def test_waiting_room_parked_blocks_class_and_below():
+    room = WaitingRoom()
+    parked = _FakeReq("normal")
+    room.park(parked)
+    room.put(_FakeReq("normal", tenant="b"))
+    room.put(_FakeReq("low"))
+    high = _FakeReq("high")
+    room.put(high)
+    # only the strictly-higher class may admit past the parked head
+    assert room.pop() is high
+    assert room.pop() is None
+    # the parked head retries first and unblocks its class when taken
+    assert room.take_parked() is parked
+    assert room.pop() is not None
+
+
+def test_waiting_room_front_requeue_resumes_first():
+    room = WaitingRoom()
+    a, b = _FakeReq(tenant="t"), _FakeReq(tenant="t")
+    room.put(a)
+    room.put(b)
+    resumed = _FakeReq(tenant="t")
+    room.put(resumed, front=True)
+    assert room.pop() is resumed
+
+
+# ------------------------------------------------- engine integration
+
+
+def test_preempt_requires_prerequisites(tiny_llama):
+    module, _ = tiny_llama
+    with pytest.raises(ValueError, match="preempt"):
+        DecodeEngine(
+            module, slots=1, max_new_tokens=4, prompt_buckets=(16,),
+            scheduler=SchedulerConfig(preempt=True),
+            registry=telemetry.MetricsRegistry(),
+        )
+
+
+def _preempt_engine(module, registry=None, **kw):
+    registry = registry if registry is not None else telemetry.MetricsRegistry()
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_new_tokens", 48)
+    kw.setdefault("prompt_buckets", (64,))
+    kw.setdefault("chunk_steps", 2)
+    kw.setdefault("pipeline_depth", 2)
+    kw.setdefault("kv_block_size", 16)
+    kw.setdefault("kv_pool_blocks", 5)  # capacity 4: ONE resident fits
+    return DecodeEngine(
+        module, paged=True, registry=registry,
+        prefix_cache=RadixPrefixCache(block_size=16, registry=registry),
+        **kw,
+    )
+
+
+@pytest.mark.chaos
+def test_preempted_stream_resumes_with_token_parity(tiny_llama):
+    """THE acceptance: a low-priority mid-decode stream is evicted to
+    host (its blocks land in the prefix cache), the high-priority
+    waiter admits, the victim re-admits via the splice path — and BOTH
+    finish with tokens bit-identical to their uncontended solo runs,
+    zero caller-visible failures."""
+    module, params = tiny_llama
+    registry = telemetry.MetricsRegistry()
+    flight = telemetry.FlightRecorder()
+    engine = _preempt_engine(module, registry=registry, flight=flight)
+    try:
+        rng = np.random.default_rng(0)
+        low_prompt = rng.integers(1, 97, 8).tolist()
+        high_prompt = rng.integers(1, 97, 8).tolist()
+        low_out, low_err = [], []
+
+        def low_client():
+            try:
+                for chunk in engine.generate_stream(
+                    params, low_prompt, priority="low"
+                ):
+                    low_out.extend(chunk)
+            except BaseException as exc:  # pragma: no cover - fail below
+                low_err.append(exc)
+
+        t = threading.Thread(target=low_client)
+        t.start()
+        # wait for the victim's first harvested token (the resume
+        # point preemption needs), while its ~22 remaining decode
+        # chunks leave a wide submission window
+        deadline = time.monotonic() + 60
+        while not low_out and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert low_out, "low stream never produced a token"
+        high_out = engine.generate(
+            params, [high_prompt], max_new_tokens=8, priority="high"
+        )[0]
+        t.join(timeout=120)
+        assert not t.is_alive(), "low stream hung"
+        assert not low_err, f"caller-visible failure: {low_err}"
+        # bit-identical to the uncontended solo runs
+        assert high_out == _solo(module, params, high_prompt, 8)
+        assert low_out == _solo(module, params, low_prompt, 48)
+        sched = engine.stats()["scheduler"]
+        assert sched["preemptions"] >= 1
+        kinds = [e["kind"] for e in flight.dump()]
+        assert "preempt" in kinds and "resume" in kinds
+        pre = [e for e in flight.dump() if e["kind"] == "preempt"][0]
+        assert pre["priority"] == "low" and pre["by_priority"] == "high"
+        st = _assert_pool_drained(engine)
+        assert st["preempted_blocks"] >= 1
+        _assert_no_live_leases(engine.prefix_cache)
+        # the metric series exist under the closed label sets
+        text = registry.exposition()
+        assert "unionml_preemptions_total" in text
+        assert 'cause="priority"' in text
+        assert "unionml_sched_waiting_depth" in text
+    finally:
+        engine.close()
+
+
+@pytest.mark.chaos
+def test_high_priority_promotes_past_parked_head(tiny_llama):
+    """The promote path: while a pool-exhausted LOW admission is
+    parked (head-of-line for its class), a HIGH request small enough
+    to fit the remaining blocks admits PAST it — it must not wait out
+    the bulk backlog. The parked stream still completes with parity
+    once blocks free."""
+    module, params = tiny_llama
+    flight = telemetry.FlightRecorder()
+    engine = _preempt_engine(module, flight=flight, slots=3)
+    try:
+        rng = np.random.default_rng(4)
+        p_a = rng.integers(1, 97, 8).tolist()   # resident: 3 blocks
+        p_b = rng.integers(1, 97, 8).tolist()   # parks: needs 3 > 1 left
+        p_c = rng.integers(1, 97, 8).tolist()   # high: 1 block, fits
+        results = {}
+        lock = threading.Lock()
+
+        errors = []
+
+        def client(name, prompt, priority, n):
+            try:
+                out = engine.generate(
+                    params, [prompt], max_new_tokens=n, priority=priority
+                )[0]
+                with lock:
+                    results[name] = out
+            except BaseException as exc:
+                with lock:
+                    errors.append((name, exc))
+        t_a = threading.Thread(target=client, args=("a", p_a, "low", 40))
+        t_a.start()
+        deadline = time.monotonic() + 60
+        while not [
+            e for e in flight.dump() if e["kind"] == "decode"
+        ] and time.monotonic() < deadline:
+            time.sleep(0.002)
+        t_b = threading.Thread(target=client, args=("b", p_b, "low", 40))
+        t_b.start()
+        while not [
+            e for e in flight.dump() if e["kind"] == "pool_pressure"
+        ] and time.monotonic() < deadline:
+            time.sleep(0.002)
+        # b is parked; a high request that fits the leftover block
+        # admits past it (equal-priority preemption never fires: a
+        # and b are both low, c needs no eviction)
+        t_c = threading.Thread(target=client, args=("c", p_c, "high", 8))
+        t_c.start()
+        for t in (t_a, t_b, t_c):
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in (t_a, t_b, t_c))
+        assert not errors, f"caller-visible failures: {errors}"
+        assert results["a"] == _solo(module, params, p_a, 40)
+        assert results["b"] == _solo(module, params, p_b, 40)
+        assert results["c"] == _solo(module, params, p_c, 8)
+        promotes = [e for e in flight.dump() if e["kind"] == "promote"]
+        assert promotes and promotes[0]["priority"] == "high"
+        assert promotes[0]["past_priority"] == "low"
+        _assert_pool_drained(engine)
+        _assert_no_live_leases(engine.prefix_cache)
+    finally:
+        engine.close()
+
+
+def test_equal_priority_contention_parks_fifo(tiny_llama):
+    """Same class never preempts itself: pool pressure within one
+    priority parks exactly as before the scheduler (and everything
+    still completes token-parity)."""
+    module, params = tiny_llama
+    engine = _preempt_engine(module)
+    try:
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(1, 97, 8).tolist() for _ in range(3)]
+        outs = engine.generate(params, prompts, max_new_tokens=8)
+        for p, out in zip(prompts, outs):
+            assert out == _solo(module, params, p, 8)
+        assert engine.stats()["scheduler"]["preemptions"] == 0
+        _assert_pool_drained(engine)
+    finally:
+        engine.close()
+
+
+@pytest.mark.chaos
+def test_preemption_under_recovery_leaks_nothing(tiny_llama):
+    """Preemption composed with the PR 3 chaos harness: an OOM-shaped
+    device fault lands while a preempted stream is in (or past) its
+    evict→resume window. Whatever the interleaving, the engine must
+    not hang, must keep serving, and must return the pool AND the host
+    cache's lease refcounts to baseline."""
+    module, params = tiny_llama
+    fi = FaultInjector()
+    registry = telemetry.MetricsRegistry()
+    flight = telemetry.FlightRecorder()
+    engine = _preempt_engine(
+        module, registry=registry, flight=flight, fault_injector=fi,
+    )
+    try:
+        rng = np.random.default_rng(2)
+        low_prompt = rng.integers(1, 97, 8).tolist()
+        high_prompt = rng.integers(1, 97, 8).tolist()
+        results, errors = [], []
+        lock = threading.Lock()
+
+        def client(prompt, priority, n):
+            try:
+                out = engine.generate(
+                    params, [prompt], max_new_tokens=n, priority=priority
+                )[0]
+                with lock:
+                    results.append((prompt, n, out))
+            except Exception as exc:
+                with lock:
+                    errors.append(exc)  # the poisoned batch
+
+        t_low = threading.Thread(target=client, args=(low_prompt, "low", 48))
+        t_low.start()
+        deadline = time.monotonic() + 60
+        while not [
+            e for e in flight.dump() if e["kind"] == "decode"
+        ] and time.monotonic() < deadline:
+            time.sleep(0.002)
+        t_high = threading.Thread(
+            target=client, args=(high_prompt, "high", 8)
+        )
+        t_high.start()
+        # once the preemption fired, poison the NEXT decode dispatch:
+        # recovery now races the victim's evict→resume window
+        while not [
+            e for e in flight.dump() if e["kind"] == "preempt"
+        ] and time.monotonic() < deadline:
+            time.sleep(0.002)
+        fi.arm("engine.dispatch", exc=xla_oom_error())
+        t_low.join(timeout=120)
+        t_high.join(timeout=120)
+        assert not t_low.is_alive() and not t_high.is_alive(), (
+            "a request hung through preemption + recovery"
+        )
+        # completed requests (if any) are solo-parity
+        for prompt, n, out in results:
+            assert out == _solo(module, params, prompt, n)
+        # the engine still serves after the storm
+        probe = rng.integers(1, 97, 8).tolist()
+        assert engine.generate(
+            params, [probe], max_new_tokens=8
+        )[0] == _solo(module, params, probe, 8)
+        _assert_pool_drained(engine)
+        _assert_no_live_leases(engine.prefix_cache)
+    finally:
+        engine.close()
+
+
+def test_mix_budget_token_parity(tiny_llama):
+    """Stall-free mixing: a larger prefill token budget changes only
+    scheduling, never tokens (chunked-prefill admissions stay
+    bit-identical to solo runs)."""
+    module, params = tiny_llama
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=5, prompt_buckets=(64,),
+        prefill_chunk=16, chunk_steps=2, paged=True,
+        scheduler=SchedulerConfig(mix_prefill_tokens=48),
+        registry=telemetry.MetricsRegistry(),
+    )
+    try:
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(1, 97, 50).tolist() for _ in range(3)]
+        outs = engine.generate(params, prompts)
+        for p, out in zip(prompts, outs):
+            assert out == _solo(module, params, p, 5)
+        _assert_pool_drained(engine)
+    finally:
+        engine.close()
+
+
+def test_priority_in_usage_vector(tiny_llama):
+    module, params = tiny_llama
+    from unionml_tpu.serving.usage import UsageLedger
+
+    registry = telemetry.MetricsRegistry()
+    ledger = UsageLedger(registry=registry)
+    engine = DecodeEngine(
+        module, slots=2, max_new_tokens=4, prompt_buckets=(16,),
+        chunk_steps=2, usage=ledger, registry=registry,
+    )
+    try:
+        engine.generate(
+            params, [[1, 2, 3]], priority="high", tenant="acme"
+        )
+        engine.generate(params, [[4, 5, 6]], tenant="acme")
+        vec = ledger.report()["tenants"]["acme"]
+        assert vec["requests_by_priority"] == {"high": 1, "normal": 1}
+    finally:
+        engine.close()
+
+
+# ------------------------------------------------------- transports
+
+
+def test_stdlib_transport_priority_round_trip(trained_model):
+    import httpx
+
+    from unionml_tpu.serving.http import ServingApp
+
+    app = ServingApp(trained_model)
+    host, port = app.serve(port=0, blocking=False)
+    base = f"http://{host}:{port}"
+    try:
+        r = httpx.post(
+            f"{base}/predict",
+            json={"features": [{"x": 1.0, "x2": 1.0}]},
+            headers={"X-Priority": "high"},
+        )
+        assert r.status_code == 200
+        assert r.headers["x-priority"] == "high"
+        # default + echo on non-predict routes too
+        h = httpx.get(f"{base}/health")
+        assert h.headers["x-priority"] == "normal"
+        # outside the closed set: 422, never reaches the scheduler
+        bad = httpx.post(
+            f"{base}/predict", json={"features": []},
+            headers={"X-Priority": "urgent"},
+        )
+        assert bad.status_code == 422
+    finally:
+        app.shutdown()
+
+
+def test_fastapi_transport_priority_round_trip(trained_model):
+    fastapi = pytest.importorskip("fastapi")
+    from fastapi.testclient import TestClient
+
+    app = fastapi.FastAPI()
+    trained_model.serve(app)
+    with TestClient(app) as client:
+        r = client.post(
+            "/predict", json={"features": [[0.1, 0.2]]},
+            headers={"X-Priority": "LOW"},
+        )
+        assert r.status_code == 200
+        assert r.headers["x-priority"] == "low"
+        h = client.get("/health")
+        assert h.headers["x-priority"] == "normal"
+        bad = client.get("/health", headers={"X-Priority": "urgent"})
+        assert bad.status_code == 422
+
+
+def test_serverless_transport_priority_round_trip(trained_model):
+    import json as _json
+
+    from unionml_tpu.serving.serverless import gateway_handler
+
+    handler = gateway_handler(trained_model)
+    r = handler({
+        "httpMethod": "POST", "path": "/predict",
+        "headers": {"X-Priority": "high"},
+        "body": _json.dumps({"features": [[0.1, 0.2]]}),
+    })
+    assert r["statusCode"] == 200
+    assert r["headers"]["X-Priority"] == "high"
+    h = handler({"httpMethod": "GET", "path": "/health"})
+    assert h["headers"]["X-Priority"] == "normal"
+    bad = handler({
+        "httpMethod": "GET", "path": "/health",
+        "headers": {"X-Priority": "urgent"},
+    })
+    assert bad["statusCode"] == 422
+
+
+def test_http_replica_forwards_priority():
+    """The router hop: HttpReplica re-emits the ambient priority scope
+    as X-Priority, so a routed request keeps its preemption rights on
+    the remote replica's engine."""
+    from unionml_tpu.serving.router import HttpReplica
+
+    replica = HttpReplica("http://127.0.0.1:9")
+    with priority_scope("high"):
+        assert replica._headers()["X-Priority"] == "high"
+    assert replica._headers()["X-Priority"] == "normal"

@@ -6,6 +6,7 @@ math, Prometheus exposition, trace-span export, and the tier-1-safe
 import json
 import re
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -650,15 +651,27 @@ def test_metrics_smoke_servingapp_scrape():
         )
         with urllib.request.urlopen(req, timeout=30) as resp:
             assert json.loads(resp.read()) == [3.0]
-        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
-            assert resp.headers["Content-Type"].startswith("text/plain")
-            text = resp.read().decode()
-        fams = parse_prometheus_text(text)  # raises on malformed lines
-        assert fams["unionml_http_requests_total"]["type"] == "counter"
-        predict_rows = [
-            s for s in fams["unionml_http_requests_total"]["samples"]
-            if s[1]["path"] == "/predict"
-        ]
+        # the handler records its request series in a `finally` AFTER
+        # the response bytes are flushed, so a scrape racing the
+        # /predict handler thread can observe the registry a beat
+        # before the sample lands — retry briefly (the race window is
+        # microseconds; this bounds the wait, it never masks a missing
+        # series)
+        predict_rows: list = []
+        deadline = time.monotonic() + 5.0
+        while True:
+            with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                text = resp.read().decode()
+            fams = parse_prometheus_text(text)  # raises on malformed lines
+            assert fams["unionml_http_requests_total"]["type"] == "counter"
+            predict_rows = [
+                s for s in fams["unionml_http_requests_total"]["samples"]
+                if s[1]["path"] == "/predict"
+            ]
+            if predict_rows or time.monotonic() > deadline:
+                break
+            time.sleep(0.01)
         assert predict_rows and predict_rows[0][1]["status"] == "200"
         assert fams["unionml_http_request_ms"]["type"] == "histogram"
     finally:

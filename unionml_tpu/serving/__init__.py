@@ -60,6 +60,17 @@ tenant the transports propagate via :func:`~unionml_tpu.serving.usage
 vectors serve at ``GET /debug/usage`` — the measurement substrate for
 per-tenant quotas and fair scheduling.
 
+Preemptive scheduling (:mod:`unionml_tpu.serving.scheduler`,
+docs/robustness.md "Preemption & fairness"): every engine admission
+drains a priority-aware waiting room — per-(priority, tenant)
+deficit-weighted queues fed by the usage ledger's fair shares, with the
+``X-Priority`` header carried end to end like ``X-Tenant-ID`` — and on
+a paged engine with a prefix cache the scheduler acts under pool
+pressure: a strictly lower-priority resident's KV blocks are evicted
+to the host block store and the stream resumed later via the splice
+path with exact token parity, so one bulk tenant can no longer stall
+every other caller behind a full pool.
+
 Above all of it sits the cluster front door
 (:mod:`unionml_tpu.serving.router`, docs/robustness.md "Fleet
 robustness"): a :class:`~unionml_tpu.serving.router.FleetRouter`
@@ -92,6 +103,15 @@ from unionml_tpu.serving.router import (
     RouterPolicy,
     make_router_app,
 )
+from unionml_tpu.serving.scheduler import (
+    PRIORITIES,
+    PreemptiveScheduler,
+    SchedulerConfig,
+    WaitingRoom,
+    current_priority,
+    priority_scope,
+    validate_priority,
+)
 from unionml_tpu.serving.usage import (
     UsageLedger,
     current_tenant,
@@ -102,8 +122,11 @@ from unionml_tpu.serving.usage import (
 __all__ = [
     "DeadlineExceeded", "DecodeEngine", "EngineReplica",
     "EngineUnavailable", "FaultInjector", "FleetRouter", "HttpReplica",
-    "KVBlockPool", "MicroBatcher", "Overloaded", "PoolExhausted",
-    "RadixPrefixCache", "ReplicaHandle", "RouterPolicy", "ServingApp",
-    "UsageLedger", "create_app", "current_tenant", "deadline_scope",
-    "make_router_app", "tenant_scope", "validate_tenant",
+    "KVBlockPool", "MicroBatcher", "Overloaded", "PRIORITIES",
+    "PoolExhausted", "PreemptiveScheduler", "RadixPrefixCache",
+    "ReplicaHandle", "RouterPolicy", "SchedulerConfig", "ServingApp",
+    "UsageLedger", "WaitingRoom", "create_app", "current_priority",
+    "current_tenant", "deadline_scope", "make_router_app",
+    "priority_scope", "tenant_scope", "validate_priority",
+    "validate_tenant",
 ]

@@ -108,6 +108,14 @@ from unionml_tpu.serving.faults import (
     current_deadline_ms,
 )
 from unionml_tpu.serving.kv_pool import KVBlockPool, PoolExhausted
+from unionml_tpu.serving.scheduler import (
+    DEFAULT_PRIORITY,
+    PreemptiveScheduler,
+    SchedulerConfig,
+    current_priority,
+    priority_rank,
+    validate_priority,
+)
 from unionml_tpu.serving.usage import (
     DEFAULT_TENANT,
     current_tenant,
@@ -145,6 +153,24 @@ def _splice_rows(dst_tree, src_tree, b_start, r_start):
         )
         for dst_layer, src_layer in zip(dst_tree, src_tree)
     )
+
+
+def _host_blocks(full, j0: int, j1: int):
+    """Owned ``[1, block, ...]`` host copies of blocks ``[j0, j1)``
+    from a block-major extract ([n_blocks, block, ...] per buffer —
+    the table-addressed gather): block j is row j, re-leading-axised
+    to the prefix cache's store form. The SINGLE home for the
+    re-axis (the harvest-insert and preempt-save paths both feed the
+    same store — a layout change applied to one and not the other
+    would silently corrupt resumes or cache hits); ``.copy()`` so a
+    stored block never pins the whole extract window in RAM."""
+    return [
+        tuple(
+            tuple(buf[j][None].copy() for buf in layer)
+            for layer in full
+        )
+        for j in range(j0, j1)
+    ]
 
 
 def _concat_rows(trees):
@@ -187,7 +213,9 @@ class _Admission:
     next_splice: int = 0
 
 
-@dataclass
+@dataclass(eq=False)  # identity semantics: the waiting room's parked
+# lane membership tests (`req in parked`) must never field-compare two
+# requests — the numpy prompt would make `==` ambiguous
 class _Request:
     prompt: np.ndarray                  # int32 [P], truncated to max bucket
     max_new_tokens: int
@@ -213,6 +241,11 @@ class _Request:
     # usage metering (docs/observability.md "Usage metering"): the
     # validated tenant id this request's resource vector is billed to
     tenant: str = DEFAULT_TENANT
+    # preemptive scheduling (docs/robustness.md "Preemption &
+    # fairness"): the validated priority class (X-Priority header /
+    # generate(priority=)); the waiting room orders admissions by it
+    # and the scheduler may evict strictly-lower-priority residents
+    priority: str = DEFAULT_PRIORITY
     # absolute perf_counter deadline (None = none): checked at DEQUEUE,
     # so an expired request is shed before it consumes prefill
     deadline: Optional[float] = None
@@ -235,6 +268,18 @@ class _Request:
     # from the tracker's per-program cost analysis
     _block_t0: List[float] = field(default_factory=list)
     _attr_flops: float = 0.0
+    # preemption bookkeeping: times evicted, when the last eviction
+    # happened (resume-wait span anchor; also marks the request as
+    # resumed so ttft/queue timings are not overwritten), and the
+    # lease pinning the evicted KV blocks in the host prefix cache
+    # until the resume admission takes its own
+    _preempts: int = 0
+    _preempted_at: float = 0.0
+    _resume_lease: Optional[Any] = None
+    # generated tokens already FOLDED INTO ``prompt`` by a previous
+    # resume: the next eviction appends only tokens[_prompt_incl:], or
+    # a twice-preempted stream would duplicate its first segment
+    _prompt_incl: int = 0
 
     def emit(self, chunk: List[int]) -> None:
         if self.stream is not None and chunk:
@@ -410,6 +455,24 @@ class DecodeEngine:
             Pool telemetry: ``unionml_kv_pool_*``. Not composable
             with ``draft_module`` (the draft would need its own
             pool).
+        scheduler: a :class:`~unionml_tpu.serving.scheduler
+            .SchedulerConfig` tuning the PREEMPTIVE, PRIORITY-AWARE
+            admission scheduler (docs/robustness.md "Preemption &
+            fairness"). Every engine runs the scheduler's waiting
+            room: requests carry a priority class (``X-Priority``
+            header / ``generate(priority=)``) and admissions drain
+            per-(priority, tenant) deficit-weighted queues — a
+            single-tenant, single-priority stream degenerates to the
+            historical FIFO. Preemption (evicting a strictly
+            lower-priority resident's KV blocks to the host
+            prefix-cache store so a higher-priority waiter can admit,
+            resuming the victim later via the splice path with exact
+            token parity) auto-enables when the engine is ``paged``
+            AND has a ``prefix_cache`` (the lossless evict/resume
+            prerequisites); ``SchedulerConfig(preempt=True)`` makes
+            missing prerequisites a construction error instead of a
+            silent park-only fallback. ``None`` (default) uses the
+            default config.
     """
 
     def __init__(
@@ -447,6 +510,7 @@ class DecodeEngine:
         kv_pool_bytes: Optional[int] = None,
         kv_pool_blocks: Optional[int] = None,
         kv_block_size: Optional[int] = None,
+        scheduler: Optional[SchedulerConfig] = None,
     ):
         import jax
 
@@ -682,7 +746,6 @@ class DecodeEngine:
         # may still write a just-retired slot's rows, and a recycled
         # block must never see them
         self._deferred_free: List = []
-        self._parked: Optional[_Request] = None
         if self.paged:
             blk = self._kv_block_size
             self._table_width = self.cache_len // blk
@@ -719,7 +782,30 @@ class DecodeEngine:
         # chunked admission in progress (dispatcher thread only); its
         # reserved slot keeps occupant None until the final chunk lands
         self._admission: Optional[_Admission] = None
-        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        # preemptive, priority-aware admission scheduling
+        # (docs/robustness.md "Preemption & fairness"): the waiting
+        # room replaces the old FIFO queue + single-slot park —
+        # per-(priority, tenant) deficit-weighted queues with a
+        # bounded parked lane for pool-exhausted admissions
+        sched_cfg = scheduler if scheduler is not None else SchedulerConfig()
+        can_preempt = self.paged and self.prefix_cache is not None
+        if sched_cfg.preempt and not can_preempt:
+            raise ValueError(
+                "SchedulerConfig(preempt=True) needs a paged engine "
+                "with a prefix cache — eviction extracts the victim's "
+                "pool blocks into the host prefix-cache store and "
+                "resume splices them back (pointer swaps, exact token "
+                "parity); pass paged=True and prefix_cache=..."
+            )
+        self._preempt_enabled = (
+            can_preempt if sched_cfg.preempt is None else bool(sched_cfg.preempt)
+        )
+        self._mix_budget = sched_cfg.mix_prefill_tokens
+        self._sched = PreemptiveScheduler(
+            sched_cfg, registry=self._registry,
+            engine_label=self.instance, usage=self._usage,
+        )
+        self._room = self._sched.room
         self._lock = threading.Lock()
         # dispatch→harvest pipeline: FIFO of in-flight readbacks; the
         # semaphore caps chunk entries at pipeline_depth
@@ -964,6 +1050,8 @@ class DecodeEngine:
         so the off-leg's idle gap never inflates the first on-leg
         window."""
         self._usage = ledger or None
+        # the waiting room's fair-share weighting follows the swap
+        self._room._usage = self._usage
 
     @property
     def breaker_open(self) -> bool:
@@ -990,11 +1078,12 @@ class DecodeEngine:
                 # 'prefill' in the trail. queue_depth = requests ahead.
                 self._flight_rec(
                     "submit", rid=req.rid, tenant=req.tenant,
+                    priority=req.priority,
                     prompt_tokens=len(req.prompt),
-                    queue_depth=self._queue.qsize(),
+                    queue_depth=self._room.qsize(),
                 )
-                self._queue.put(req)
-        self._g_queue_depth.set(self._queue.qsize())
+                self._room.put(req)
+        self._g_queue_depth.set(self._room.qsize())
 
     def _usage_rejected(self, reqs: List[_Request], reason: str) -> None:
         """Tenant dimension on admission-control rejections (all reqs
@@ -1054,7 +1143,7 @@ class DecodeEngine:
                 reason="breaker_open", retry_after_s=max(0.1, remaining),
             )
         if self.max_queue_depth is not None:
-            depth = self._queue.qsize()
+            depth = self._room.qsize()
             if depth + n_new > self.max_queue_depth:
                 self._m_rejected["queue_full"].inc(n_new)
                 self._usage_rejected(reqs, "queue_full")
@@ -1082,7 +1171,7 @@ class DecodeEngine:
             status = "ok"
         return {
             "status": status,
-            "queue_depth": self._queue.qsize(),
+            "queue_depth": self._room.qsize(),
             "breaker_open": breaker,
         }
 
@@ -1102,7 +1191,7 @@ class DecodeEngine:
         while True:
             with self._lock:
                 drained = (
-                    self._queue.empty()
+                    self._room.empty()
                     and self._admitting == 0
                     and self._admission is None
                     and all(r is None for r in self._occupant)
@@ -1789,6 +1878,7 @@ class DecodeEngine:
         max_new_tokens: Optional[int] = None,
         deadline_ms: Optional[float] = None,
         tenant: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> list:
         """Generate for a list of token-id prompts; blocks until all done.
 
@@ -1807,11 +1897,22 @@ class DecodeEngine:
         .tenant_scope` the transports open from ``X-Tenant-ID``) names
         who this call's resource vector is billed to when the engine
         runs a usage ledger; defaults to ``anonymous``.
+
+        ``priority`` (or the ambient :func:`~unionml_tpu.serving
+        .scheduler.priority_scope` the transports open from
+        ``X-Priority``) sets the scheduling class — ``high`` /
+        ``normal`` / ``low`` — the waiting room orders admissions by
+        and the preemptive scheduler arbitrates pool pressure with
+        (docs/robustness.md "Preemption & fairness").
         """
         self.bind(params)
         tenant = (
             validate_tenant(tenant) if tenant is not None
             else current_tenant()
+        )
+        priority = (
+            validate_priority(priority) if priority is not None
+            else current_priority()
         )
         n = max_new_tokens if max_new_tokens is not None else self.max_new_tokens
         if not 1 <= n <= self.max_new_tokens:
@@ -1836,7 +1937,10 @@ class DecodeEngine:
             rows.append(row)
         reqs = []
         for row in rows:
-            req = _Request(prompt=row, max_new_tokens=n, tenant=tenant)
+            req = _Request(
+                prompt=row, max_new_tokens=n, tenant=tenant,
+                priority=priority,
+            )
             if deadline_ms is not None:
                 req.deadline = req.submitted + deadline_ms / 1e3
             req.rid = self._tracer.new_request("generate")
@@ -1873,6 +1977,7 @@ class DecodeEngine:
         max_new_tokens: Optional[int] = None,
         deadline_ms: Optional[float] = None,
         tenant: Optional[str] = None,
+        priority: Optional[str] = None,
     ):
         """Yield token chunks for ONE prompt as the engine harvests them.
 
@@ -1888,6 +1993,10 @@ class DecodeEngine:
         tenant = (
             validate_tenant(tenant) if tenant is not None
             else current_tenant()
+        )
+        priority = (
+            validate_priority(priority) if priority is not None
+            else current_priority()
         )
         n = max_new_tokens if max_new_tokens is not None else self.max_new_tokens
         if not 1 <= n <= self.max_new_tokens:
@@ -1905,7 +2014,7 @@ class DecodeEngine:
             row = np.concatenate([self._prefix_tokens, row])
         req = _Request(
             prompt=row, max_new_tokens=n, stream=queue.Queue(),
-            tenant=tenant,
+            tenant=tenant, priority=priority,
         )
         if deadline_ms is not None:
             req.deadline = req.submitted + deadline_ms / 1e3
@@ -1964,7 +2073,11 @@ class DecodeEngine:
             busy = (
                 any(r is not None for r in self._occupant)
                 or self._admitting > 0
-                or not self._queue.empty()
+                or not self._room.empty()
+                # a preempted stream in evict→resume limbo lives only
+                # in the in-flight pipeline: its host KV belongs to
+                # the CURRENT weights, so a swap must wait for it
+                or not self._inflight.empty()
             )
             if self._params is not None and busy:
                 raise RuntimeError(
@@ -2059,7 +2172,7 @@ class DecodeEngine:
             # counts, MFU/roofline ratios (docs/observability.md)
             out["programs"] = self._programs.stats()
         out["robustness"] = {
-            "queue_depth": self._queue.qsize(),
+            "queue_depth": self._room.qsize(),
             "rejected": {
                 reason: int(c.value)
                 for reason, c in self._m_rejected.items()
@@ -2069,6 +2182,9 @@ class DecodeEngine:
             "breaker_open": self.breaker_open,
             "draining": self._draining,
         }
+        # the preemptive scheduler's view: per-class waiting depths,
+        # parked pool-exhausted admissions, evictions performed
+        out["scheduler"] = self._sched.stats()
         for name, h in (
             ("queue_wait_ms", self._h_queue),
             ("prefill_ms", self._h_prefill),
@@ -2102,6 +2218,7 @@ class DecodeEngine:
             self._usage.reset_stats()
         if self._programs is not None:
             self._programs.reset()
+        self._sched.reset_stats()
 
     def close(self):
         self._stop.set()
@@ -2109,14 +2226,18 @@ class DecodeEngine:
         self._harvester.join(timeout=5.0)
         with self._lock:
             adm, self._admission = self._admission, None
-            parked, self._parked = self._parked, None
         if adm is not None:
             self._drop_admission(adm.req, RuntimeError("decode engine closed"))
-        if parked is not None:
+        while True:
+            parked = self._room.take_parked()
+            if parked is None:
+                break
             self._drop_admission(parked, RuntimeError("decode engine closed"))
         # drain the in-flight pipeline the harvester no longer owns:
         # stranded insert entries still hold lease refcounts — leaking
-        # them would pin blocks in a user-supplied cache forever
+        # them would pin blocks in a user-supplied cache forever — and
+        # a stranded preempt entry holds a request in evict→resume
+        # limbo that no queue or slot structure can see
         while True:
             try:
                 entry = self._inflight.get_nowait()
@@ -2124,12 +2245,13 @@ class DecodeEngine:
                 break
             if entry[0] == "insert":
                 self._release_lease(entry[2])
-        while True:
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
+            elif entry[0] == "preempt":
+                self._fail_orphan(
+                    entry[2], RuntimeError("decode engine closed")
+                )
+        for req in self._room.pop_all():
             req.error = RuntimeError("decode engine closed")
+            self._release_lease(req)  # a resumed-queued stream's pin
             self._tracer.finish_request(req.rid)
             req.event.set()
             req.finish_stream()
@@ -2164,9 +2286,18 @@ class DecodeEngine:
         with self._lock:
             slot = self._occupant.index(None)
         t0 = time.perf_counter()
-        req.queue_wait_ms = (t0 - req.submitted) * 1e3
+        if req._preempted_at:
+            # a resumed stream: the original queue wait already landed
+            # in the histogram/span — record the evict→re-admit gap as
+            # its own span instead of corrupting the queue timing
+            self._tracer.record_span(
+                req.rid, f"resume-wait[{req._preempts - 1}]",
+                req._preempted_at, t0,
+            )
+        else:
+            req.queue_wait_ms = (t0 - req.submitted) * 1e3
+            self._tracer.record_span(req.rid, "queue", req.submitted, t0)
         req._dispatch_t = t0
-        self._tracer.record_span(req.rid, "queue", req.submitted, t0)
         bucket = self._bucket_for(len(req.prompt))
         padded = np.full(bucket, self.pad_id, np.int32)
         padded[: len(req.prompt)] = req.prompt
@@ -2222,7 +2353,9 @@ class DecodeEngine:
             self._state = new_state
             self._occupant[slot] = req
             self._slot_gen[slot] += 1
-            req._expected = 1
+            # resumed streams already hold harvested tokens; dispatch
+            # accounting continues from them (fresh admissions: 0 + 1)
+            req._expected = len(req.tokens) + 1
             self._m_slots_busy.set(self._slots_in_use_locked())
         self._flight_rec(
             "prefill", rid=req.rid, tenant=req.tenant, slot=slot,
@@ -2298,11 +2431,48 @@ class DecodeEngine:
         self._inflight.put(("insert", epoch, req, first_new, rows))
 
     def _release_lease(self, req: _Request) -> None:
-        """Unpin the request's matched cache blocks (idempotent; error
-        paths and the insert path may both get here)."""
+        """Unpin the request's matched cache blocks AND any resume pin
+        (idempotent; error paths and the insert path may both get
+        here). Entry ordering makes releasing both safe: an insert
+        entry for admission N always processes before the preempt
+        entry that would set a new resume lease."""
         lease, req._lease = req._lease, None
         if lease is not None:
             lease.release()
+        self._release_resume_lease(req)
+
+    def _release_resume_lease(self, req: _Request) -> None:
+        """Drop the pin holding a preempted stream's evicted KV blocks
+        in the host cache — once the resume admission has taken its
+        own match lease over the same path, or on any terminal path
+        (idempotent)."""
+        lease, req._resume_lease = req._resume_lease, None
+        if lease is not None:
+            lease.release()
+
+    def _fail_orphan(self, req: _Request, exc: BaseException) -> None:
+        """Fail a request stranded in evict→resume limbo: between its
+        preemption and its requeue it lives ONLY in the in-flight
+        pipeline, so recovery and close cannot reach it through any
+        occupant/queue structure — the preempt entry's failure arms
+        must finish it or its waiter hangs forever. Its pool blocks
+        were already released at eviction (generation-guarded against
+        a concurrent pool reset)."""
+        with self._lock:
+            if req.event.is_set():
+                return
+            req.error = exc
+        self._release_lease(req)
+        self._m_errors.inc()
+        if self._usage is not None:
+            self._usage.record_drop(req.tenant, "error")
+        self._flight_rec(
+            "drop", rid=req.rid, tenant=req.tenant,
+            cause=f"error:{type(exc).__name__}",
+        )
+        self._tracer.finish_request(req.rid)
+        req.event.set()
+        req.finish_stream()
 
     # ------------------------------------------------------------------ #
     # usage metering helpers (no-ops when usage=None)
@@ -2487,6 +2657,7 @@ class DecodeEngine:
                         req.tenant, queue_ms=req.queue_wait_ms,
                         prefill_tokens=req._prefilled_tokens,
                         cached_tokens=req._saved_tokens,
+                        priority=req.priority,
                     )
             self._flight_rec(
                 "finish", rid=req.rid, tenant=req.tenant, slot=slot,
@@ -2511,9 +2682,17 @@ class DecodeEngine:
             # requests and the donated device buffers it references may
             # be invalid — never materialize them. An insert entry
             # still releases its lease (idempotent) so recovery can
-            # never leak a prefix-cache pin.
+            # never leak a prefix-cache pin, and a preempt entry must
+            # FAIL its evicted stream (between eviction and requeue it
+            # lives only here — recovery could not see it).
             if entry[0] == "insert":
                 self._release_lease(entry[2])
+            elif entry[0] == "preempt":
+                self._fail_orphan(entry[2], RuntimeError(
+                    "engine recovered while this stream was preempted; "
+                    "its evicted device state belonged to the poisoned "
+                    "era"
+                ))
             return
         self._fire("engine.harvest")
         if entry[0] == "insert":
@@ -2535,20 +2714,7 @@ class DecodeEngine:
                         for layer in rows
                     )
                     if self.paged:
-                        # extract arrived block-major ([n_blocks, blk,
-                        # ...] per buffer — the table-addressed gather):
-                        # block j is row j, re-leading-axised to the
-                        # host store's [1, blk, ...] form
-                        blocks = [
-                            tuple(
-                                tuple(
-                                    buf[j][None].copy()
-                                    for buf in layer
-                                )
-                                for layer in full
-                            )
-                            for j in range(first_new, nb)
-                        ]
+                        blocks = _host_blocks(full, first_new, nb)
                     else:
                         blocks = [
                             tuple(
@@ -2566,13 +2732,57 @@ class DecodeEngine:
             finally:
                 self._release_lease(req)
             return
+        if entry[0] == "preempt":
+            # a preempted stream's evicted KV lands in the host block
+            # store, the path is pinned against LRU, and the stream
+            # re-enters the waiting room at the FRONT of its queue —
+            # the resume admission then splices these exact bytes back
+            # (pointer swaps, exact token parity; docs/robustness.md
+            # "Preemption & fairness"). FIFO entry order guarantees
+            # the insert lands before the re-admission can match.
+            _, _, req, nb, rows, resume_prompt, incl = entry
+            cache = self.prefix_cache
+            try:
+                if rows is not None and cache is not None and nb > 0:
+                    full = tuple(
+                        tuple(np.asarray(buf) for buf in layer)
+                        for layer in rows
+                    )
+                    cache.insert(
+                        resume_prompt, 0, _host_blocks(full, 0, int(nb))
+                    )
+            except Exception as exc:
+                # a failed save must not fail the stream: the resume
+                # admission simply matches fewer blocks and recomputes
+                logger.info(f"preempt KV save skipped: {exc!r}")
+            if cache is not None:
+                # eviction-target pinning: the saved path must survive
+                # LRU pressure until the resume admission takes its
+                # own match lease over it
+                self._release_resume_lease(req)  # a prior preemption's
+                req._resume_lease = cache.lease(resume_prompt)
+            req.prompt = resume_prompt
+            req._prompt_incl = incl
+            req._matched_blocks = 0
+            req._park_logged = False
+            self._flight_rec(
+                "resume", rid=req.rid, tenant=req.tenant,
+                priority=req.priority, tokens=len(req.tokens),
+                cached_blocks=int(nb),
+            )
+            self._room.put(req, front=True)
+            self._g_queue_depth.set(self._room.qsize())
+            return
         if entry[0] == "prefill":
             _, _, slot, req, first = entry
             tok = int(np.asarray(first))
             now = time.perf_counter()  # after the readback: prefill_ms
             with self._lock:           # includes its in-flight lag
                 req.prefill_ms = (now - req._dispatch_t) * 1e3
-                req.ttft_ms = (now - req.submitted) * 1e3
+                if req.ttft_ms == 0.0:
+                    # a RESUMED stream's first token already happened;
+                    # its ttft must stay the first segment's
+                    req.ttft_ms = (now - req.submitted) * 1e3
                 req._prefill_end = now
                 self._tracer.record_span(
                     req.rid, "prefill", req._dispatch_t, now,
@@ -2595,6 +2805,9 @@ class DecodeEngine:
                     {req.tenant: 1}, device_s=device_s,
                     flops=req._attr_flops,
                 )
+                # drained: a resumed stream's next prefill segment
+                # must not re-bill the first segment's programs
+                req._attr_flops = 0.0
             return
         _, _, mask, gens, toks, dispatched, seq = entry
         if self.draft is not None:
@@ -2816,12 +3029,35 @@ class DecodeEngine:
         with self._lock:
             if None not in self._occupant:
                 return None
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
+            req = self._room.pop()
+            if req is None:
                 return None
             self._admitting += 1
-        self._g_queue_depth.set(self._queue.qsize())
+        self._g_queue_depth.set(self._room.qsize())
+        return req
+
+    def _pop_bypass(self, parked: _Request) -> Optional[_Request]:
+        """The PROMOTE path: while ``parked`` head-of-line-blocks its
+        class on pool exhaustion, a STRICTLY higher-priority request
+        may still admit past it (the waiting room's parked-lane gating
+        releases nothing at or below the parked class) — without this,
+        a premium request would wait out a bulk backlog's parked head
+        in exactly the overload the scheduler exists for."""
+        with self._lock:
+            if None not in self._occupant:
+                return None
+            req = self._room.pop(
+                above_rank=priority_rank(parked.priority)
+            )
+            if req is None:
+                return None
+            self._admitting += 1
+        self._flight_rec(
+            "promote", rid=req.rid, tenant=req.tenant,
+            priority=req.priority, past=parked.rid,
+            past_priority=parked.priority,
+        )
+        self._g_queue_depth.set(self._room.qsize())
         return req
 
     def _drop_admission(self, req: _Request, exc: BaseException) -> None:
@@ -2860,6 +3096,151 @@ class DecodeEngine:
         req.event.set()
         req.finish_stream()
 
+    # ------------------------------------------------------------------ #
+    # preemption (docs/robustness.md "Preemption & fairness")
+    # ------------------------------------------------------------------ #
+
+    def _eligible_victims_locked(self) -> List:
+        """Residents the scheduler may evict (lock held): prefill
+        harvested (there is a token-exact resume point), waiter still
+        listening, and the resume prompt — original prompt plus every
+        harvested token — still fits an admission bucket (the splice
+        path needs a ``[1, bucket]`` workspace)."""
+        out = []
+        for slot, r in enumerate(self._occupant):
+            if r is None or r.abandoned or not r.tokens:
+                continue
+            if (
+                len(r.prompt) + len(r.tokens) - r._prompt_incl
+                > self.buckets[-1]
+            ):
+                continue
+            out.append((slot, r))
+        return out
+
+    def _maybe_preempt(self, waiter: _Request) -> bool:
+        """A parked (pool-exhausted) admission asks the scheduler to
+        act: evict at most ONE strictly-lower-priority resident per
+        dispatcher pass (gradual — each eviction frees blocks behind
+        the dispatch fence, and the parked retry re-checks the pool
+        every pass). Returns True when a victim was evicted."""
+        if not self._preempt_enabled:
+            return False
+        with self._lock:
+            # anti-cascade: blocks already freed onto the deferred
+            # fence land as soon as the in-flight chunks harvest — if
+            # they cover the waiter, a further eviction would thrash a
+            # second victim for blocks that are already on their way
+            pending = sum(len(ids) for _, ids in self._deferred_free)
+            needed = self.kv_pool.blocks_for_rows(min(
+                len(waiter.prompt) + waiter.max_new_tokens
+                - len(waiter.tokens),
+                self.cache_len,
+            ))
+            if self.kv_pool.available + pending >= needed:
+                return False
+            victim = self._sched.select_victim(
+                waiter, self._eligible_victims_locked()
+            )
+        if victim is None:
+            return False
+        return self._preempt_victim(victim[0], victim[1], waiter)
+
+    def _preempt_victim(
+        self, slot: int, victim: _Request, waiter: _Request
+    ) -> bool:
+        """Evict ``victim`` from its slot so ``waiter`` can admit
+        (dispatcher thread): gather the victim's finalized full KV
+        blocks by table entry (the existing extract path — the async
+        device→host copy starts now, the harvester materializes it),
+        retire the slot with deferred-fence block frees (in-flight
+        chunks may still write them), and hand the stream to the
+        harvester's ``preempt`` entry, which stores the blocks in the
+        host prefix cache and requeues the stream at the front of its
+        queue. The resume admission splices the SAME bytes back, so
+        the resumed stream's tokens are exactly its solo run's
+        (chaos-tested in tests/unit/test_scheduler.py)."""
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        blk = self._kv_block_size
+        with self._lock:
+            if self._occupant[slot] is not victim or self._state is None:
+                return False
+            ep0 = self._epoch
+            st = self._state
+            # only FULL blocks whose every row is covered by harvested
+            # tokens are saved: rows past prompt + new-tokens[:-1] may
+            # be written by in-flight chunks mid-extract (same block),
+            # so the sub-block tail is recomputed at resume instead —
+            # the same recompute the warm-partial-hit admission path
+            # runs. A resumed victim's prompt already CONTAINS its
+            # first _prompt_incl tokens, so only the tail since the
+            # last resume counts as new rows.
+            nb = min(
+                (
+                    len(victim.prompt)
+                    + len(victim.tokens) - victim._prompt_incl - 1
+                ) // blk,
+                self._slot_covered[slot],
+            )
+            ids = self._table[slot, :nb].copy()
+        rows = None
+        if nb > 0:
+            # dispatched on the dispatcher thread BEFORE any later
+            # decode chunk, so donation order guarantees it reads the
+            # pre-eviction pool (the _schedule_insert precedent)
+            rows = self._extract_blocks(st["pool"], jnp.asarray(ids))
+            for layer in rows:
+                for buf in layer:
+                    _start_host_copy(buf)
+        with self._lock:
+            if self._epoch != ep0 or self._occupant[slot] is not victim:
+                return False  # recovery/retirement raced: nothing evicted
+            if (
+                len(victim.prompt) + len(victim.tokens)
+                - victim._prompt_incl > self.buckets[-1]
+            ):
+                # tokens harvested since the eligibility check pushed
+                # the resume prompt past the largest bucket — evicting
+                # now would fail the stream at re-admission (a caller-
+                # visible error); leave it resident instead
+                return False
+            # stale-generation machinery: tokens from chunks already
+            # in flight for this slot are discarded at harvest (they
+            # are recomputed after resume), so the snapshot below is
+            # the victim's final pre-eviction state
+            self._slot_gen[slot] += 1
+            self._occupant[slot] = None
+            victim._preempts += 1
+            victim._preempted_at = time.perf_counter()
+            resume_prompt = np.concatenate([
+                victim.prompt,
+                np.asarray(
+                    victim.tokens[victim._prompt_incl:], np.int32
+                ),
+            ])
+            incl = len(victim.tokens)
+            freed = len(victim._block_ids)
+            self._release_blocks_locked(victim, slot)
+            self.kv_pool.note_preempted(freed)
+            self._m_slots_busy.set(self._slots_in_use_locked())
+        self._sched.record_preemption("priority")
+        self._flight_rec(
+            "preempt", rid=victim.rid, tenant=victim.tenant,
+            priority=victim.priority, slot=slot, by=waiter.rid,
+            by_priority=waiter.priority, blocks_saved=int(nb),
+            blocks_freed=freed, tokens=len(victim.tokens),
+        )
+        self._tracer.record_span(
+            victim.rid, f"preempt[{victim._preempts - 1}]", t0,
+            time.perf_counter(), tokens=len(victim.tokens),
+        )
+        self._inflight.put(
+            ("preempt", ep0, victim, nb, rows, resume_prompt, incl)
+        )
+        return True
+
     def _start_admission(self, req: _Request) -> None:
         """Dispatcher: begin admitting a dequeued request (counted in
         ``_admitting`` by ``_pop_request``). With a prefix cache, the
@@ -2896,8 +3277,13 @@ class DecodeEngine:
                 # pass, FIFO preserved — nothing admits past it) until
                 # retirements free blocks. Queue backlog behind a
                 # parked admission sheds through max_queue_depth.
+                # a RESUMED stream's prompt already contains its
+                # harvested tokens, so it only decodes the remainder —
+                # without the subtraction a resume could demand more
+                # than the whole pool and park forever
                 rows_cap = min(
-                    len(req.prompt) + req.max_new_tokens, self.cache_len
+                    len(req.prompt) + req.max_new_tokens - len(req.tokens),
+                    self.cache_len,
                 )
                 needed = self.kv_pool.blocks_for_rows(rows_cap)
                 with self._lock:
@@ -2909,7 +3295,7 @@ class DecodeEngine:
                             needed, count_failure=not req._park_logged
                         )
                     except PoolExhausted as exc:
-                        self._parked = req
+                        self._room.park(req)
                         if not req._park_logged:
                             req._park_logged = True
                             resident = [
@@ -2921,11 +3307,14 @@ class DecodeEngine:
                             )
                             # post-hoc 429 analysis: distinguishes
                             # pool-full from queue-full, and names the
-                            # preemption candidate a future scheduler
-                            # would evict (docs/observability.md)
+                            # oldest-resident candidate; the SCHEDULER
+                            # acts on its own victim policy when a
+                            # strictly lower-priority resident exists
+                            # (docs/robustness.md)
                             self._flight_rec(
                                 "pool_pressure", reason="alloc_fail",
-                                rid=req.rid, needed_blocks=exc.needed,
+                                rid=req.rid, priority=req.priority,
+                                needed_blocks=exc.needed,
                                 available_blocks=exc.available,
                                 preempt_candidate=(
                                     cand.rid if cand is not None else None
@@ -2963,6 +3352,9 @@ class DecodeEngine:
                 lease = cache.match(req.prompt)
                 req._lease = lease
                 req._matched_blocks = lease.n_blocks
+                # the resume pin's job is done: the admission's own
+                # match lease now covers the same path
+                self._release_resume_lease(req)
                 blk = cache.block_size
                 # usable match: unit-quantized, and capped one token
                 # short of the prompt — finish_prefill must run at
@@ -3115,7 +3507,9 @@ class DecodeEngine:
                 self._admission = None
                 self._occupant[adm.slot] = req
                 self._slot_gen[adm.slot] += 1
-                req._expected = 1
+                # resumed streams already hold harvested tokens;
+                # dispatch accounting continues from them
+                req._expected = len(req.tokens) + 1
                 self._admitting -= 1
                 self._m_slots_busy.set(self._slots_in_use_locked())
             self._flight_rec(
@@ -3135,6 +3529,27 @@ class DecodeEngine:
                     self._admission = None
             self._drop_admission(req, exc)
 
+    def _advance_admission_budgeted(self, adm: _Admission) -> None:
+        """One dispatcher pass of admission work under the scheduler's
+        stall-free mixing budget: with ``mix_prefill_tokens`` unset
+        (default) exactly one admission step runs per pass — the
+        historical cadence — else lead prefill chunks keep dispatching
+        until the token budget is spent (splices are pointer swaps and
+        never charge it), so long prompts admit faster while decode
+        chunks still interleave every pass."""
+        budget = self._mix_budget
+        if budget is None:
+            self._advance_admission(adm)
+            return
+        remaining = budget
+        while self._admission is adm:
+            was_splice = adm.next_splice < len(adm.splice_rows)
+            self._advance_admission(adm)
+            if not was_splice:
+                remaining -= adm.chunk
+                if remaining <= 0:
+                    break
+
     def _run(self):
         """Dispatcher: admit queued requests into free slots and keep up
         to ``pipeline_depth`` decode chunks in flight. NEVER blocks on a
@@ -3150,22 +3565,49 @@ class DecodeEngine:
                 progressed = False
                 adm = self._admission
                 if adm is not None:
-                    self._advance_admission(adm)
+                    # stall-free mixing (Sarathi lineage): up to the
+                    # configured prefill token budget of admission
+                    # steps per pass, then a decode chunk — resident
+                    # slots keep streaming under any budget
+                    self._advance_admission_budgeted(adm)
                     progressed = True
                 else:
-                    # a parked admission (pool exhausted at reservation)
-                    # retries FIRST — nothing admits past it, so FIFO
-                    # order survives pool pressure
-                    req = self._parked
-                    if req is not None:
-                        self._parked = None
-                    else:
+                    # a parked admission (pool exhausted at
+                    # reservation) retries FIRST; the waiting room
+                    # only releases strictly-higher-priority requests
+                    # past it, so FIFO-under-pressure survives within
+                    # and below the parked class
+                    req = None
+                    with self._lock:
+                        has_slot = None in self._occupant
+                    if has_slot:
+                        req = self._room.take_parked()
+                    if req is None:
                         req = self._pop_request()
                     if req is not None:
                         self._start_admission(req)
-                        # re-parking is not progress (sleep, retry on
-                        # the next pass once retirements free blocks)
-                        progressed = self._parked is not req
+                        if self._room.is_parked(req):
+                            # pool exhausted: EVICTING a strictly
+                            # lower-priority resident is progress;
+                            # otherwise sleep and retry once
+                            # retirements free blocks
+                            progressed = self._maybe_preempt(req)
+                            # promote: a strictly-higher-priority
+                            # request may admit past the parked head
+                            # (it may itself park — joining the lane —
+                            # and preempt on its own behalf)
+                            breq = self._pop_bypass(req)
+                            if breq is not None:
+                                self._start_admission(breq)
+                                if self._room.is_parked(breq):
+                                    progressed = (
+                                        self._maybe_preempt(breq)
+                                        or progressed
+                                    )
+                                else:
+                                    progressed = True
+                        else:
+                            progressed = True
                 if self._dispatch_chunk():
                     progressed = True
                 if not progressed:

@@ -22,6 +22,7 @@ from unionml_tpu.serving.faults import (
     parse_deadline_header,
 )
 from unionml_tpu.serving.http import ServingApp
+from unionml_tpu.serving.scheduler import priority_scope, validate_priority
 from unionml_tpu.serving.usage import tenant_scope, validate_tenant
 
 
@@ -93,6 +94,12 @@ def serving_app(
         except ValueError as exc:
             raise HTTPException(status_code=422, detail=str(exc))
 
+    def _parse_priority(request) -> str:
+        try:  # the shared validator: same 422 contract as stdlib
+            return validate_priority(request.headers.get("x-priority"))
+        except ValueError as exc:
+            raise HTTPException(status_code=422, detail=str(exc))
+
     def _fault_http(exc: Exception) -> "HTTPException":
         """The faults.http_fault_response contract (429/503 +
         Retry-After, 504) — same mapping the stdlib transport sends."""
@@ -119,12 +126,13 @@ def serving_app(
                 response.headers["traceparent"] = (
                     telemetry.format_traceparent(ctx)
                 )
-                # tenant parsed HERE like the deadline: the scope must
-                # live on the threadpool thread that submits to the
-                # engine/batcher, not the event loop's
+                # tenant/priority parsed HERE like the deadline: the
+                # scopes must live on the threadpool thread that
+                # submits to the engine/batcher, not the event loop's
                 with tenant_scope(_parse_tenant(request)):
-                    with deadline_scope(_parse_deadline(request)):
-                        return core.predict(payload)
+                    with priority_scope(_parse_priority(request)):
+                        with deadline_scope(_parse_deadline(request)):
+                            return core.predict(payload)
         except _FAULTS as exc:
             raise _fault_http(exc)
         except (ValueError, KeyError, TypeError) as exc:
@@ -151,8 +159,9 @@ def serving_app(
         try:
             with telemetry.trace_scope(ctx):
                 with tenant_scope(_parse_tenant(request)):
-                    with deadline_scope(_parse_deadline(request)):
-                        frames = core.predict_stream_events(payload)
+                    with priority_scope(_parse_priority(request)):
+                        with deadline_scope(_parse_deadline(request)):
+                            frames = core.predict_stream_events(payload)
         except _FAULTS as exc:
             finish()
             raise _fault_http(exc)
@@ -260,8 +269,10 @@ def serving_app(
         t0 = time.perf_counter()
         try:
             # same boundary validation as the stdlib transport: a
-            # hostile X-Tenant-ID answers 422 before any route runs
+            # hostile X-Tenant-ID or X-Priority answers 422 before
+            # any route runs
             tenant = validate_tenant(request.headers.get("x-tenant-id"))
+            priority = validate_priority(request.headers.get("x-priority"))
         except ValueError as exc:
             from fastapi.responses import JSONResponse
 
@@ -286,6 +297,7 @@ def serving_app(
             raise
         response.headers["X-Request-ID"] = rid
         response.headers["X-Tenant-ID"] = tenant
+        response.headers["X-Priority"] = priority
         if "traceparent" not in response.headers:
             response.headers["traceparent"] = telemetry.format_traceparent(
                 telemetry.server_trace_context(

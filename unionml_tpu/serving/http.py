@@ -65,6 +65,15 @@ value). The validated tenant is echoed on every response alongside
 :func:`~unionml_tpu.serving.usage.tenant_scope` so engine/batcher
 submissions bill their resource vectors to it.
 
+Scheduling priority (docs/robustness.md "Preemption & fairness"):
+every request may carry an ``X-Priority`` header (``high`` /
+``normal`` / ``low``, default ``normal``; anything else answers
+**422** — the value set is closed). The validated class is echoed on
+every response and predict routes open a
+:func:`~unionml_tpu.serving.scheduler.priority_scope`, so engine
+submissions enter the preemptive scheduler's waiting room under the
+caller's class.
+
 Distributed tracing (docs/observability.md): every request parses an
 inbound W3C ``traceparent`` header (a fresh root is minted when absent
 or malformed — tracing metadata can never 5xx a request) and the
@@ -116,6 +125,11 @@ from unionml_tpu.serving.faults import (
     deadline_scope,
     http_fault_response,
     parse_deadline_header,
+)
+from unionml_tpu.serving.scheduler import (
+    DEFAULT_PRIORITY,
+    priority_scope,
+    validate_priority,
 )
 from unionml_tpu.serving.usage import (
     DEFAULT_TENANT,
@@ -660,6 +674,7 @@ class ServingApp:
             _status = 0
             _trace_ctx: Optional[telemetry.TraceContext] = None
             _tenant = DEFAULT_TENANT
+            _priority = DEFAULT_PRIORITY
 
             def log_message(self, fmt, *args):
                 logger.info(f"http: {fmt % args}")
@@ -675,6 +690,7 @@ class ServingApp:
                 self.send_header("Content-Length", str(len(data)))
                 self.send_header("X-Request-ID", self._rid)
                 self.send_header("X-Tenant-ID", self._tenant)
+                self.send_header("X-Priority", self._priority)
                 if self._trace_ctx is not None:
                     self.send_header(
                         "traceparent",
@@ -705,10 +721,14 @@ class ServingApp:
                 try:
                     try:
                         # validated at the boundary: a hostile tenant
-                        # header answers 422 before any route logic,
-                        # and can never reach a label value
+                        # or priority header answers 422 before any
+                        # route logic, and can never reach a label
+                        # value or the scheduler
                         self._tenant = validate_tenant(
                             self.headers.get("X-Tenant-ID")
+                        )
+                        self._priority = validate_priority(
+                            self.headers.get("X-Priority")
                         )
                     except ValueError as exc:
                         self._trace_ctx = telemetry.server_trace_context(
@@ -724,7 +744,8 @@ class ServingApp:
                             self._trace_ctx = ctx
                             # visible to engine/batcher submissions on
                             # this request thread (deadline-scope-style)
-                            with tenant_scope(self._tenant):
+                            with tenant_scope(self._tenant), \
+                                    priority_scope(self._priority):
                                 handler()
                     else:
                         self._trace_ctx = telemetry.server_trace_context(raw_tp)
@@ -809,6 +830,7 @@ class ServingApp:
                 self.send_header("Connection", "close")
                 self.send_header("X-Request-ID", self._rid)
                 self.send_header("X-Tenant-ID", self._tenant)
+                self.send_header("X-Priority", self._priority)
                 if self._trace_ctx is not None:
                     self.send_header(
                         "traceparent",

@@ -44,7 +44,11 @@ Telemetry (``unionml_kv_pool_*``, per-instance ``pool`` label):
   ``_freed_blocks_total`` — flow counters,
 - ``unionml_kv_pool_alloc_failures_total`` — reservations refused for
   lack of blocks (the pool-full pressure signal the flight recorder
-  pairs with its ``pool_pressure`` events).
+  pairs with its ``pool_pressure`` events),
+- ``unionml_kv_pool_preempted_blocks_total`` — blocks released by
+  scheduler preemption (docs/robustness.md "Preemption & fairness"):
+  a resident's KV evicted to the host prefix-cache store so a
+  higher-priority waiter could admit.
 """
 
 from __future__ import annotations
@@ -177,6 +181,12 @@ class KVBlockPool:
             "Reservations refused because the pool had too few "
             "unreserved free blocks.",
         )
+        self._m_preempted = counter(
+            "unionml_kv_pool_preempted_blocks_total",
+            "Blocks released by scheduler preemption (a resident's KV "
+            "evicted to the host prefix-cache store; the blocks return "
+            "to the free list once the dispatch fence passes).",
+        )
 
     def _sync_gauges(self) -> None:
         cap = self.capacity
@@ -273,6 +283,14 @@ class KVBlockPool:
             raise RuntimeError("kv pool double-free")
         self._sync_gauges()
 
+    def note_preempted(self, n: int) -> None:
+        """Count ``n`` blocks released by a scheduler preemption (the
+        engine calls this at eviction time; the actual free rides the
+        normal deferred-fence :meth:`give` path, so the flow counters
+        stay consistent — this series only attributes the CAUSE)."""
+        if n > 0:
+            self._m_preempted.inc(n)
+
     def note_used_rows(self, rows: int) -> None:
         """Update the fragmentation gauge's numerator: total rows
         actually holding KV across every in-use block (the engine's
@@ -322,11 +340,15 @@ class KVBlockPool:
             "allocated_blocks": int(self._m_allocated.value),
             "freed_blocks": int(self._m_freed.value),
             "alloc_failures": int(self._m_alloc_failures.value),
+            "preempted_blocks": int(self._m_preempted.value),
         }
 
     def reset_stats(self) -> None:
         """Zero the flow counters (benchmarks call this between
         phases); the occupancy gauges re-sync to live contents."""
-        for m in (self._m_allocated, self._m_freed, self._m_alloc_failures):
+        for m in (
+            self._m_allocated, self._m_freed, self._m_alloc_failures,
+            self._m_preempted,
+        ):
             m.reset()
         self._sync_gauges()

@@ -273,6 +273,32 @@ class RadixPrefixCache:
         self._h_lookup.observe((time.perf_counter() - t0) * 1e3)
         return PrefixLease(self, nodes)
 
+    def lease(self, tokens: Sequence[int]) -> PrefixLease:
+        """Pin the longest cached block-prefix of ``tokens`` WITHOUT
+        counting a lookup — eviction-target pinning for the preemptive
+        scheduler (docs/robustness.md "Preemption & fairness"): right
+        after a preempted stream's blocks are inserted, the engine
+        leases the path so LRU pressure cannot reclaim them before the
+        resume admission splices them back (which would silently turn
+        a lossless pointer-swap resume into a recompute). Bumps the
+        LRU clock (the blocks ARE hot) but records no hit/miss — this
+        is bookkeeping, not serving traffic, and it must not distort
+        the hit-rate telemetry the way router :meth:`peek` must not."""
+        tokens = np.ascontiguousarray(tokens, np.int32).ravel()
+        nodes: List[_Node] = []
+        with self._lock:
+            self._clock += 1
+            node = self._root
+            for i in range(len(tokens) // self.block_size):
+                child = node.children.get(self._block_key(tokens, i))
+                if child is None:
+                    break
+                child.refcount += 1
+                child.last_used = self._clock
+                nodes.append(child)
+                node = child
+        return PrefixLease(self, nodes)
+
     def peek(self, tokens: Sequence[int]) -> int:
         """Longest cached block-prefix of ``tokens``, in TOKENS — a
         read-only probe for routing-affinity decisions (the fleet

@@ -72,6 +72,7 @@ from unionml_tpu.serving.faults import (
     current_deadline_ms,
     deadline_scope,
 )
+from unionml_tpu.serving.scheduler import current_priority, priority_scope
 from unionml_tpu.serving.usage import current_tenant, tenant_scope
 
 # the router's request id, exposed to replica dispatches on this thread
@@ -244,6 +245,10 @@ class HttpReplica(ReplicaHandle):
         tenant = current_tenant()
         if tenant:
             headers["X-Tenant-ID"] = tenant
+        # the scheduling class survives the hop: the remote transport
+        # validates + re-opens it, so a routed high-priority request
+        # keeps its preemption rights on the replica's engine
+        headers["X-Priority"] = current_priority()
         ctx = telemetry.current_trace_context()
         if ctx is not None:
             headers["traceparent"] = telemetry.format_traceparent(ctx)
@@ -1163,11 +1168,13 @@ class FleetRouter:
         # the hop onto worker threads
         deadline = current_deadline_ms()
         tenant = current_tenant()
+        priority = current_priority()
         trace_ctx = telemetry.current_trace_context()
 
         def lane(idx: int, exclude: List[str]) -> None:
             try:
                 with deadline_scope(deadline), tenant_scope(tenant), \
+                        priority_scope(priority), \
                         telemetry.trace_scope(trace_ctx), _rid_scope(rid):
                     replica = self._pick(prompt, exclude=exclude)
                     lanes[idx] = replica.name
@@ -1369,12 +1376,14 @@ def make_router_app(router: FleetRouter, *, name: str = "fleet-router",
             # the caller's thread-local scopes, hedge-lane style)
             deadline = current_deadline_ms()
             tenant = current_tenant()
+            priority = current_priority()
             trace_ctx = telemetry.current_trace_context()
             results: List = [None] * len(rows)
 
             def run(i: int) -> None:
                 try:
                     with deadline_scope(deadline), tenant_scope(tenant), \
+                            priority_scope(priority), \
                             telemetry.trace_scope(trace_ctx):
                         results[i] = self.router.generate(rows[i])
                 except BaseException as exc:  # relayed in submit order

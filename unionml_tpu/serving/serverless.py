@@ -41,6 +41,11 @@ from unionml_tpu.serving.faults import (
     parse_deadline_header,
 )
 from unionml_tpu.serving.http import ServingApp
+from unionml_tpu.serving.scheduler import (
+    DEFAULT_PRIORITY,
+    priority_scope,
+    validate_priority,
+)
 from unionml_tpu.serving.usage import (
     DEFAULT_TENANT,
     tenant_scope,
@@ -146,6 +151,7 @@ def gateway_handler(
         # server-span context below so callers stitch the full tree
         trace_ctx = telemetry.server_trace_context(raw_traceparent)
         tenant = DEFAULT_TENANT
+        priority = DEFAULT_PRIORITY
         t0 = time.perf_counter()
 
         def respond(
@@ -162,6 +168,7 @@ def gateway_handler(
                     "Content-Type": content_type,
                     "X-Request-ID": rid,
                     "X-Tenant-ID": tenant,
+                    "X-Priority": priority,
                     "traceparent": telemetry.format_traceparent(trace_ctx),
                     **(extra or {}),
                 },
@@ -172,6 +179,7 @@ def gateway_handler(
             # validated at the boundary (422 via the ValueError arm
             # below), echoed on every response like the HTTP transports
             tenant = validate_tenant(headers.get("x-tenant-id"))
+            priority = validate_priority(headers.get("x-priority"))
             if method == "GET" and path == "/":
                 return respond(200, app.root(), content_type="text/html")
             if method == "GET" and path == "/health":
@@ -206,10 +214,11 @@ def gateway_handler(
                 with app.traced_request("/predict", raw_traceparent) as ctx:
                     trace_ctx = ctx
                     with tenant_scope(tenant):
-                        with deadline_scope(deadline_ms):
-                            return respond(
-                                200, json.dumps(app.predict(payload))
-                            )
+                        with priority_scope(priority):
+                            with deadline_scope(deadline_ms):
+                                return respond(
+                                    200, json.dumps(app.predict(payload))
+                                )
             return respond(
                 404, json.dumps({"error": f"no route {method} {path}"})
             )

@@ -133,10 +133,11 @@ class _TenantUsage:
     __slots__ = (
         "requests", "queue_ms", "prefill_tokens", "cached_tokens",
         "decode_tokens", "device_seconds", "flops", "kv_block_seconds",
-        "rejected", "deadline_shed", "dropped",
+        "rejected", "deadline_shed", "dropped", "by_priority",
     )
 
     def __init__(self):
+        self.by_priority: Dict[str, int] = {}
         self.requests = 0
         self.queue_ms = 0.0
         self.prefill_tokens = 0
@@ -152,6 +153,11 @@ class _TenantUsage:
     def vector(self) -> dict:
         return {
             "requests": self.requests,
+            # priority breakdown of completed requests (closed value
+            # set — scheduler.PRIORITIES — so JSON keys stay bounded;
+            # kept out of the metric surface: the per-tenant label
+            # cardinality budget is spent)
+            "requests_by_priority": dict(self.by_priority),
             "queue_ms": round(self.queue_ms, 3),
             "prefill_tokens": self.prefill_tokens,
             "cached_tokens": self.cached_tokens,
@@ -365,9 +371,11 @@ class UsageLedger:
         queue_ms: float = 0.0,
         prefill_tokens: int = 0,
         cached_tokens: int = 0,
+        priority: Optional[str] = None,
     ) -> None:
         """One request completed and delivered: the per-request scalars
-        (queue wait, prefill split) land here; decode tokens and device
+        (queue wait, prefill split, and the scheduling ``priority``
+        class it ran under) land here; decode tokens and device
         attribution accumulated through :meth:`attribute` as the
         request's chunks harvested."""
         with self._lock:
@@ -377,6 +385,10 @@ class UsageLedger:
             acct.queue_ms += queue_ms
             acct.prefill_tokens += int(prefill_tokens)
             acct.cached_tokens += int(cached_tokens)
+            if priority is not None:
+                acct.by_priority[priority] = (
+                    acct.by_priority.get(priority, 0) + 1
+                )
         lbl = (self.instance, label)
         self._f_requests.labels(*lbl).inc()
         if queue_ms > 0:
@@ -492,6 +504,24 @@ class UsageLedger:
             label = self._label_locked(tenant)
             self._acct_locked(tenant).dropped += 1
         self._f_dropped.labels(self.instance, label, cause).inc()
+
+    def fair_share(self, tenant: str) -> float:
+        """``tenant``'s fraction of ATTRIBUTED device-seconds so far
+        (0.0 when nothing is attributed yet or the tenant is unknown)
+        — the cheap read the preemptive scheduler's deficit queues
+        scale their refill quanta by (a tenant that already consumed
+        most of the device refills slower, so its class's light users
+        catch up). Tenants rolled past ``max_tenants`` share the
+        ``other`` accumulator's vector and therefore its share."""
+        with self._lock:
+            acct = self._tenants.get(tenant)
+            if acct is None and len(self._tenants) >= self.max_tenants:
+                acct = self._other
+            if acct is None or self.total_device_seconds <= 0.0:
+                return 0.0
+            return min(
+                1.0, acct.device_seconds / self.total_device_seconds
+            )
 
     # ------------------------------------------------------------------ #
     # views
