@@ -52,6 +52,16 @@ Usage::
     #   amplification within the fleet retry budget; then a 1-replica
     #   passthrough leg asserting <= 2% p99 overhead vs the direct
     #   engine (docs/robustness.md "Fleet robustness")
+    UNIONML_TPU_BENCH_PRESET=serve_autoscale python benchmarks/serve_latency.py
+    # ^ SLO-driven autoscaling (the self-operating fleet): a
+    #   burn-inducing flood on a 2-replica fleet triggers a scale-out
+    #   within the SLO fast window, warm-joined from a donor's hot
+    #   prefix blocks (>= 1 warm hit on the joiner's first request
+    #   asserted); a mid-run replica kill is reaped and replaced
+    #   automatically; the load drop scales the fleet back to
+    #   baseline — zero caller-visible failures and exact token
+    #   parity vs the solo oracle throughout (docs/robustness.md
+    #   "Autoscaling & self-healing")
 """
 
 from __future__ import annotations
@@ -1930,6 +1940,351 @@ def router_leg() -> None:
         engine.close()
 
 
+def autoscale_leg() -> None:
+    """Self-operating fleet
+    (``UNIONML_TPU_BENCH_PRESET=serve_autoscale``).
+
+    One continuous chaos scenario on a 2-replica baseline fleet with a
+    closed-loop :class:`~unionml_tpu.serving.autoscaler
+    .FleetAutoscaler` (docs/robustness.md "Autoscaling &
+    self-healing"):
+
+    1. **Burn-induced scale-out, fleet-warmed.** A concurrent
+       shared-prefix flood drives the fleet TTFT objective into
+       sustained fast+slow-window burn; the autoscaler provisions a
+       third replica WITHIN the SLO fast window, warm-joined from the
+       warmest donor's hot prefix blocks — the joiner's first
+       shared-prefix request is asserted to HIT (prefill tokens
+       saved > 0 against imported-only content).
+    2. **Mid-run kill, replaced automatically.** A replica takes an
+       OOM-shaped device fault and then reads as a dead process; the
+       router absorbs the in-flight failures (retries), the
+       autoscaler reaps the corpse and provisions its replacement.
+    3. **Load drop, scale-in.** The flood ends, burn clears, and the
+       fleet consolidates back to the 2-replica baseline through the
+       hysteresis band.
+
+    Asserts ZERO caller-visible failures and exact per-request token
+    parity vs the solo oracle across all three phases, and that every
+    scale decision is present in the flight record.
+    """
+    import threading
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu import telemetry
+    from unionml_tpu.models import Llama
+    from unionml_tpu.serving.autoscaler import (
+        AutoscalerPolicy, EngineReplicaProvisioner, FleetAutoscaler,
+    )
+    from unionml_tpu.serving.engine import DecodeEngine
+    from unionml_tpu.serving.faults import (
+        EngineUnavailable, FaultInjector, xla_oom_error,
+    )
+    from unionml_tpu.serving.router import (
+        EngineReplica, FleetRouter, RouterPolicy,
+    )
+    from unionml_tpu.serving.usage import UsageLedger
+    from unionml_tpu.slo import LatencyObjective, SloWatchdog
+
+    backend = jax.default_backend()
+    if backend == "cpu":
+        cfg = serving_config("tiny")
+        module = Llama(cfg)
+        tokens0 = jnp.zeros((1, 8), jnp.int32)
+        params = jax.jit(module.init)(jax.random.PRNGKey(0), tokens0)["params"]
+        n_req, clients, slots = 2400, 8, 2
+        new_tokens, bucket, chunk_steps = 16, 32, 4
+        ttft_threshold_ms = 10.0
+    else:
+        cfg = serving_config("serve_1p5b")
+        module = Llama(cfg)
+        params = random_quantized_params(module)
+        n_req, clients, slots = 384, 32, 4
+        new_tokens, bucket, chunk_steps = 32, 64, 8
+        ttft_threshold_ms = 250.0
+
+    registry = telemetry.MetricsRegistry()
+    flight = telemetry.FlightRecorder()
+    ledger = UsageLedger(registry=registry)
+    fi0 = FaultInjector()
+
+    def make_engine(fi=None):
+        return DecodeEngine(
+            module, slots=slots, max_new_tokens=new_tokens,
+            prompt_buckets=(bucket,), chunk_steps=chunk_steps,
+            prefix_cache=True, usage=ledger, max_queue_depth=128,
+            registry=registry,
+            **({"fault_injector": fi} if fi is not None else {}),
+        )
+
+    class KillableEngineReplica(EngineReplica):
+        """Models a crashed process: the armed fault poisons the
+        in-flight batch (retryable), the kill flag makes every later
+        dispatch/health read unreachable."""
+
+        killed = False
+
+        def kill(self):
+            self.killed = True
+
+        def generate_stream(self, prompt, *, max_new_tokens=None):
+            if self.killed:
+                raise EngineUnavailable(
+                    f"{self.name} process died", reason="unreachable",
+                )
+            return super().generate_stream(
+                prompt, max_new_tokens=max_new_tokens
+            )
+
+        def generate(self, prompt, *, max_new_tokens=None):
+            if self.killed:
+                raise EngineUnavailable(
+                    f"{self.name} process died", reason="unreachable",
+                )
+            return super().generate(prompt, max_new_tokens=max_new_tokens)
+
+        def health(self):
+            if self.killed:
+                raise ConnectionError(f"{self.name} process died")
+            return super().health()
+
+    engines = [make_engine(fi0), make_engine()]
+    replicas = [
+        KillableEngineReplica(engines[i], params, name=f"r{i}")
+        for i in range(2)
+    ]
+    router = FleetRouter(
+        replicas,
+        policy=RouterPolicy(
+            health_ttl_s=0.0, jitter_s=0.0, backoff_base_s=0.001,
+            max_attempts=4, retry_budget_burst=50.0,
+            retry_budget_ratio=1.0, eject_consecutive=1,
+            eject_cooldown_s=1000.0,   # corpses stay ejected; reap ends them
+        ),
+        registry=registry, flight=flight,
+    )
+    # the fleet SLO: TTFT over every engine in the shared registry —
+    # the flood's queueing pushes it over the (bucket-edge) threshold,
+    # the short windows make the burn measurable within the bench
+    fast_window_s, slow_window_s = 5.0, 10.0
+    watchdog = SloWatchdog(
+        [LatencyObjective(
+            "fleet_ttft", "unionml_engine_ttft_ms",
+            threshold_ms=ttft_threshold_ms, target=0.5, min_events=4,
+            fast_burn=1.0, slow_burn=1.0,
+        )],
+        registry=registry,
+        fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+    )
+    aux_engines = []
+
+    def factory():
+        engine = make_engine()
+        engine.warmup(params)   # a joiner must never serve cold compiles
+        aux_engines.append(engine)
+        return engine, params
+
+    auto = FleetAutoscaler(
+        router,
+        EngineReplicaProvisioner(factory),
+        policy=AutoscalerPolicy(
+            min_replicas=2, max_replicas=4,
+            fast_burn_threshold=1.0, slow_burn_threshold=1.0,
+            sustain_evals=2,
+            headroom_out=0.0,          # burn is THE out trigger here
+            headroom_in=0.5,
+            cooldown_out_s=2.0, cooldown_in_s=0.5,
+            warm_blocks=64, reap_unhealthy_evals=2,
+        ),
+        slo=watchdog, usage=ledger,
+        registry=registry, flight=flight,
+    )
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, cfg.vocab_size, 16).tolist()
+    distinct = [
+        shared + rng.integers(1, cfg.vocab_size, 8).tolist()
+        for _ in range(6)
+    ]
+    try:
+        for e in engines:
+            e.warmup(params)
+        solo = {
+            tuple(p): engines[0].generate(params, [p])[0] for p in distinct
+        }
+        # prime the SURVIVOR's cache so the first (repair) join always
+        # has a warm donor — in production the fleet has served for
+        # hours before a scale event; the oracle above only warmed r0
+        engines[1].generate(params, [distinct[0]])
+        for e in engines:
+            e.reset_stats()
+        ledger.reset_stats()
+
+        results, failures, lock = [], [], threading.Lock()
+        started = threading.Event()
+
+        def client(idx):
+            for j in range(n_req // clients):
+                p = distinct[(idx + j) % len(distinct)]
+                if idx == 0 and j == 1:
+                    started.set()
+                try:
+                    out = router.generate(p)
+                    with lock:
+                        results.append((tuple(p), out))
+                except BaseException as exc:   # EVERY failure counts
+                    with lock:
+                        failures.append(f"{type(exc).__name__}: {exc}")
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(clients)
+        ]
+        flood_t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        started.wait(timeout=120)
+
+        scale_out_s = None
+        trigger_s = None
+        warm_hit_tokens = 0
+        killed = False
+        deadline = time.perf_counter() + 600.0
+        while any(t.is_alive() for t in threads):
+            if time.perf_counter() > deadline:
+                raise AssertionError("flood did not complete")
+            decision = auto.evaluate()
+            if trigger_s is None and decision.get("burn_streak", 0) >= 1:
+                # burn DETECTED (the multiwindow trigger is arming) —
+                # the fast-window bar applies here; the action latency
+                # additionally pays the synchronous provision+warmup
+                trigger_s = time.perf_counter() - flood_t0
+            if decision["decision"] == "scale_out" and scale_out_s is None:
+                scale_out_s = time.perf_counter() - flood_t0
+                assert decision["reason"] == "slo_burn", decision
+                assert decision["warmed_blocks"] > 0, (
+                    f"join was not fleet-warmed: {decision}"
+                )
+                # the joiner's FIRST request: a shared-prefix prompt
+                # straight into the fresh engine. Its cache holds ONLY
+                # imported blocks at this instant (its own inserts need
+                # a completed request), so any prefill tokens saved
+                # here are warm-join hits by construction.
+                joiner = aux_engines[-1]
+                saved0 = joiner.prefix_cache.stats()["prefill_tokens_saved"]
+                probe = shared + rng.integers(1, cfg.vocab_size, 8).tolist()
+                probe_out = joiner.generate(params, [probe])[0]
+                warm_hit_tokens = (
+                    joiner.prefix_cache.stats()["prefill_tokens_saved"]
+                    - saved0
+                )
+                assert warm_hit_tokens > 0, (
+                    "joiner's first request missed the warm prefix"
+                )
+                assert probe_out == engines[1].generate(params, [probe])[0]
+                # mid-run KILL: wait for r0 to hold resident streams
+                # (the kill must be caller-visible-but-absorbed, never
+                # a free idle-replica removal), then its in-flight
+                # batch dies OOM-shaped and the replica reads as dead
+                k_deadline = time.perf_counter() + 60.0
+                busy = 0
+                while time.perf_counter() < k_deadline:
+                    with engines[0]._lock:
+                        busy = sum(
+                            r is not None for r in engines[0]._occupant
+                        )
+                    if busy:
+                        break
+                    time.sleep(0.002)
+                assert busy, "victim replica never took residents"
+                fi0.arm("engine.dispatch", exc=xla_oom_error())
+                replicas[0].kill()
+                killed = True
+            time.sleep(0.02)
+        for t in threads:
+            t.join(timeout=120)
+        flood_s = time.perf_counter() - flood_t0
+
+        assert scale_out_s is not None, "the flood never triggered scale-out"
+        assert trigger_s is not None and trigger_s <= fast_window_s, (
+            f"burn detection took {trigger_s}s — outside the "
+            f"{fast_window_s:.0f}s SLO fast window"
+        )
+        # the action = detection + sustain + synchronous provision &
+        # warmup (XLA compiles); generous allowance so CI hosts pass
+        assert scale_out_s <= fast_window_s + 15.0, (
+            f"scale-out took {scale_out_s:.1f}s — detection "
+            f"{trigger_s:.1f}s plus an implausible provision time"
+        )
+        assert killed
+        assert not failures, (
+            f"{len(failures)} caller-visible failures (want 0): "
+            f"{sorted(set(failures))[:3]}"
+        )
+        bad = sum(1 for key, out in results if out != solo[key])
+        assert bad == 0, f"{bad}/{len(results)} responses lost token parity"
+        assert len(results) == n_req
+
+        # the corpse is reaped and replaced; then the idle fleet
+        # consolidates back to baseline through the hysteresis band
+        settle_deadline = time.perf_counter() + 60.0
+        while time.perf_counter() < settle_deadline:
+            auto.evaluate()
+            members = router.health()["replicas"]
+            if "r0" not in members and len(members) <= 2 and all(
+                m["state"] == "live" for m in members.values()
+            ):
+                break
+            time.sleep(0.05)
+        members = router.health()["replicas"]
+        assert "r0" not in members, f"corpse not reaped: {members}"
+        assert len(members) == 2, f"did not scale back in: {members}"
+        assert router.health()["live_replicas"] == 2
+
+        kinds = [e["kind"] for e in flight.dump()]
+        for kind in ("scale_out", "scale_reap", "scale_in", "retry"):
+            assert kind in kinds, f"missing {kind} in flight record"
+        decisions = {
+            values: int(child.value)
+            for values, child in auto._m_decisions.children()
+        }
+        # the burn-driven growth AND the post-kill replacement both
+        # provisioned (the replacement rides whichever trigger is hot:
+        # still-burning SLO, or the below-min repair after the reap)
+        n_scale_outs = sum(
+            v for (d, _r), v in decisions.items() if d == "scale_out"
+        )
+        assert n_scale_outs >= 2, decisions
+        assert decisions.get(("scale_out", "slo_burn"), 0) >= 1, decisions
+        print(json.dumps({
+            "metric": "serve_autoscale",
+            "offered": n_req,
+            "clients": clients,
+            "completed": len(results),
+            "caller_visible_failures": len(failures),
+            "token_parity": "exact",
+            "flood_s": round(flood_s, 2),
+            "burn_detect_latency_s": round(trigger_s, 2),
+            "scale_out_latency_s": round(scale_out_s, 2),
+            "slo_fast_window_s": fast_window_s,
+            "warm_join_hit_tokens": int(warm_hit_tokens),
+            "warmed_blocks_total": int(auto._m_warmed.value),
+            "reaped": int(auto._m_reaped.value),
+            "final_replicas": len(members),
+            "decisions": {"|".join(k): v for k, v in decisions.items()},
+            "unit": "requests",
+        }))
+    finally:
+        auto.close()
+        for e in engines + aux_engines:
+            e.close()
+
+
 if __name__ == "__main__":
     if os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_tracing":
         if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
@@ -1964,6 +2319,17 @@ if __name__ == "__main__":
                 "workload is hardcoded in paged_leg"
             )
         paged_leg()
+    elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_autoscale":
+        if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
+            os.environ.get("UNIONML_TPU_BENCH_PREFIX")
+        ):
+            # hardcoded workload, same rule as the other engine legs
+            raise SystemExit(
+                "UNIONML_TPU_BENCH_PRESET=serve_autoscale takes no CLI "
+                f"flags or KV/PREFIX env legs (got {sys.argv[1:]}); its "
+                "workload is hardcoded in autoscale_leg"
+            )
+        autoscale_leg()
     elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_router":
         if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
             os.environ.get("UNIONML_TPU_BENCH_PREFIX")

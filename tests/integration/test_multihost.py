@@ -14,8 +14,21 @@ fakes anywhere in the leg.
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parents[2]
 sys.path.insert(0, str(REPO / "scripts"))
+
+from unionml_tpu.parallel import cpu_multiprocess_supported  # noqa: E402
+
+# CPU-simulated multi-process runs need a jax build with Gloo CPU
+# collectives (multihost_initialize selects them); a build without the
+# capability must SKIP — a red "environment failure" every run is
+# indistinguishable from a real regression
+pytestmark = pytest.mark.skipif(
+    not cpu_multiprocess_supported(),
+    reason="this jax build has no multi-process CPU collectives (gloo)",
+)
 
 from multihost_smoke import launch_pair, launch_single  # noqa: E402
 

@@ -206,6 +206,17 @@ def shim_world(tmp_path, monkeypatch):
         sys.modules.pop("mh_app", None)
 
 
+def _cpu_multiprocess_supported() -> bool:
+    from unionml_tpu.parallel import cpu_multiprocess_supported
+
+    return cpu_multiprocess_supported()
+
+
+@pytest.mark.skipif(
+    not _cpu_multiprocess_supported(),
+    reason="this jax build has no multi-process CPU collectives (gloo) "
+    "— the two SSH-launched runners form a real jax.distributed world",
+)
 def test_two_private_hosts_real_transport(shim_world):
     from unionml_tpu.remote import TPUVMBackend
 

@@ -497,3 +497,85 @@ def test_engine_prefix_cache_eviction_stress(tiny_llama):
         assert s["entries"] <= 4
     finally:
         engine.close()
+
+
+# --------------------------------------------------------------------- #
+# fleet warming: export_hot / import_blocks
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.quick
+def test_export_hot_selects_hottest_with_ancestor_closure():
+    """MRU paths export first; a budget too small for a deep path's
+    ancestor closure falls back to a shallower hot node instead of
+    shipping an orphaned child."""
+    cache = RadixPrefixCache(block_size=4, registry=telemetry.MetricsRegistry())
+    deep = list(range(100, 112))           # 3 blocks
+    shallow = list(range(200, 204))        # 1 block
+    cache.insert(deep, 0, [_block_tree(fill=float(i)) for i in range(3)])
+    cache.insert(shallow, 0, [_block_tree(fill=9.0)])
+    cache.match(shallow).release()         # shallow is now the hottest
+
+    entries = cache.export_hot(max_blocks=1)
+    assert len(entries) == 1
+    assert entries[0]["tokens"].tolist() == shallow
+    assert entries[0]["first_block"] == 0
+
+    # budget 2: the hottest DEEP node needs 3 blocks (closure) — it is
+    # skipped whole; shallow + the deep path's first block fit
+    cache.match(deep).release()            # deep path hottest again
+    entries = cache.export_hot(max_blocks=2)
+    assert len(entries) == 2
+    exported = sorted(
+        (e["tokens"].tolist()[:4], e["first_block"]) for e in entries
+    )
+    assert (deep[:4], 0) in exported or (shallow, 0) in exported
+    # parent-before-child order within the export
+    firsts = [e["first_block"] for e in entries]
+    assert firsts == sorted(firsts)
+
+
+@pytest.mark.quick
+def test_export_import_roundtrip_warms_peer_and_keeps_counters_clean():
+    """A donor export imported into a cold peer makes the peer's peek
+    warm — and neither side's hit/miss telemetry moves (warming is
+    bookkeeping, not serving traffic)."""
+    donor = RadixPrefixCache(block_size=4, registry=telemetry.MetricsRegistry())
+    joiner = RadixPrefixCache(block_size=4, registry=telemetry.MetricsRegistry())
+    tokens = list(range(1, 13))
+    donor.insert(tokens, 0, [_block_tree(fill=float(i)) for i in range(3)])
+    hits_before = donor.stats()["hits"], donor.stats()["misses"]
+
+    entries = donor.export_hot(max_blocks=8)
+    attached = joiner.import_blocks(entries)
+    assert attached == 3
+    assert joiner.peek(tokens) == 12
+    assert (donor.stats()["hits"], donor.stats()["misses"]) == hits_before
+    assert joiner.stats()["hits"] == 0 and joiner.stats()["misses"] == 0
+    # the imported bytes are the donor's bytes (block store unit shared)
+    assert joiner.bytes == donor.bytes
+    # leases released: every exported node is evictable again
+    donor.clear()      # would deadlock/leak if refcounts were held
+    assert donor.entries == 0
+
+    # empty donors and empty budgets export nothing, import attaches 0
+    assert donor.export_hot() == []
+    assert joiner.export_hot(max_blocks=0) == []
+    assert joiner.import_blocks([]) == 0
+
+
+@pytest.mark.quick
+def test_import_respects_byte_budget_of_importer():
+    """An importer at its byte budget keeps its own LRU discipline:
+    blocks that do not fit are rejected, never force-attached."""
+    donor = RadixPrefixCache(block_size=4, registry=telemetry.MetricsRegistry())
+    tokens = list(range(1, 17))
+    donor.insert(tokens, 0, [_block_tree(fill=float(i)) for i in range(4)])
+    tiny = RadixPrefixCache(
+        block_size=4, max_bytes=2 * _BLOCK_BYTES,
+        registry=telemetry.MetricsRegistry(),
+    )
+    attached = tiny.import_blocks(donor.export_hot(max_blocks=8))
+    assert attached == 2                   # budget, not the export size
+    assert tiny.bytes <= 2 * _BLOCK_BYTES
+    assert tiny.peek(tokens) == 8

@@ -742,3 +742,32 @@ def test_max_restarts_skips_deterministic_failures(fixture_model, monkeypatch):
             wait=True, max_restarts=3,
         )
     assert len(launches) == 1, "deterministic failure was relaunched"
+
+
+def test_pinned_requirements_toml_fallback_parser():
+    """The Python-3.10 textual fallback must survive extras brackets
+    inside specs and comments — a ']' only terminates the array
+    OUTSIDE quotes (silently dropping deps would ship a broken env)."""
+    from unionml_tpu.remote.packaging import _parse_dependencies_toml
+
+    tricky = "\n".join([
+        "[build-system]",
+        'requires = ["setuptools"]',
+        "[project]",
+        'name = "x"',
+        "dependencies = [",
+        '    "jax[tpu]>=0.4.30",  # extras bracket inside the spec',
+        "    'flax>=0.8',",
+        '    "numpy>=1.24",',
+        "]",
+        "[project.optional-dependencies]",
+        'dev = ["pytest"]',
+    ])
+    assert _parse_dependencies_toml(tricky) == [
+        "jax[tpu]>=0.4.30", "flax>=0.8", "numpy>=1.24",
+    ]
+    assert _parse_dependencies_toml(
+        '[project]\ndependencies = ["a[x]>=1", "b>=2"]\n'
+    ) == ["a[x]>=1", "b>=2"]
+    with pytest.raises(KeyError):
+        _parse_dependencies_toml("[project]\nname='x'\n")

@@ -963,3 +963,206 @@ def test_router_app_full_stack(tiny_llama):
         app.shutdown()
         for e in engines:
             e.close()
+
+
+# -------------------------------------- weighted least-request picking
+
+
+def test_latency_weight_sheds_slow_replica_without_ejection():
+    """PR 10's named follow-up: with latency_weight on, a healthy-but-
+    slow replica's rolling dispatch latency pushes its score down, so
+    it sheds share smoothly — no failures, no ejection."""
+    a = FakeReplica("a", tokens=(1, 1), chunk=2, delay_s=0.05)  # slow
+    b = FakeReplica("b", tokens=(1, 1), chunk=2)                # fast
+    router = _router([a, b], latency_weight=50.0)
+    # warmup: with no samples the term is 0 and ties round-robin, so
+    # both replicas take traffic and seed their windows
+    for _ in range(4):
+        router.generate([1, 2, 3])
+    warmup_a = a.dispatches
+    assert warmup_a >= 1, "round-robin warmup must reach the slow replica"
+    # steady state: the slow replica's ~50ms mean costs it 2.5 score
+    # points — it loses every subsequent pick
+    for _ in range(12):
+        router.generate([1, 2, 3])
+    assert a.dispatches == warmup_a, (
+        f"slow replica kept winning picks ({a.dispatches} vs warmup "
+        f"{warmup_a})"
+    )
+    assert b.dispatches == 16 - warmup_a
+    # shed share, NOT ejected: the replica never failed
+    assert router.health()["replicas"]["a"]["state"] == "live"
+    assert int(router._m_ejections.labels("a").value) == 0
+
+
+def test_latency_weight_off_by_default():
+    a = FakeReplica("a", tokens=(1, 1), delay_s=0.03)
+    b = FakeReplica("b", tokens=(1, 1))
+    router = _router([a, b])
+    for _ in range(8):
+        router.generate([1, 2, 3])
+    # pure round-robin ties: the slow replica keeps its half
+    assert a.dispatches == 4 and b.dispatches == 4
+
+
+# ------------------------------------------------- remote cache peek
+
+
+def test_fleet_cached_prefix_len_is_max_over_routable():
+    a = FakeReplica("a", cached=4)
+    b = FakeReplica("b", cached=12)
+    c = FakeReplica("c", cached=99)
+    router = _router([a, b, c])
+    router.drain_replica("c")  # draining replicas don't count
+    assert router.cached_prefix_len([1, 2, 3]) == 12
+
+
+def test_remote_cache_peek_e2e_with_ttl(tmp_path):
+    """Satellite: HttpReplica.cached_prefix_len probes the remote
+    GET /debug/cache/peek (it hardcoded 0 before — cross-host
+    cache-affinity routing was blind) and TTL-caches the probe like
+    health, so it can never become a per-pick round trip."""
+    a = FakeReplica("a", cached=8)
+    router = _router([a])
+    registry = telemetry.MetricsRegistry()
+    app = make_router_app(router, registry=registry)
+    host, port = app.serve(port=0, blocking=False)
+    base = f"http://{host}:{port}"
+    try:
+        remote = HttpReplica(base, name="front", peek_ttl_s=60.0)
+        prompt = list(range(1, 17))
+
+        def peek_requests(expect):
+            # the stdlib handler lands its request series in a finally
+            # AFTER the response flushes — bounded wait, like the
+            # /metrics scrape smoke
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                n = sum(
+                    child.value
+                    for values, child in app._m_http_requests.children()
+                    if values[1] == "/debug/cache/peek"
+                )
+                if n >= expect:
+                    return n
+                time.sleep(0.01)
+            return n
+
+        for _ in range(5):
+            assert remote.cached_prefix_len(prompt) == 8
+        assert peek_requests(1) == 1, (
+            "TTL cache must collapse repeat probes"
+        )
+        # ttl=0 means always-fresh (same contract as health_ttl_s)
+        fresh = HttpReplica(base, name="fresh", peek_ttl_s=0.0)
+        for _ in range(3):
+            assert fresh.cached_prefix_len(prompt) == 8
+        assert peek_requests(4) == 4
+        # a different prompt is a different cache key
+        assert remote.cached_prefix_len([7, 7, 7]) == 8
+        # the probe feeds the real pick: a second-tier router over the
+        # HTTP replica scores cache affinity across the hop
+        assert remote.cached_prefix_len(prompt) > 0
+    finally:
+        app.shutdown()
+
+
+def test_remote_cache_peek_degrades_to_zero():
+    """No endpoint / unreachable host / bad prompt — the probe answers
+    0 and never raises: affinity is an optimization, not a routing
+    prerequisite."""
+    unreachable = HttpReplica("http://example.invalid:1", name="r")
+    assert unreachable.cached_prefix_len([1, 2, 3]) == 0
+
+
+def test_serving_app_cache_peek_route_contract():
+    """ServingApp.debug_cache_peek: 422-shaped errors for a missing
+    peek source or an unparseable prompt; the engine-backed wiring is
+    one kwarg."""
+    from unionml_tpu.serving.http import ServingApp
+
+    class _Model:
+        name = "m"
+        artifact = object()
+
+    app = ServingApp(_Model())
+    with pytest.raises(ValueError, match="no cache peek"):
+        app.debug_cache_peek("1,2,3")
+    peeked = []
+    app2 = ServingApp(
+        _Model(), cache_peek=lambda toks: peeked.append(toks) or 16,
+    )
+    assert app2.debug_cache_peek("1,2,3") == {"cached_prefix_len": 16}
+    assert peeked == [[1, 2, 3]]
+    with pytest.raises(ValueError):
+        app2.debug_cache_peek("")
+    with pytest.raises(ValueError):
+        app2.debug_cache_peek("1,x,3")
+
+
+def test_remote_cache_peek_negative_caches_missing_endpoint():
+    """A remote WITHOUT the peek route (HTTP 404, any transport's 404
+    shape) is negative-cached permanently: one probe, then zero — an
+    old replica must not cost a wasted RTT per novel prompt."""
+    import http.server
+
+    hits = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            hits.append(self.path)
+            body = b'{"detail": "Not Found"}'  # FastAPI's 404 shape
+            self.send_response(404)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):
+            pass
+
+    server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        replica = HttpReplica(
+            f"http://127.0.0.1:{server.server_address[1]}", name="old",
+            peek_ttl_s=0.0,   # always-fresh: only the negative cache saves us
+        )
+        assert replica.cached_prefix_len([1, 2, 3]) == 0
+        assert replica.cached_prefix_len([9, 9, 9]) == 0
+        assert replica.cached_prefix_len([5, 5, 5]) == 0
+        assert len(hits) == 1, f"endpoint probed {len(hits)} times"
+        assert replica._peek_supported is False
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_remote_cache_peek_keys_on_prefix():
+    """The probe cache keys (and queries) only the first
+    peek_prompt_tokens tokens — unique-suffix traffic, the normal LLM
+    workload, still hits the TTL cache."""
+    a = FakeReplica("a", cached=8)
+    router = _router([a])
+    app = make_router_app(router, registry=telemetry.MetricsRegistry())
+    host, port = app.serve(port=0, blocking=False)
+    try:
+        remote = HttpReplica(
+            f"http://{host}:{port}", name="front",
+            peek_ttl_s=60.0, peek_prompt_tokens=4,
+        )
+        prefix = [1, 2, 3, 4]
+        for suffix in ([9], [8, 7], [6, 5, 4]):
+            assert remote.cached_prefix_len(prefix + suffix) == 8
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            n = sum(
+                child.value
+                for values, child in app._m_http_requests.children()
+                if values[1] == "/debug/cache/peek"
+            )
+            if n >= 1:
+                break
+            time.sleep(0.01)
+        assert n == 1, f"prefix-keyed cache missed ({n} probes)"
+    finally:
+        app.shutdown()

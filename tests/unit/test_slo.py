@@ -314,3 +314,29 @@ def test_ttft_breach_degrades_health_and_recovers(tiny_llama):
     finally:
         app.shutdown()
         engine.close()
+
+
+def test_burn_scores_reads_both_windows():
+    """burn_scores() — the autoscaler's sustained-burn signal — reads
+    the fast AND slow windows from the last evaluation; a burst inside
+    the fast window alone must show slow < fast (the multiwindow
+    discipline that keeps a blip from buying hardware)."""
+    reg = MetricsRegistry()
+    h = reg.histogram("unionml_engine_ttft_ms", "ttft", ("engine",))
+    wd = _ttft_watchdog(reg)
+    assert wd.burn_scores() == {"fast": 0.0, "slow": 0.0}
+    # long healthy history fills the slow window
+    for t in range(0, 50, 2):
+        for _ in range(4):
+            h.labels("engine-0").observe(50.0)
+        wd.evaluate(now=float(t))
+    # then a fast-window burst of slow requests
+    for _ in range(8):
+        h.labels("engine-0").observe(500.0)
+    wd.evaluate(now=52.0)
+    scores = wd.burn_scores()
+    assert scores["fast"] > scores["slow"] > 0.0
+    assert scores["fast"] == wd.burn_score("fast") == wd.burn_score()
+    assert scores["slow"] == wd.burn_score("slow")
+    with pytest.raises(ValueError, match="window"):
+        wd.burn_score("medium")

@@ -793,3 +793,25 @@ def test_debug_usage_endpoint_engine_backed(tiny_llama):
     finally:
         app.shutdown()
         engine.close()
+
+
+def test_capacity_totals_cheap_read_matches_report():
+    """capacity_totals() — the autoscaler's windowed-headroom read —
+    returns the raw counters without assembling a report, and
+    differencing consecutive samples isolates recent utilization."""
+    ledger = UsageLedger(registry=telemetry.MetricsRegistry())
+    assert ledger.capacity_totals() == (0.0, 0.0)
+    assert ledger.capacity_headroom() == 1.0  # vacuous: no capacity yet
+    ledger.attribute({"a": 30}, device_s=1.0, slot_steps=100.0)
+    cap, used = ledger.capacity_totals()
+    assert (cap, used) == (100.0, 30.0)
+    assert ledger.capacity_headroom() == pytest.approx(0.7)
+    assert ledger.report()["capacity"]["headroom"] == pytest.approx(0.7)
+    # the delta window: a later busy burst reads busy even after a
+    # long idle cumulative history
+    ledger.attribute({"a": 95}, device_s=1.0, slot_steps=100.0)
+    cap2, used2 = ledger.capacity_totals()
+    d_headroom = 1.0 - (used2 - used) / (cap2 - cap)
+    assert d_headroom == pytest.approx(0.05)
+    ledger.reset_stats()
+    assert ledger.capacity_totals() == (0.0, 0.0)

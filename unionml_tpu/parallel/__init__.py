@@ -16,7 +16,12 @@ first-class replacement: strategies compose as axes of one
 
 from unionml_tpu.parallel.collectives import bucketed_psum
 from unionml_tpu.parallel.compat import shard_map
-from unionml_tpu.parallel.mesh import make_mesh, mesh_devices, multihost_initialize
+from unionml_tpu.parallel.mesh import (
+    cpu_multiprocess_supported,
+    make_mesh,
+    mesh_devices,
+    multihost_initialize,
+)
 from unionml_tpu.parallel.pipeline import (
     pipeline_apply,
     pipeline_spmd,
@@ -33,6 +38,7 @@ from unionml_tpu.parallel.sharding import (
 
 __all__ = [
     "bucketed_psum",
+    "cpu_multiprocess_supported",
     "shard_map",
     "make_mesh",
     "mesh_devices",

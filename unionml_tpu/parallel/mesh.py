@@ -98,6 +98,27 @@ def make_mesh(
     return Mesh(mesh_arr, tuple(axes))
 
 
+def cpu_multiprocess_supported() -> bool:
+    """Can THIS jax build run multi-process collectives on the CPU
+    backend? True when the ``jax_cpu_collectives_implementation`` knob
+    exists and jaxlib ships the Gloo TCP implementation
+    :func:`multihost_initialize` selects. The multihost/tpuvm
+    integration suites ``skipif`` on this, so an environment that
+    genuinely cannot run them reports *skipped*, not a known-red
+    failure set."""
+    import jax
+
+    if "jax_cpu_collectives_implementation" not in getattr(
+        jax.config, "values", {}
+    ):
+        return False
+    try:
+        from jax._src.lib import xla_extension
+    except Exception:
+        return False
+    return hasattr(xla_extension, "make_gloo_tcp_collectives")
+
+
 def multihost_initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -114,6 +135,19 @@ def multihost_initialize(
 
     if os.environ.get("JAX_PLATFORMS", "") == "cpu" and coordinator_address is None:
         return False  # single-process CPU simulation
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # a multi-process CPU run (the TPU-pod control plane minus the
+        # hardware) needs an explicit cross-process collectives
+        # implementation BEFORE the backend initializes — without it
+        # every cross-process psum/allgather dies with "Multiprocess
+        # computations aren't implemented on the CPU backend". Gloo is
+        # the TCP implementation jaxlib ships; builds without the knob
+        # (or without gloo) fall through and the caller's capability
+        # probe (cpu_multiprocess_supported) should have skipped.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):
+            pass
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -71,6 +71,58 @@ def framework_root() -> Path:
     return Path(__file__).resolve().parents[2]
 
 
+def _parse_dependencies_toml(text: str) -> list:
+    """The ``[project] dependencies = [...]`` array as a list of spec
+    strings, parsed textually for hosts without :mod:`tomllib`
+    (Python < 3.11). Handles the shape this repo's pyproject.toml
+    uses — one bracketed array of quoted strings with optional ``#``
+    comments — including specs that themselves contain brackets
+    (``"jax[tpu]>=0.4"``): the closing ``]`` only terminates the array
+    when scanned OUTSIDE a quoted string."""
+    import re
+
+    in_project = False
+    buf = None
+    done = False
+    for line in text.splitlines():
+        stripped = line.strip()
+        if buf is None:
+            if stripped.startswith("["):
+                in_project = stripped == "[project]"
+                continue
+            match = (
+                re.match(r"dependencies\s*=\s*\[", stripped)
+                if in_project else None
+            )
+            if match is None:
+                continue
+            buf = ""
+            stripped = stripped[match.end():]
+        # append up to the first closing bracket outside quotes
+        quote = None
+        for i, ch in enumerate(stripped):
+            if quote is not None:
+                if ch == quote:
+                    quote = None
+            elif ch in "\"'":
+                quote = ch
+            elif ch == "#":        # comment: rest of line ignored
+                stripped = stripped[:i]
+                break
+            elif ch == "]":
+                stripped = stripped[:i]
+                done = True
+                break
+        buf += stripped
+        if done:
+            break
+    if buf is None:
+        raise KeyError("dependencies")
+    return [
+        a or b for a, b in re.findall(r'"([^"]+)"|\'([^\']+)\'', buf)
+    ]
+
+
 def pinned_requirements() -> str:
     """``name==version`` lines for the framework's runtime dependencies.
 
@@ -80,12 +132,23 @@ def pinned_requirements() -> str:
     that aren't installed locally fall back to the unpinned spec.
     """
     import re
-    import tomllib
     from importlib import metadata
 
     try:
-        with open(framework_root() / "pyproject.toml", "rb") as f:
-            specs = tomllib.load(f)["project"]["dependencies"]
+        import tomllib  # Python >= 3.11
+    except ModuleNotFoundError:
+        tomllib = None
+    try:
+        pyproject = framework_root() / "pyproject.toml"
+        if tomllib is not None:
+            with open(pyproject, "rb") as f:
+                specs = tomllib.load(f)["project"]["dependencies"]
+        else:
+            # Python 3.10 hosts (TPU VM images still ship it): extract
+            # the [project] dependencies array textually — the narrow
+            # subset of TOML this file actually uses — instead of
+            # shipping an unpinned environment
+            specs = _parse_dependencies_toml(pyproject.read_text())
     except (FileNotFoundError, KeyError):
         specs = []
     lines = []

@@ -83,6 +83,13 @@ invisible to callers. :func:`~unionml_tpu.serving.router
 .make_router_app` mounts it on either transport.
 """
 
+from unionml_tpu.serving.autoscaler import (
+    AutoscalerPolicy,
+    EngineReplicaProvisioner,
+    FleetAutoscaler,
+    HttpReplicaProvisioner,
+    ReplicaProvisioner,
+)
 from unionml_tpu.serving.batcher import MicroBatcher
 from unionml_tpu.serving.engine import DecodeEngine
 from unionml_tpu.serving.faults import (
@@ -120,13 +127,14 @@ from unionml_tpu.serving.usage import (
 )
 
 __all__ = [
-    "DeadlineExceeded", "DecodeEngine", "EngineReplica",
-    "EngineUnavailable", "FaultInjector", "FleetRouter", "HttpReplica",
-    "KVBlockPool", "MicroBatcher", "Overloaded", "PRIORITIES",
-    "PoolExhausted", "PreemptiveScheduler", "RadixPrefixCache",
-    "ReplicaHandle", "RouterPolicy", "SchedulerConfig", "ServingApp",
-    "UsageLedger", "WaitingRoom", "create_app", "current_priority",
-    "current_tenant", "deadline_scope", "make_router_app",
-    "priority_scope", "tenant_scope", "validate_priority",
-    "validate_tenant",
+    "AutoscalerPolicy", "DeadlineExceeded", "DecodeEngine",
+    "EngineReplica", "EngineReplicaProvisioner", "EngineUnavailable",
+    "FaultInjector", "FleetAutoscaler", "FleetRouter", "HttpReplica",
+    "HttpReplicaProvisioner", "KVBlockPool", "MicroBatcher",
+    "Overloaded", "PRIORITIES", "PoolExhausted", "PreemptiveScheduler",
+    "RadixPrefixCache", "ReplicaHandle", "ReplicaProvisioner",
+    "RouterPolicy", "SchedulerConfig", "ServingApp", "UsageLedger",
+    "WaitingRoom", "create_app", "current_priority", "current_tenant",
+    "deadline_scope", "make_router_app", "priority_scope",
+    "tenant_scope", "validate_priority", "validate_tenant",
 ]

@@ -239,6 +239,13 @@ def serving_app(
         except ValueError as exc:
             raise HTTPException(status_code=422, detail=str(exc))
 
+    @app.get("/debug/cache/peek")
+    async def debug_cache_peek(prompt: str = ""):
+        try:
+            return core.debug_cache_peek(prompt)
+        except (ValueError, TypeError) as exc:
+            raise HTTPException(status_code=422, detail=str(exc))
+
     @app.get("/debug/trace")
     async def debug_trace(format: str = "chrome"):
         from fastapi.responses import Response as RawResponse

@@ -46,6 +46,13 @@ Same endpoint surface as the reference's FastAPI app
   attributed device-seconds and FLOPs, prefix-cache savings, and the
   decode capacity-headroom estimate (docs/observability.md "Usage
   metering & cost attribution"),
+- ``GET /debug/cache/peek?prompt=1,2,3`` — the prefix cache's
+  read-only peek over HTTP (``ServingApp(cache_peek=...)``): how many
+  leading tokens of the comma-separated prompt this process holds
+  cached KV for. The fleet router's ``HttpReplica`` probes it
+  (TTL-cached) for cache-affinity routing ACROSS hosts — the remote
+  twin of the in-process ``RadixPrefixCache.peek``, and like it the
+  probe takes no lease, bumps no LRU, and moves no hit/miss counters,
 - ``GET /debug/trace?format=chrome|jsonl`` — the trace recorder's
   Chrome-trace / JSON-lines export over HTTP (no shelling into the
   process to pull a trace),
@@ -142,7 +149,7 @@ from unionml_tpu.serving.usage import (
 KNOWN_ROUTES = (
     "/", "/predict", "/predict/stream", "/health", "/stats", "/metrics",
     "/debug/profile", "/debug/memory", "/debug/flight", "/debug/trace",
-    "/debug/slo", "/debug/usage",
+    "/debug/slo", "/debug/usage", "/debug/cache/peek",
 )
 
 # the routes that open a RECORDED trace timeline (a server span the
@@ -198,6 +205,7 @@ class ServingApp:
         otlp_endpoint: Optional[str] = None,
         slo: Optional[Any] = None,
         usage: Optional[Any] = None,
+        cache_peek: Optional[Any] = None,
         **batcher_kwargs,
     ):
         """``warmup``: optional callable invoked with the loaded model
@@ -267,7 +275,14 @@ class ServingApp:
         ``engine.usage``) — served at ``GET /debug/usage``: per-tenant
         resource vectors, cache savings, and the capacity-headroom
         estimate (docs/observability.md "Usage metering & cost
-        attribution")."""
+        attribution").
+
+        ``cache_peek``: a ``(prompt token ids) -> int`` read-only
+        probe — wire the engine's ``prefix_cache.peek`` (or a
+        router's fleet-wide ``cached_prefix_len``) — served at
+        ``GET /debug/cache/peek?prompt=...`` so the fleet router's
+        :class:`~unionml_tpu.serving.router.HttpReplica` can make
+        cache-affinity routing decisions across hosts."""
         self.model = model
         self.remote = remote
         self.app_version = app_version
@@ -291,6 +306,7 @@ class ServingApp:
         self._tracer = tracer if tracer is not None else telemetry.get_tracer()
         self._slo = slo
         self._usage = usage
+        self._cache_peek = cache_peek
         self._otlp = None
         endpoint = otlp_endpoint or os.getenv("UNIONML_TPU_OTLP_ENDPOINT")
         if endpoint:
@@ -494,6 +510,31 @@ class ServingApp:
                 "ServingApp(usage=engine.usage) with a metering engine"
             )
         return self._usage.report()
+
+    def debug_cache_peek(self, prompt: Any) -> dict:
+        """``GET /debug/cache/peek?prompt=1,2,3``: how many leading
+        tokens of ``prompt`` (comma-separated ids, or a list) this
+        process holds cached KV for — the remote half of cache-affinity
+        routing. Raises ``ValueError`` (→ 422) when the app has no
+        peek source or the prompt doesn't parse."""
+        if self._cache_peek is None:
+            raise ValueError(
+                "no cache peek on this app — construct "
+                "ServingApp(cache_peek=engine.prefix_cache.peek) with a "
+                "prefix-cached engine"
+            )
+        if isinstance(prompt, str):
+            parts = [p for p in prompt.split(",") if p.strip() != ""]
+            if not parts:
+                raise ValueError(
+                    "prompt must be non-empty comma-separated token ids"
+                )
+            tokens = [int(p) for p in parts]
+        else:
+            tokens = [int(t) for t in prompt]
+            if not tokens:
+                raise ValueError("prompt must be non-empty")
+        return {"cached_prefix_len": int(self._cache_peek(tokens))}
 
     def debug_trace(self, format: str = "chrome"):
         """``GET /debug/trace?format=chrome|jsonl``: the trace
@@ -799,6 +840,13 @@ class ServingApp:
                     try:
                         self._send(200, app.debug_usage())
                     except ValueError as exc:
+                        self._send(422, {"error": str(exc)})
+                elif path == "/debug/cache/peek":
+                    try:
+                        self._send(200, app.debug_cache_peek(
+                            query.get("prompt", [""])[0]
+                        ))
+                    except (ValueError, TypeError) as exc:
                         self._send(422, {"error": str(exc)})
                 elif path == "/debug/trace":
                     fmt = query.get("format", ["chrome"])[0]

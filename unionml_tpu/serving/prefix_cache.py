@@ -415,6 +415,102 @@ class RadixPrefixCache:
             self._m_saved.inc(n)
 
     # ------------------------------------------------------------------ #
+    # fleet warming (export / import)
+    # ------------------------------------------------------------------ #
+
+    def export_hot(self, *, max_blocks: int = 64) -> List[dict]:
+        """The fleet's warm-join donor path (Mooncake/SGLang cache-aware
+        lineage, docs/robustness.md "Autoscaling & self-healing"): the
+        hottest ``<= max_blocks`` cached blocks as host-RAM entries a
+        peer cache can :meth:`import_blocks`, so a freshly provisioned
+        replica's first requests hit warm prefixes instead of
+        recomputing them.
+
+        Selection is most-recently-used first with **ancestor closure**
+        (a child's rows are meaningless without the prefix path above
+        it, so a hot deep node pulls its whole path in). Each entry is
+        ``{"tokens", "first_block", "rows"}`` — exactly one
+        :meth:`insert` call — emitted parent-before-child so the
+        importer attaches ancestors first. The selected path is held
+        under a :class:`PrefixLease` (refcount pinning) while entries
+        are built, so a concurrent insert's eviction pass can never
+        reclaim a block out from under the export; ``rows`` reference
+        the store's own arrays (host KV rows are write-once — both
+        caches splice from them read-only), so exporting costs
+        pointers, not a copy of the bytes. Like :meth:`peek`, no
+        hit/miss counters move: warming is bookkeeping, not serving
+        traffic."""
+        if max_blocks < 1:
+            return []
+        with self._lock:
+            nodes: List[_Node] = []
+            stack = list(self._root.children.values())
+            while stack:
+                node = stack.pop()
+                stack.extend(node.children.values())
+                nodes.append(node)
+            nodes.sort(key=lambda n: n.last_used, reverse=True)
+            selected: List[_Node] = []
+            chosen = set()
+            for node in nodes:
+                if id(node) in chosen:
+                    continue
+                # ancestor closure: walk up to the first already-chosen
+                # (or root) ancestor; the whole chain ships or none
+                chain: List[_Node] = []
+                cur = node
+                while cur is not None and cur.parent is not None and (
+                    id(cur) not in chosen
+                ):
+                    chain.append(cur)
+                    cur = cur.parent
+                if len(selected) + len(chain) > max_blocks:
+                    continue  # try a shallower hot node
+                for n in chain:
+                    chosen.add(id(n))
+                    selected.append(n)
+                if len(selected) >= max_blocks:
+                    break
+            # parent-before-child order == ascending depth
+            selected.sort(key=lambda n: n.depth)
+            for n in selected:
+                n.refcount += 1
+            lease = PrefixLease(self, selected)
+        try:
+            entries: List[dict] = []
+            for node in selected:
+                path_keys: List[bytes] = []
+                cur: Optional[_Node] = node
+                while cur is not None and cur.parent is not None:
+                    path_keys.append(cur.key)
+                    cur = cur.parent
+                tokens = np.concatenate([
+                    np.frombuffer(k, np.int32) for k in reversed(path_keys)
+                ]) if path_keys else np.zeros((0,), np.int32)
+                entries.append({
+                    "tokens": tokens,
+                    "first_block": node.depth,
+                    "rows": node.rows,
+                })
+            return entries
+        finally:
+            lease.release()
+
+    def import_blocks(self, entries: Sequence[dict]) -> int:
+        """Attach :meth:`export_hot` entries from a donor cache (the
+        warm-join import path); returns how many blocks were newly
+        attached. Each entry rides the normal :meth:`insert` budget/
+        eviction machinery — an importer at its byte budget keeps its
+        own LRU discipline, and entries whose ancestors were rejected
+        drop harmlessly."""
+        attached = 0
+        for entry in entries:
+            attached += self.insert(
+                entry["tokens"], int(entry["first_block"]), [entry["rows"]],
+            )
+        return attached
+
+    # ------------------------------------------------------------------ #
     # pinning / eviction
     # ------------------------------------------------------------------ #
 

@@ -152,6 +152,25 @@ class ReplicaHandle:
         for (0 when unknown — remote replicas without a peek API)."""
         return 0
 
+    def cache_blocks(self) -> int:
+        """Resident prefix-cache blocks (0 when unknown) — the
+        autoscaler's warm-donor/cold-victim ranking signal."""
+        return 0
+
+    def export_hot_blocks(self, max_blocks: int = 64) -> List[dict]:
+        """The warm-join donor hook: this replica's hottest cached
+        prefix blocks as :meth:`~unionml_tpu.serving.prefix_cache
+        .RadixPrefixCache.export_hot` entries (empty when the replica
+        has no exportable cache — remote replicas don't ship KV bytes
+        over this API yet)."""
+        return []
+
+    def import_cache_blocks(self, entries: Sequence[dict]) -> int:
+        """The warm-join import hook: attach a donor's exported blocks
+        before this replica takes traffic; returns blocks attached (0
+        when unsupported)."""
+        return 0
+
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Finish in-flight work; stop admitting. True when drained."""
         return True
@@ -207,6 +226,22 @@ class EngineReplica(ReplicaHandle):
             return 0
         return int(cache.peek(prompt))
 
+    def cache_blocks(self) -> int:
+        cache = getattr(self.engine, "prefix_cache", None)
+        return 0 if cache is None else int(cache.entries)
+
+    def export_hot_blocks(self, max_blocks: int = 64) -> List[dict]:
+        cache = getattr(self.engine, "prefix_cache", None)
+        if cache is None:
+            return []
+        return cache.export_hot(max_blocks=max_blocks)
+
+    def import_cache_blocks(self, entries: Sequence[dict]) -> int:
+        cache = getattr(self.engine, "prefix_cache", None)
+        if cache is None:
+            return 0
+        return int(cache.import_blocks(entries))
+
     def drain(self, timeout: Optional[float] = None) -> bool:
         return self.engine.drain(timeout)
 
@@ -231,11 +266,32 @@ class HttpReplica(ReplicaHandle):
 
     def __init__(
         self, base_url: str, *, name: Optional[str] = None,
-        timeout_s: float = 60.0,
+        timeout_s: float = 60.0, peek_ttl_s: float = 1.0,
+        peek_cache_size: int = 256, peek_timeout_s: float = 2.0,
+        peek_prompt_tokens: int = 128,
     ):
         self.base_url = base_url.rstrip("/")
         self.name = name if name is not None else self.base_url
         self.timeout_s = timeout_s
+        # remote cache-peek probe cache (health-TTL-style): the router
+        # peeks per pick, and a per-pick HTTP round trip would make
+        # every dispatch pay a network RTT per replica. Strict `<` so
+        # peek_ttl_s=0 means always-fresh; bounded so a high-entropy
+        # prompt stream can't grow host memory. The probe gets its OWN
+        # short timeout (a peek must never stall a pick the way the
+        # 60 s dispatch timeout would on a wedged-but-accepting host)
+        # and keys/queries on only the first `peek_prompt_tokens`
+        # tokens — affinity is a property of the PREFIX, so
+        # unique-suffix traffic (the normal LLM workload) still hits
+        # the cache, and probe URLs stay bounded for 100k-token
+        # prompts.
+        self.peek_ttl_s = float(peek_ttl_s)
+        self.peek_timeout_s = float(peek_timeout_s)
+        self.peek_prompt_tokens = int(peek_prompt_tokens)
+        self._peek_cache_size = int(peek_cache_size)
+        self._peek_cache: Dict[bytes, tuple] = {}
+        self._peek_lock = threading.Lock()
+        self._peek_supported = True  # flips off on a 404 (older remote)
 
     def _headers(self) -> dict:
         headers = {"Content-Type": "application/json"}
@@ -391,6 +447,62 @@ class HttpReplica(ReplicaHandle):
     def health(self) -> dict:
         return self._get_json("/health")
 
+    def cached_prefix_len(self, prompt) -> int:
+        """Cache-affinity across hosts: probe the remote transport's
+        ``GET /debug/cache/peek`` (the read-only peek the in-process
+        path uses directly) with a TTL cache so the probe can never
+        become a per-pick round trip, its own short ``peek_timeout_s``
+        so it can never stall one either, and only the first
+        ``peek_prompt_tokens`` tokens as the key AND the query (the
+        affinity signal lives in the prefix — unique-suffix traffic
+        still hits the cache). Any failure — unreachable host, a
+        remote without the endpoint (HTTP 404, negative-cached
+        permanently), no cache wired (422) — degrades to 0: affinity
+        is an optimization, never a routing prerequisite."""
+        if not self._peek_supported:
+            return 0
+        head = [int(t) for t in prompt[:self.peek_prompt_tokens]]
+        key = b"".join(
+            t.to_bytes(4, "little", signed=True) for t in head
+        )
+        now = time.monotonic()
+        with self._peek_lock:
+            hit = self._peek_cache.get(key)
+            if hit is not None and now - hit[1] < self.peek_ttl_s:
+                return hit[0]
+        cached = 0
+        url = (
+            f"{self.base_url}/debug/cache/peek?prompt="
+            + ",".join(str(t) for t in head)
+        )
+        try:
+            with urllib.request.urlopen(
+                urllib.request.Request(url), timeout=self.peek_timeout_s,
+            ) as resp:
+                body = json.loads(resp.read().decode())
+            cached = int(body.get("cached_prefix_len", 0))
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                # the route itself is absent (any transport's 404
+                # shape) — an older remote: stop asking forever
+                self._peek_supported = False
+                return 0
+            cached = 0  # 422 (no cache wired) and other statuses
+        except BaseException:
+            cached = 0  # probe failures must never fail (or slow) a pick
+        with self._peek_lock:
+            if len(self._peek_cache) >= self._peek_cache_size:
+                # bounded: drop the stalest ~half instead of growing
+                cutoff = sorted(
+                    at for _, at in self._peek_cache.values()
+                )[len(self._peek_cache) // 2]
+                self._peek_cache = {
+                    k: v for k, v in self._peek_cache.items()
+                    if v[1] > cutoff
+                }
+            self._peek_cache[key] = (cached, now)
+        return cached
+
     def drain(self, timeout: Optional[float] = None) -> bool:
         # remote drain is an operator action on the remote process;
         # the router-side contract is just "stop routing here"
@@ -430,6 +542,17 @@ class RouterPolicy:
     ``min_live``: below this many live replicas the router's own
     ``health()`` degrades — a thin fleet should shed at the balancer
     above, not blackhole at the router.
+
+    Weighted least-request (``latency_weight``, default 0 = off): the
+    router keeps a per-replica sliding window (``latency_window``
+    samples, :class:`~unionml_tpu.telemetry.SlidingSamples`) of
+    successful dispatch latencies and subtracts ``latency_weight *
+    rolling_mean_seconds`` from the pick score — so a slow replica
+    (overloaded host, thermal throttle, noisy neighbor) sheds share
+    smoothly *without* waiting for failures to eject it. The weight is
+    score-points per second: at the default queue_weight=2, a replica
+    running 500 ms slower on average loses as much score as one extra
+    queued request per ``latency_weight``.
     """
 
     def __init__(
@@ -452,9 +575,15 @@ class RouterPolicy:
         cache_weight: float = 1.0,
         queue_weight: float = 2.0,
         burn_weight: float = 4.0,
+        latency_weight: float = 0.0,
+        latency_window: int = 128,
         health_ttl_s: float = 0.25,
         seed: int = 0,
     ):
+        if latency_weight < 0.0:
+            raise ValueError(
+                f"latency_weight must be >= 0, got {latency_weight}"
+            )
         if max_attempts < 1:
             raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
         if not 0.0 <= retry_budget_ratio <= 1.0:
@@ -487,6 +616,8 @@ class RouterPolicy:
         self.cache_weight = cache_weight
         self.queue_weight = queue_weight
         self.burn_weight = burn_weight
+        self.latency_weight = latency_weight
+        self.latency_window = latency_window
         self.health_ttl_s = health_ttl_s
         self.seed = seed
 
@@ -568,6 +699,9 @@ class FleetRouter:
         self._rng = random.Random(self.policy.seed)
         self._budget_tokens = self.policy.retry_budget_burst
         self._latency = telemetry.SlidingSamples(maxlen=512)
+        # per-replica dispatch-latency windows (the weighted
+        # least-request term; populated lazily on first success)
+        self._replica_latency: Dict[str, telemetry.SlidingSamples] = {}
         self._registry = (
             registry if registry is not None else telemetry.get_registry()
         )
@@ -632,6 +766,16 @@ class FleetRouter:
 
     # -- membership / choreography ----------------------------------------
 
+    def replica_handle(self, name: str) -> ReplicaHandle:
+        """The handle registered under ``name`` (KeyError when absent)
+        — the autoscaler uses this to reach a warm-join donor's
+        export hook without holding router internals."""
+        with self._lock:
+            state = self._replicas.get(name)
+            if state is None:
+                raise KeyError(f"unknown replica {name!r}")
+            return state.handle
+
     def add_replica(self, handle: ReplicaHandle) -> None:
         """Join a new replica into the live set (scale-out, or a
         rebuilt process re-registering)."""
@@ -648,6 +792,7 @@ class FleetRouter:
         drained = self.drain_replica(name, timeout=drain_timeout)
         with self._lock:
             self._replicas.pop(name, None)
+            self._replica_latency.pop(name, None)
         self._flight.record("leave", replica=name, drained=drained)
         return drained
 
@@ -662,7 +807,13 @@ class FleetRouter:
                 raise KeyError(f"unknown replica {name!r}")
             state.state = _DRAINING
         self._flight.record("drain", replica=name)
-        return bool(state.handle.drain(timeout))
+        try:
+            return bool(state.handle.drain(timeout))
+        except BaseException as exc:
+            # a dead replica's drain dying with its process must not
+            # wedge choreography (the autoscaler reaps through here)
+            logger.info(f"router: drain of {name} failed ({exc!r})")
+            return False
 
     def rejoin_replica(self, name: str) -> None:
         """Resume a drained replica and route to it again (the join
@@ -764,6 +915,63 @@ class FleetRouter:
                 "latency_samples": len(self._latency),
             },
         }
+
+    def replica_signals(self) -> Dict[str, dict]:
+        """Per-replica router lifecycle state + the replica's OWN
+        health (through the TTL cache, so polling this costs what a
+        pick costs) + resident cache-block count — the autoscaler's
+        one-stop signal read: queue depths, breaker states, burn
+        scores, and cache warmth in one pass, without reaching into
+        router internals."""
+        now = self._clock()
+        with self._lock:
+            states = list(self._replicas.values())
+        out: Dict[str, dict] = {}
+        for state in states:
+            health = self._health_of(state, now)
+            try:
+                blocks = int(state.handle.cache_blocks())
+            except BaseException:
+                blocks = 0
+            out[state.handle.name] = {
+                "state": state.state,
+                "health": dict(health),
+                "cache_blocks": blocks,
+                "consecutive_failures": state.consecutive_failures,
+            }
+        return out
+
+    def cached_prefix_len(self, prompt: Sequence[int]) -> int:
+        """Fleet-wide longest cached prefix: the max over routable
+        replicas' peeks. This is the router app's
+        ``GET /debug/cache/peek`` source, so a router can front
+        another router (or a balancer can probe a whole fleet) with
+        cache affinity intact."""
+        with self._lock:
+            states = [
+                s for s in self._replicas.values()
+                if s.state in (_LIVE, _HALF_OPEN)
+            ]
+        best = 0
+        for state in states:
+            try:
+                best = max(best, int(state.handle.cached_prefix_len(prompt)))
+            except BaseException:
+                continue  # a peek failure must never fail the probe
+        return best
+
+    def _note_latency(self, name: str, seconds: float) -> None:
+        """One successful dispatch's wall time: feeds the fleet-wide
+        hedge-delay window AND the replica's least-request window."""
+        self._latency.add(seconds)
+        with self._lock:
+            samples = self._replica_latency.get(name)
+            if samples is None:
+                samples = telemetry.SlidingSamples(
+                    maxlen=self.policy.latency_window
+                )
+                self._replica_latency[name] = samples
+        samples.add(seconds)
 
     # -- retry budget ------------------------------------------------------
 
@@ -956,6 +1164,12 @@ class FleetRouter:
                     - self.policy.queue_weight * float(h.get("queue_depth", 0))
                     - self.policy.burn_weight * float(h.get("burn", 0.0))
                 )
+                if self.policy.latency_weight > 0.0:
+                    # weighted least-request: a replica's rolling mean
+                    # dispatch latency (seconds) sheds its share
+                    samples = self._replica_latency.get(state.handle.name)
+                    if samples is not None and len(samples):
+                        score -= self.policy.latency_weight * samples.mean()
                 if h.get("breaker_open"):
                     score -= 100.0
                 if h.get("status") == "degraded":
@@ -1076,7 +1290,7 @@ class FleetRouter:
                     skip = 0
                     emitted += len(out)
                     yield out
-                self._latency.add(time.perf_counter() - t0)
+                self._note_latency(name, time.perf_counter() - t0)
                 self._record_success(name)
                 self._m_routed.labels(name, "ok").inc()
                 return
@@ -1199,7 +1413,7 @@ class FleetRouter:
                         if lost:
                             return  # lost: stop consuming (abandon)
                         out.extend(chunk)
-                    self._latency.add(time.perf_counter() - t0)
+                    self._note_latency(replica.name, time.perf_counter() - t0)
                     self._record_success(replica.name)
                     results[idx] = out
             except BaseException as exc:  # noqa: BLE001 — relayed below
@@ -1356,6 +1570,9 @@ def make_router_app(router: FleetRouter, *, name: str = "fleet-router",
             kw.setdefault("stats", router.stats)
             kw.setdefault("health", router.health)
             kw.setdefault("drain", router.drain)
+            # the fleet-wide peek: a router app answers /debug/cache/
+            # peek with the max over its replicas, so routers compose
+            kw.setdefault("cache_peek", router.cached_prefix_len)
             super().__init__(_RouterModel(name), **kw)
             self.router = router
 

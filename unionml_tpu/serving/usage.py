@@ -523,6 +523,31 @@ class UsageLedger:
                 1.0, acct.device_seconds / self.total_device_seconds
             )
 
+    def capacity_totals(self) -> "tuple[float, float]":
+        """``(capacity_slot_steps, used_slot_steps)`` — the raw decode
+        capacity counters, cumulative since the last reset. A cheap
+        read (one lock, no gauge refresh, no report assembly) for
+        pollers that difference consecutive samples into a *windowed*
+        utilization — the autoscaler's headroom signal works on deltas
+        between evaluations, so an idle morning never dilutes an
+        overloaded afternoon (docs/robustness.md "Autoscaling &
+        self-healing")."""
+        with self._lock:
+            return (
+                self._capacity_slot_steps,
+                sum(self._used_slot_steps.values()),
+            )
+
+    def capacity_headroom(self) -> float:
+        """``1 - used/capacity`` over everything since the last reset
+        (1.0 with no capacity dispatched) — the cumulative convenience
+        read; pollers that need recency should difference
+        :meth:`capacity_totals` instead."""
+        cap, used = self.capacity_totals()
+        if cap <= 0.0:
+            return 1.0
+        return max(0.0, 1.0 - used / cap)
+
     # ------------------------------------------------------------------ #
     # views
     # ------------------------------------------------------------------ #

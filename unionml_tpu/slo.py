@@ -551,24 +551,39 @@ class SloWatchdog:
         ``"ok"`` — the contribution ``ServingApp.health`` merges."""
         return "degraded" if self.breached() else "ok"
 
-    def burn_score(self) -> float:
-        """Max fast-window burn rate across objectives as of the LAST
-        evaluation (0.0 before any) — a scalar load-shifting signal:
-        the fleet router deprioritizes replicas whose objectives are
-        burning budget even before they formally breach, so traffic
-        shifts ahead of the page, not after it. No sampling happens
-        here; the health-probe cadence (which calls :meth:`evaluate`)
-        is the refresh cadence."""
+    def burn_score(self, window: str = "fast") -> float:
+        """Max burn rate across objectives over ``window`` (``"fast"``
+        or ``"slow"``) as of the LAST evaluation (0.0 before any) — a
+        scalar load-shifting signal: the fleet router deprioritizes
+        replicas whose objectives are burning budget even before they
+        formally breach, so traffic shifts ahead of the page, not
+        after it. The autoscaler reads BOTH windows (scale-out needs
+        the fast window to burn hard AND the slow window to confirm
+        it is sustained — the same multiwindow discipline as paging).
+        No sampling happens here; the health-probe cadence (which
+        calls :meth:`evaluate`) is the refresh cadence."""
+        if window not in ("fast", "slow"):
+            raise ValueError(
+                f"window must be 'fast' or 'slow', got {window!r}"
+            )
         with self._lock:
             if self._last_report is None:
                 return 0.0
             return max(
                 (
-                    obj["windows"]["fast"]["burn_rate"]
+                    obj["windows"][window]["burn_rate"]
                     for obj in self._last_report["objectives"]
                 ),
                 default=0.0,
             )
+
+    def burn_scores(self) -> Dict[str, float]:
+        """``{"fast": ..., "slow": ...}`` — both windows' max burn
+        rates in one read (the autoscaler's sustained-burn signal)."""
+        return {
+            "fast": self.burn_score("fast"),
+            "slow": self.burn_score("slow"),
+        }
 
     # -- optional background ticker ---------------------------------------
 

@@ -156,6 +156,14 @@ class SlidingSamples:
             vals = sorted(self._samples)
         return vals[max(0, math.ceil(q * len(vals)) - 1)]
 
+    def mean(self, default: float = 0.0) -> float:
+        """Window mean (the rolling-average read behind the router's
+        weighted least-request latency term); ``default`` when empty."""
+        with self._lock:
+            if not self._samples:
+                return default
+            return sum(self._samples) / len(self._samples)
+
 
 # log-spaced ms buckets (1 / 2.5 / 5 per decade, 100 µs .. 1 min): wide
 # enough for a fused decode step (~2 ms) and a cold XLA compile (~20 s)
