@@ -62,6 +62,15 @@ Usage::
     #   baseline — zero caller-visible failures and exact token
     #   parity vs the solo oracle throughout (docs/robustness.md
     #   "Autoscaling & self-healing")
+    UNIONML_TPU_BENCH_PRESET=serve_fleet_obs python benchmarks/serve_latency.py
+    # ^ fleet observability plane: a 3-replica fleet under load with
+    #   cross-hop trace stitching ON and a concurrent federated
+    #   /metrics scraper — zero caller-visible failures, exact token
+    #   parity, every replica labeled in the one-scrape body, the
+    #   probe request's stitched timeline complete; then per-request
+    #   paired plane-on/off legs asserting <= 2% p99 overhead at
+    #   bit-identical tokens (docs/observability.md "Fleet
+    #   observability")
 """
 
 from __future__ import annotations
@@ -2285,6 +2294,318 @@ def autoscale_leg() -> None:
             e.close()
 
 
+def fleet_obs_leg() -> None:
+    """Fleet observability plane
+    (``UNIONML_TPU_BENCH_PRESET=serve_fleet_obs``;
+    docs/observability.md "Fleet observability").
+
+    Phase 1 — **the plane under load**: a 3-replica engine fleet
+    behind a router with cross-hop trace stitching ON, concurrent
+    clients streaming requests while a background scraper hammers the
+    federated ``/metrics`` merge. Asserts ZERO caller-visible
+    failures, exact token parity vs the solo oracle, every replica's
+    series present under its ``replica`` label in the federated body,
+    and a probe request's stitched timeline complete (route root,
+    pick/attempt spans, engine timelines parented under the attempt
+    that dispatched them, one trace id).
+
+    Phase 2 — **plane overhead**: the same fleet serves the same
+    requests with the plane OFF (``router.tracer = None``) and ON,
+    paired PER REQUEST in alternating order (the PR 8 estimator
+    protocol: whole-pass legs drift percents at minute scale; pairing
+    cancels it), per-request MIN over rounds, nearest-rank p99
+    computed UNROUNDED over enough requests that the p99 is not the
+    sample max — and the bar held against the MEDIAN of three
+    independent sweeps (a single 120×20 order statistic still swings
+    ~±1.5% from thread-scheduling jitter; measured medians 0.4–1.0%
+    across solo runs). The scraper stops first — federation is
+    scrape-path work that never rides a request, and a scrape landing
+    inside one leg of a pair is exactly the tail noise pairing exists
+    to cancel. Asserts ≤ 2% p99 and bit-identical tokens on both
+    legs.
+    """
+    import gc
+    import threading
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu import telemetry
+    from unionml_tpu.models import Llama
+    from unionml_tpu.serving.engine import DecodeEngine
+    from unionml_tpu.serving.router import (
+        EngineReplica, FleetRouter, RouterPolicy, make_router_app,
+    )
+
+    backend = jax.default_backend()
+    if backend == "cpu":
+        cfg = serving_config("tiny")
+        module = Llama(cfg)
+        tokens0 = jnp.zeros((1, 8), jnp.int32)
+        params = jax.jit(module.init)(jax.random.PRNGKey(0), tokens0)["params"]
+        n_req, clients, slots = 48, 6, 2
+        new_tokens, bucket, chunk_steps = 16, 16, 4
+        overhead_reqs, overhead_rounds = 40, 6
+    else:
+        cfg = serving_config("serve_1p5b")
+        module = Llama(cfg)
+        params = random_quantized_params(module)
+        n_req, clients, slots = 192, 24, 8
+        new_tokens, bucket, chunk_steps = 32, 64, 8
+        overhead_reqs, overhead_rounds = 120, 8
+
+    n_replicas = 3
+    # estimator hardening (the PR 8 lessons, plus this preset's own
+    # measured spread): 120+ requests so nearest-rank p99 is the
+    # 2nd-worst min rather than the sample max, and 20 rounds on CPU —
+    # at 10 rounds the per-request min still carries ±3-5% of harvester
+    # thread-scheduling jitter at the p99, swamping a 2% bar (measured:
+    # 10-round runs spread -6.5%..+5.6%, 20-round runs -0.1%..+1.8%)
+    overhead_reqs = max(overhead_reqs, 120)
+    if backend == "cpu":
+        overhead_rounds = max(overhead_rounds, 20)
+    tracer = telemetry.TraceRecorder()
+    app_registry = telemetry.MetricsRegistry()
+    flight = telemetry.FlightRecorder()
+    # per-engine registries: the federation merge has real per-replica
+    # bodies to label (the shared-registry path is the degenerate case)
+    engines = [
+        DecodeEngine(
+            module, slots=slots, max_new_tokens=new_tokens,
+            prompt_buckets=(bucket,), chunk_steps=chunk_steps,
+            max_queue_depth=64, registry=telemetry.MetricsRegistry(),
+            tracer=tracer,
+        )
+        for _ in range(n_replicas)
+    ]
+    router = FleetRouter(
+        [
+            EngineReplica(engines[i], params, name=f"r{i}")
+            for i in range(n_replicas)
+        ],
+        policy=RouterPolicy(health_ttl_s=0.05),
+        registry=app_registry,
+        flight=flight,
+        tracer=tracer,
+    )
+    app = make_router_app(
+        router, registry=app_registry, tracer=tracer, flight=flight,
+    )
+    rng = np.random.default_rng(0)
+    distinct = [
+        rng.integers(1, cfg.vocab_size, bucket // 2).tolist()
+        for _ in range(8)
+    ]
+    scrape_stop = threading.Event()
+    scrape_bodies = [0]
+
+    def scraper():
+        while not scrape_stop.is_set():
+            body = app.metrics_text()
+            if 'replica="r0"' in body:
+                scrape_bodies[0] += 1
+            scrape_stop.wait(0.05)
+
+    scraper_thread = threading.Thread(target=scraper, daemon=True)
+    try:
+        for e in engines:
+            e.warmup(params)
+        solo = {
+            tuple(p): engines[0].generate(params, [p])[0] for p in distinct
+        }
+        scraper_thread.start()
+
+        # ---- phase 1: loaded run, plane ON ----
+        results, failures, lock = [], [], threading.Lock()
+
+        def client(idx):
+            for p in (
+                distinct[(idx + k) % len(distinct)]
+                for k in range(n_req // clients)
+            ):
+                try:
+                    out = router.generate(p)
+                    with lock:
+                        results.append((tuple(p), out))
+                except BaseException as exc:  # EVERY failure counts
+                    with lock:
+                        failures.append(f"{type(exc).__name__}: {exc}")
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        assert not any(t.is_alive() for t in threads), "clients hung"
+        assert not failures, (
+            f"{len(failures)} caller-visible failures (want 0): "
+            f"{sorted(set(failures))[:3]}"
+        )
+        bad = sum(1 for key, out in results if out != solo[key])
+        assert bad == 0, f"{bad}/{len(results)} responses lost token parity"
+
+        # a probe STREAMING request right after the flood: its routing
+        # timeline is now deterministically the NEWEST route timeline,
+        # and the stitched-timeline acceptance rides it
+        probe_prompt = distinct[0]
+        probe_tokens = [
+            t for c in router.generate_stream(probe_prompt) for t in c
+        ]
+        assert probe_tokens == solo[tuple(probe_prompt)]
+        probe_rid = next(
+            rid_done
+            for rid_done, meta_done, _ in reversed(tracer._done)
+            if meta_done.get("kind") == "route"
+        )
+        # the probe's engine timeline retires on the harvester thread
+        # moments after the stream's last chunk: bounded wait
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            doc, _ = app.debug_trace(rid=probe_rid)
+            if any(
+                s.get("root") and s["kind"] == "stream"
+                for s in doc["spans"]
+            ):
+                break
+            time.sleep(0.01)
+
+        body = app.metrics_text()
+        for i in range(n_replicas):
+            assert f'replica="r{i}"' in body, (
+                f"federated body is missing replica r{i}"
+            )
+        assert "unionml_router_requests_total" in body
+        assert scrape_bodies[0] > 0, "no federated scrape completed"
+
+        doc, _ = app.debug_trace(rid=probe_rid)
+        assert doc["trace_id"], "probe request has no stitched trace"
+        span_names = [s["name"] for s in doc["spans"]]
+        assert "route" in span_names and "pick" in span_names, span_names
+        attempts = {
+            s["span_id"] for s in doc["spans"] if s["name"] == "attempt"
+        }
+        stream_roots = [
+            s for s in doc["spans"]
+            if s.get("root") and s["kind"] == "stream"
+        ]
+        assert stream_roots, "engine timeline missing from the stitch"
+        assert all(
+            s["parent_span_id"] in attempts for s in stream_roots
+        ), "engine timelines not parented under the dispatch attempt"
+        print(json.dumps({
+            "metric": "serve_fleet_obs_plane_under_load",
+            "replicas": n_replicas,
+            "offered": n_req + 1,
+            "completed": len(results) + 1,
+            "caller_visible_failures": len(failures),
+            "federated_scrapes": scrape_bodies[0],
+            "stitched_spans": len(doc["spans"]),
+            "token_parity": "exact",
+            "unit": "requests",
+        }))
+
+        # ---- phase 2: paired per-request plane on/off overhead ----
+        # the scraper stops first: federation is scrape-path work (its
+        # merge cost never rides a request), and a background scrape
+        # landing inside one leg of a pair is exactly the tail noise
+        # the paired protocol exists to cancel
+        scrape_stop.set()
+        scraper_thread.join(timeout=5.0)
+        prompts = [
+            rng.integers(1, cfg.vocab_size, bucket // 2).tolist()
+            for _ in range(overhead_reqs)
+        ]
+
+        def p99(vals):  # nearest-rank, UNROUNDED (0.1 ms rounding is
+            v = sorted(vals)  # percents of this workload)
+            return v[max(0, math.ceil(0.99 * len(v)) - 1)]
+
+        def sweep(sweep_i):
+            """One full paired measurement; even a 120×20 min-of-rounds
+            p99 still swings ~±1.5% from thread-scheduling jitter on a
+            CPU host, so the BAR is held against the median of three
+            independent sweeps — the single-order-statistic estimate
+            is the noise, not the plane."""
+            off_min = [math.inf] * overhead_reqs
+            on_min = [math.inf] * overhead_reqs
+            token_mismatch = 0
+            gc_was_enabled = gc.isenabled()
+            gc.disable()
+            try:
+                for r in range(overhead_rounds):
+                    for i, p in enumerate(prompts):
+                        legs = [("off", i), ("on", i)]
+                        if (r + i + sweep_i) % 2:
+                            legs.reverse()  # drift cancels in the pair
+                        outs = {}
+                        for legname, idx in legs:
+                            router.tracer = (
+                                tracer if legname == "on" else None
+                            )
+                            t0 = time.perf_counter()
+                            out = router.generate(p)
+                            dt = time.perf_counter() - t0
+                            mins = on_min if legname == "on" else off_min
+                            mins[idx] = min(mins[idx], dt)
+                            outs[legname] = out
+                        if outs["off"] != outs["on"]:
+                            token_mismatch += 1
+            finally:
+                router.tracer = tracer
+                if gc_was_enabled:
+                    gc.enable()
+            assert token_mismatch == 0, (
+                f"{token_mismatch} plane-on responses diverged from "
+                "plane-off"
+            )
+            return p99(off_min), p99(on_min)
+
+        sweeps = [sweep(s) for s in range(3)]
+        overheads = sorted(
+            (on99 - off99) / off99 if off99 > 0 else 0.0
+            for off99, on99 in sweeps
+        )
+        overhead = overheads[1]  # median of 3 independent sweeps
+        off99, on99 = sweeps[0]
+        assert overhead <= 0.02, (
+            f"observability plane adds {overhead:.1%} median p99 "
+            f"(sweeps: {', '.join(f'{o:.2%}' for o in overheads)}); "
+            "bar is 2%"
+        )
+        print(json.dumps({
+            "metric": "serve_fleet_obs_p99_overhead",
+            "requests": overhead_reqs,
+            "rounds": overhead_rounds,
+            "sweeps": 3,
+            "sweep_overheads_pct": [
+                round(o * 100, 2) for o in overheads
+            ],
+            "plane_off_p99_ms": round(off99 * 1e3, 3),
+            "plane_on_p99_ms": round(on99 * 1e3, 3),
+            "value": round(overhead * 100, 2),
+            "token_parity": "exact",
+            "unit": "percent",
+        }))
+        print(json.dumps({
+            "metric": "serve_fleet_obs_summary",
+            "plane_under_load": "0 caller-visible failures, parity exact",
+            "federation": f"{n_replicas} replicas under one scrape",
+            "p99_overhead_pct": round(overhead * 100, 2),
+        }))
+    finally:
+        scrape_stop.set()
+        scraper_thread.join(timeout=5.0)
+        for e in engines:
+            e.close()
+
+
 if __name__ == "__main__":
     if os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_tracing":
         if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
@@ -2319,6 +2640,17 @@ if __name__ == "__main__":
                 "workload is hardcoded in paged_leg"
             )
         paged_leg()
+    elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_fleet_obs":
+        if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
+            os.environ.get("UNIONML_TPU_BENCH_PREFIX")
+        ):
+            # hardcoded workload, same rule as the other engine legs
+            raise SystemExit(
+                "UNIONML_TPU_BENCH_PRESET=serve_fleet_obs takes no CLI "
+                f"flags or KV/PREFIX env legs (got {sys.argv[1:]}); its "
+                "workload is hardcoded in fleet_obs_leg"
+            )
+        fleet_obs_leg()
     elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_autoscale":
         if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
             os.environ.get("UNIONML_TPU_BENCH_PREFIX")

@@ -299,6 +299,120 @@ REQUEST_DERIVED_LABELS = (
 REQUEST_LABEL_EXEMPT = ("unionml_tpu/serving/usage.py",)
 
 
+# the CLOSED trace-span-name vocabulary (the autoscaler's
+# DECISION_REASONS pattern applied to spans): every literal span name
+# recorded into the TraceRecorder must come from this set, and every
+# name here must be documented in docs/observability.md — so the
+# stitched fleet timeline's vocabulary stays a documented enum that
+# OTLP consumers (grouping, alerting on span names) can rely on.
+# Names recorded from variables (the goodput tracker's phase names are
+# the BADPUT_CAUSES vocabulary, enforced at runtime) are not checkable
+# statically and are skipped.
+TRACE_SPAN_NAMES = (
+    # engine request lifecycle
+    "queue", "prefill", "harvest", "recover",
+    # micro-batcher
+    "predict",
+    # fleet router decision machinery (docs/observability.md
+    # "Fleet observability")
+    "pick", "attempt", "backoff", "hedge-lane",
+)
+# indexed span families (f-strings with a bounded constant prefix) and
+# the transport server span (f"http {path}" — path is route-bounded)
+TRACE_SPAN_PREFIXES = (
+    "decode-chunk[", "prefill-chunk[", "prefix-splice[",
+    "resume-wait[", "preempt[", "http ",
+)
+TRACE_SPAN_EXEMPT = (
+    "unionml_tpu/telemetry.py",   # the recorder mechanism itself
+)
+
+
+def _span_name_literal(node: ast.Call):
+    """The span-name argument of a ``record_span`` call when it is
+    statically checkable: ``(kind, value)`` where kind is "const" for
+    a string literal, "prefix" for an f-string's leading constant
+    part, or None for a variable (skipped)."""
+    if len(node.args) < 2:
+        return None, None
+    name_arg = node.args[1]
+    if isinstance(name_arg, ast.Constant) and isinstance(
+        name_arg.value, str
+    ):
+        return "const", name_arg.value
+    if isinstance(name_arg, ast.JoinedStr):
+        prefix = ""
+        for value in name_arg.values:
+            if isinstance(value, ast.Constant) and isinstance(
+                value.value, str
+            ):
+                prefix += value.value
+            else:
+                break
+        return "prefix", prefix
+    return None, None
+
+
+def check_span_names(package_root: Path) -> list:
+    """Every literal span name at a ``record_span`` call site must be
+    in :data:`TRACE_SPAN_NAMES` (constants) or open with a
+    :data:`TRACE_SPAN_PREFIXES` family (f-strings), and the whole
+    vocabulary must be documented in docs/observability.md — the
+    span-name twin of the metrics-doc drift check."""
+    problems = []
+    for path in sorted(package_root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        try:
+            rel = path.resolve().relative_to(ROOT).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        if rel in TRACE_SPAN_EXEMPT:
+            continue
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"))
+        except SyntaxError:
+            continue  # reported by the per-file checker
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "record_span"
+            ):
+                continue
+            kind, name = _span_name_literal(node)
+            if kind is None:
+                continue  # variable name: runtime-enforced vocabulary
+            if kind == "const" and name in TRACE_SPAN_NAMES:
+                continue
+            if kind == "prefix" and name and any(
+                name.startswith(p) for p in TRACE_SPAN_PREFIXES
+            ):
+                # the f-string's constant prefix must COVER a family
+                # prefix — the reverse test would let f"p{x}" ride in
+                # on "preempt[" and silently widen the closed set
+                continue
+            problems.append(
+                f"{path}:{node.lineno}: span name {name!r} is outside "
+                "the closed TRACE_SPAN_NAMES/TRACE_SPAN_PREFIXES set "
+                "(scripts/lint_basics.py) — span names are a "
+                "documented enum; add it there AND to "
+                f"{METRICS_DOC}, or reuse an existing name"
+            )
+    doc_path = ROOT / METRICS_DOC
+    if doc_path.exists():
+        doc_text = doc_path.read_text(encoding="utf-8")
+        for name in TRACE_SPAN_NAMES + tuple(
+            p.rstrip("[ ") for p in TRACE_SPAN_PREFIXES
+        ):
+            if name not in doc_text:
+                problems.append(
+                    f"{METRICS_DOC}: span name {name!r} from the "
+                    "TRACE_SPAN_NAMES enum is not documented"
+                )
+    return problems
+
+
 def _call_labelnames(node: ast.Call):
     """Constant label names of a metric registration call: the third
     positional arg or the ``labelnames`` kwarg, when it is a literal
@@ -442,9 +556,13 @@ def main(argv) -> int:
         problems.extend(check_file(f))
     if paths is DEFAULT_PATHS or "unionml_tpu" in paths:
         # repo-wide contracts, meaningful only when the package is in
-        # scope (a single-file lint must not fail on doc drift)
+        # scope (a single-file lint must not fail on doc drift). The
+        # default `make lint` target always lands here, so the
+        # metrics↔docs drift check and the span-name enum run on
+        # every lint, not just when someone remembers to ask.
         problems.extend(check_metrics_doc(ROOT))
         problems.extend(check_label_cardinality(ROOT / "unionml_tpu"))
+        problems.extend(check_span_names(ROOT / "unionml_tpu"))
     for p in problems:
         print(p)
     print(f"lint_basics: {len(files)} files, {len(problems)} problem(s)")

@@ -146,7 +146,7 @@ def encode_spans(
         for key, value in meta.items():
             if key not in (
                 "kind", "trace_id", "span_id", "parent_span_id",
-                "sampled", "start_s", "end_s", "truncated",
+                "sampled", "start_s", "end_s", "truncated", "events",
             ):
                 root_attrs[f"unionml.{key}"] = value
         root: Dict[str, Any] = {
@@ -160,12 +160,31 @@ def encode_spans(
         }
         if meta.get("parent_span_id"):
             root["parentSpanId"] = meta["parent_span_id"]
+        instants = meta.get("events")
+        if instants:
+            # recorder instants → OTLP span events on the root span
+            # (the fleet timeline's eject/probe/rejoin/scale_* marks)
+            root["events"] = [
+                {
+                    "timeUnixNano": _ns(ev["t_s"], wall_offset_s),
+                    "name": str(ev["name"]),
+                    **(
+                        {"attributes": _attrs({
+                            str(k): v for k, v in ev["args"].items()
+                        })}
+                        if ev.get("args") else {}
+                    ),
+                }
+                for ev in instants
+            ]
         otlp_spans.append(root)
         for span in spans:
             child: Dict[str, Any] = {
                 "traceId": trace_id,
                 "spanId": span.get("span_id") or telemetry.new_span_id(),
-                "parentSpanId": root_id,
+                # an explicit per-span parent (the router nests hedge
+                # lanes / attempts this way) wins over the root default
+                "parentSpanId": span.get("parent_span_id") or root_id,
                 "name": str(span["name"]),
                 "kind": 1,  # SPAN_KIND_INTERNAL
                 "startTimeUnixNano": _ns(span["start_s"], wall_offset_s),

@@ -348,6 +348,9 @@ class FleetAutoscaler:
         self._last_decision: Optional[dict] = None
         self._ticker: Optional[threading.Thread] = None
         self._ticker_stop = threading.Event()
+        # the fleet dashboard (GET /debug/fleet on the router app)
+        # reads the operating autoscaler's view through this link
+        router.autoscaler = self
         R = self._registry
         self._m_decisions = R.counter(
             "unionml_autoscaler_decisions_total",
@@ -585,10 +588,9 @@ class FleetAutoscaler:
                 )
                 continue
             removed.append(name)
-            self._flight.record(
-                "scale_reap", replica=name,
-                evals=self._unhealthy_streak.pop(name, 0),
-            )
+            evals = self._unhealthy_streak.pop(name, 0)
+            self._flight.record("scale_reap", replica=name, evals=evals)
+            self.router.trace_event("scale_reap", replica=name, evals=evals)
             self._m_reaped.inc()
             handle = self._provisioned.pop(name, None)
             if handle is not None:
@@ -606,6 +608,16 @@ class FleetAutoscaler:
         self._m_decisions.labels(decision, reason).inc()
         out = {"decision": decision, "reason": reason, **detail}
         self._last_decision = out
+        if decision != "scale_hold" or reason != "steady":
+            # every acted-or-blocked decision is also a span EVENT on
+            # the router's fleet timeline (OTLP export), so a latency
+            # spike and the scale decision that caused — or failed to
+            # prevent — it sit on one trace axis. Steady holds stay
+            # off the timeline for the same reason they stay out of
+            # the flight ring.
+            self.router.trace_event(decision, reason=reason, **{
+                k: v for k, v in detail.items() if k != "traffic"
+            })
         return out
 
     def _hold(self, now: float, reason: str, detail: dict) -> dict:
@@ -762,6 +774,59 @@ class FleetAutoscaler:
                 "burn_streak": self._burn_streak,
                 "provisioned": sorted(self._provisioned),
                 "provision_failures": self._provision_failures,
+            }
+
+    def dashboard(self, signals: Optional[Dict[str, dict]] = None) -> dict:
+        """The operator view ``GET /debug/fleet`` serves (through
+        :meth:`~unionml_tpu.serving.router.FleetRouter.fleet_report`,
+        which passes its already-gathered ``signals`` so one dashboard
+        call costs one fleet sweep, not three): the burn windows and
+        usage headroom the next decision will read, plus the last
+        decision and its reason. READ-ONLY and NON-BLOCKING for the
+        decision loop — the headroom is computed against the stored
+        counters without advancing them, the burn read is the
+        watchdog's last evaluation, and any replica health sweep
+        happens OUTSIDE ``_eval_lock`` (a wedged remote replica under
+        the lock would stall the very evaluation the autoscaler
+        exists to make)."""
+        if self._slo is not None:
+            burn = self._slo.burn_scores()
+        else:
+            # the replica-health fallback may touch the network —
+            # never under the evaluation lock
+            if signals is None:
+                signals = self.router.replica_signals()
+            replica_burn = max(
+                (
+                    float(s["health"].get("burn", 0.0) or 0.0)
+                    for s in signals.values()
+                ),
+                default=0.0,
+            )
+            burn = {"fast": replica_burn, "slow": replica_burn}
+        with self._eval_lock:
+            headroom, traffic = 1.0, False
+            if self._usage is not None:
+                cap, used = self._usage.capacity_totals()
+                d_cap = cap - self._last_cap
+                d_used = used - self._last_used
+                if d_cap > 0.0:
+                    headroom = max(0.0, 1.0 - d_used / d_cap)
+                    traffic = True
+            return {
+                "burn": burn,
+                "burn_streak": self._burn_streak,
+                "headroom": round(headroom, 4),
+                "traffic_since_last_eval": traffic,
+                "last_decision": dict(self._last_decision or {}),
+                "provisioned": sorted(self._provisioned),
+                "provision_failures": self._provision_failures,
+                "policy": {
+                    "min_replicas": self.policy.min_replicas,
+                    "max_replicas": self.policy.max_replicas,
+                    "headroom_out": self.policy.headroom_out,
+                    "headroom_in": self.policy.headroom_in,
+                },
             }
 
     def start(self, interval_s: float = 5.0) -> None:

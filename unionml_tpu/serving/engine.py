@@ -1054,6 +1054,30 @@ class DecodeEngine:
         self._room._usage = self._usage
 
     @property
+    def registry(self):
+        """The engine's :class:`~unionml_tpu.telemetry.MetricsRegistry`
+        — the fleet router's metrics federation reads it to expose this
+        replica's series under the router's ``replica`` label (or to
+        skip the merge when the replica already shares the router
+        app's registry)."""
+        return self._registry
+
+    @property
+    def tracer(self):
+        """The engine's :class:`~unionml_tpu.telemetry.TraceRecorder`
+        — the stitched ``/debug/trace`` fetches this replica's request
+        timelines through it (identity with the router app's recorder
+        means the local merge already covers them)."""
+        return self._tracer
+
+    @property
+    def flight(self):
+        """The engine's :class:`~unionml_tpu.telemetry.FlightRecorder`
+        (``None`` when disabled) — the fleet ``/debug/flight`` merge
+        reads replica rings through it."""
+        return self._flight
+
+    @property
     def breaker_open(self) -> bool:
         """True while the circuit breaker rejects submissions (the
         cooldown after ``breaker_threshold`` recoveries in the window).

@@ -100,12 +100,33 @@ def serving_app(
         except ValueError as exc:
             raise HTTPException(status_code=422, detail=str(exc))
 
-    def _fault_http(exc: Exception) -> "HTTPException":
+    def _fault_http(
+        exc: Exception, rid: Optional[str] = None
+    ) -> "HTTPException":
         """The faults.http_fault_response contract (429/503 +
-        Retry-After, 504) — same mapping the stdlib transport sends."""
+        Retry-After, 504) — same mapping the stdlib transport sends.
+        ``rid`` rides the error headers: Starlette discards the
+        route's Response on an HTTPException, so without this the
+        middleware would stamp a DIFFERENT X-Request-ID than the one
+        the recorded timeline is keyed by — and /debug/trace?rid=
+        would 422 for exactly the failed requests an operator wants
+        to trace."""
         status, extra = http_fault_response(exc)
+        headers = dict(extra or {})
+        if rid is not None:
+            headers["X-Request-ID"] = rid
         return HTTPException(
-            status_code=status, detail=str(exc), headers=extra or None
+            status_code=status, detail=str(exc), headers=headers or None
+        )
+
+    def _invalid_http(
+        exc: Exception, rid: Optional[str] = None
+    ) -> "HTTPException":
+        """422 with the timeline rid riding the headers (same reason
+        as :func:`_fault_http`)."""
+        return HTTPException(
+            status_code=422, detail=str(exc),
+            headers={"X-Request-ID": rid} if rid is not None else None,
         )
 
     _FAULTS = (Overloaded, EngineUnavailable, DeadlineExceeded)
@@ -118,10 +139,16 @@ def serving_app(
     # traceparent must be parsed HERE, like the deadline header).
     @app.post("/predict")
     def predict(payload: dict, request: Request, response: Response):
-        # reference: fastapi.py:50-64
+        # reference: fastapi.py:50-64. The route mints the request id
+        # itself and keys the recorded timeline by it (the middleware
+        # only fills X-Request-ID when a route didn't), so
+        # /debug/trace?rid=<X-Request-ID> resolves the id the client
+        # actually received — same contract as the stdlib transport.
+        rid = telemetry.new_request_id()
+        response.headers["X-Request-ID"] = rid
         try:
             with core.traced_request(
-                "/predict", request.headers.get("traceparent")
+                "/predict", request.headers.get("traceparent"), rid=rid,
             ) as ctx:
                 response.headers["traceparent"] = (
                     telemetry.format_traceparent(ctx)
@@ -134,9 +161,11 @@ def serving_app(
                         with deadline_scope(_parse_deadline(request)):
                             return core.predict(payload)
         except _FAULTS as exc:
-            raise _fault_http(exc)
+            raise _fault_http(exc, rid)
+        except HTTPException:
+            raise  # header-parse 422s: already shaped
         except (ValueError, KeyError, TypeError) as exc:
-            raise HTTPException(status_code=422, detail=str(exc))
+            raise _invalid_http(exc, rid)
 
     # the body's blocking first-chunk pull — queue + prefill, ~120 ms at
     # 8B, up to submit_timeout on a wedged engine — also runs in the
@@ -153,8 +182,10 @@ def serving_app(
         # trace_scope itself only needs to cover the validating
         # first-chunk pull — that is where the engine timeline is
         # created and parented.
+        rid = telemetry.new_request_id()
         ctx, finish = core.open_traced_request(
-            "/predict/stream", request.headers.get("traceparent")
+            "/predict/stream", request.headers.get("traceparent"),
+            rid=rid,
         )
         try:
             with telemetry.trace_scope(ctx):
@@ -164,10 +195,13 @@ def serving_app(
                             frames = core.predict_stream_events(payload)
         except _FAULTS as exc:
             finish()
-            raise _fault_http(exc)
+            raise _fault_http(exc, rid)
+        except HTTPException:
+            finish()
+            raise  # header-parse 422s: already shaped
         except (ValueError, KeyError, TypeError) as exc:
             finish()
-            raise HTTPException(status_code=422, detail=str(exc))
+            raise _invalid_http(exc, rid)
         except BaseException:
             finish()
             raise
@@ -180,7 +214,10 @@ def serving_app(
 
         return StreamingResponse(
             stream_then_finish(), media_type="text/event-stream",
-            headers={"traceparent": telemetry.format_traceparent(ctx)},
+            headers={
+                "traceparent": telemetry.format_traceparent(ctx),
+                "X-Request-ID": rid,
+            },
         )
 
     @app.get("/health")
@@ -247,21 +284,34 @@ def serving_app(
             raise HTTPException(status_code=422, detail=str(exc))
 
     @app.get("/debug/trace")
-    async def debug_trace(format: str = "chrome"):
+    async def debug_trace(
+        format: str = "chrome",
+        rid: Optional[str] = None,
+        trace: Optional[str] = None,
+    ):
         from fastapi.responses import Response as RawResponse
 
         try:
-            body, content_type = core.debug_trace(format)
+            body, content_type = core.debug_trace(
+                format, rid=rid, trace=trace,
+            )
         except ValueError as exc:
             raise HTTPException(status_code=422, detail=str(exc))
         if isinstance(body, str):
             return RawResponse(body, media_type=content_type)
-        return body  # chrome: plain JSON
+        return body  # chrome/stitched: plain JSON
 
     @app.get("/debug/slo")
     async def debug_slo():
         try:
             return core.debug_slo()
+        except ValueError as exc:
+            raise HTTPException(status_code=422, detail=str(exc))
+
+    @app.get("/debug/fleet")
+    async def debug_fleet():
+        try:
+            return core.debug_fleet()
         except ValueError as exc:
             raise HTTPException(status_code=422, detail=str(exc))
 
@@ -302,7 +352,11 @@ def serving_app(
                 (time.perf_counter() - t0) * 1e3,
             )
             raise
-        response.headers["X-Request-ID"] = rid
+        # the predict routes set their OWN X-Request-ID (the id their
+        # recorded timeline is keyed by — /debug/trace?rid= must
+        # resolve it); the middleware fills it everywhere else
+        if "X-Request-ID" not in response.headers:
+            response.headers["X-Request-ID"] = rid
         response.headers["X-Tenant-ID"] = tenant
         response.headers["X-Priority"] = priority
         if "traceparent" not in response.headers:

@@ -149,7 +149,7 @@ from unionml_tpu.serving.usage import (
 KNOWN_ROUTES = (
     "/", "/predict", "/predict/stream", "/health", "/stats", "/metrics",
     "/debug/profile", "/debug/memory", "/debug/flight", "/debug/trace",
-    "/debug/slo", "/debug/usage", "/debug/cache/peek",
+    "/debug/slo", "/debug/usage", "/debug/cache/peek", "/debug/fleet",
 )
 
 # the routes that open a RECORDED trace timeline (a server span the
@@ -491,9 +491,13 @@ class ServingApp:
         lifecycle events from the flight recorder (all retained when
         unset), optionally filtered by event kind / request id /
         tenant tag (``?tenant=`` names who was shed in an overload
-        postmortem)."""
+        postmortem). ``wall_offset_ms`` is the value to ADD to each
+        event's monotonic ``t_ms`` for epoch milliseconds — the fleet
+        router's flight merge rebases per-host rings with it, since
+        raw monotonic readings are incomparable across machines."""
         return {
             **self._flight.stats(),
+            "wall_offset_ms": round(telemetry.wall_clock_offset_ms(), 3),
             "events": self._flight.dump(
                 n=n, kind=kind, rid=rid, tenant=tenant
             ),
@@ -536,18 +540,63 @@ class ServingApp:
                 raise ValueError("prompt must be non-empty")
         return {"cached_prefix_len": int(self._cache_peek(tokens))}
 
-    def debug_trace(self, format: str = "chrome"):
-        """``GET /debug/trace?format=chrome|jsonl``: the trace
-        recorder's retained requests — ``(body, content_type)``.
-        ``chrome`` (default) is the Perfetto-loadable trace-event JSON;
-        ``jsonl`` one span per line for log shippers. Raises
-        ``ValueError`` (→ 422) for any other format."""
+    def debug_trace(
+        self,
+        format: str = "chrome",
+        rid: Optional[str] = None,
+        trace: Optional[str] = None,
+    ):
+        """``GET /debug/trace?format=chrome|jsonl`` — the trace
+        recorder's retained requests — OR, with ``?rid=`` /
+        ``?trace=``, ONE stitched end-to-end timeline:
+        ``(body, content_type)``.
+
+        - ``format=chrome`` (default) is the Perfetto-loadable
+          trace-event JSON; ``jsonl`` one span per line for log
+          shippers. Raises ``ValueError`` (→ 422) for any other
+          format.
+        - ``rid=<X-Request-ID>`` resolves the id a client holds into
+          its trace and answers the stitched timeline document
+          (:func:`~unionml_tpu.telemetry.stitched_trace`): every
+          retained local timeline of that trace — transport server
+          span, engine/batcher spans, and on a router app the routing
+          spans plus fetched replica spans — as one span list with
+          connected W3C parent links. Unknown rids raise
+          ``ValueError`` (→ 422).
+        - ``trace=<trace-id>`` stitches directly by trace id and
+          answers an EMPTY document when this process holds nothing
+          for it (a fleet peer probing every replica must get a
+          degrading answer, not an error).
+        """
+        if rid is not None or trace is not None:
+            trace_id = trace
+            if trace_id is None:
+                trace_id = self._tracer.find_trace_id(rid)
+                if trace_id is None:
+                    raise ValueError(
+                        f"unknown request id {rid!r} (not in the trace "
+                        "recorder's retained window)"
+                    )
+            doc = telemetry.stitched_trace(
+                trace_id, self._tracer.requests_for_trace(trace_id),
+            )
+            return doc, "application/json"
         if format == "chrome":
             return self._tracer.export_chrome(), "application/json"
         if format == "jsonl":
             return self._tracer.export_jsonl(), "application/x-ndjson"
         raise ValueError(
             f"unknown trace format {format!r} (use chrome or jsonl)"
+        )
+
+    def debug_fleet(self) -> dict:
+        """``GET /debug/fleet``: the fleet operator dashboard — only a
+        router app (:func:`~unionml_tpu.serving.router
+        .make_router_app`) has a fleet to report. Raises ``ValueError``
+        (→ 422) here."""
+        raise ValueError(
+            "no fleet on this app — serve a FleetRouter via "
+            "make_router_app for the fleet dashboard"
         )
 
     def debug_slo(self) -> dict:
@@ -561,7 +610,10 @@ class ServingApp:
             )
         return self._slo.evaluate()
 
-    def open_traced_request(self, path: str, raw_traceparent: Optional[str]):
+    def open_traced_request(
+        self, path: str, raw_traceparent: Optional[str],
+        rid: Optional[str] = None,
+    ):
         """``(ctx, finish)`` — the non-context-manager seam for
         transports whose response outlives the handler frame (the
         FastAPI streaming route hands its body to the event loop):
@@ -570,9 +622,13 @@ class ServingApp:
         ``finish()`` that records the server span and closes the
         timeline — callable exactly-once-effective from any thread.
         Prefer :meth:`traced_request` where the handler frame spans
-        the response."""
+        the response. ``rid`` keys the timeline under the transport's
+        ``X-Request-ID`` so ``/debug/trace?rid=`` resolves the id the
+        client actually received."""
         inbound = telemetry.parse_traceparent(raw_traceparent)
-        rid = self._tracer.new_request("http", trace_ctx=inbound, path=path)
+        rid = self._tracer.new_request(
+            "http", trace_ctx=inbound, rid=rid, path=path,
+        )
         ctx = self._tracer.trace_context(rid)
         t0 = time.perf_counter()
         finished = threading.Event()
@@ -593,7 +649,8 @@ class ServingApp:
 
     @contextmanager
     def traced_request(
-        self, path: str, raw_traceparent: Optional[str]
+        self, path: str, raw_traceparent: Optional[str],
+        rid: Optional[str] = None,
     ) -> Iterator[telemetry.TraceContext]:
         """One traced transport request (shared by all three
         transports so the propagation contract cannot drift): opens a
@@ -604,7 +661,7 @@ class ServingApp:
         and yields the context whose
         :func:`~unionml_tpu.telemetry.format_traceparent` the response
         must echo."""
-        ctx, finish = self.open_traced_request(path, raw_traceparent)
+        ctx, finish = self.open_traced_request(path, raw_traceparent, rid)
         try:
             with telemetry.trace_scope(ctx):
                 yield ctx
@@ -781,7 +838,12 @@ class ServingApp:
                     # without opening a recorded timeline, so probes
                     # can never churn the trace ring or the OTLP queue
                     if path in TRACED_ROUTES and self.command == "POST":
-                        with app.traced_request(path, raw_tp) as ctx:
+                        # the timeline is keyed by the response's
+                        # X-Request-ID, so /debug/trace?rid= answers
+                        # with the id the client actually holds
+                        with app.traced_request(
+                            path, raw_tp, rid=self._rid
+                        ) as ctx:
                             self._trace_ctx = ctx
                             # visible to engine/batcher submissions on
                             # this request thread (deadline-scope-style)
@@ -851,7 +913,11 @@ class ServingApp:
                 elif path == "/debug/trace":
                     fmt = query.get("format", ["chrome"])[0]
                     try:
-                        body, content_type = app.debug_trace(fmt)
+                        body, content_type = app.debug_trace(
+                            fmt,
+                            rid=query.get("rid", [None])[0],
+                            trace=query.get("trace", [None])[0],
+                        )
                     except ValueError as exc:
                         self._send(422, {"error": str(exc)})
                         return
@@ -859,6 +925,11 @@ class ServingApp:
                 elif path == "/debug/slo":
                     try:
                         self._send(200, app.debug_slo())
+                    except ValueError as exc:
+                        self._send(422, {"error": str(exc)})
+                elif path == "/debug/fleet":
+                    try:
+                        self._send(200, app.debug_fleet())
                     except ValueError as exc:
                         self._send(422, {"error": str(exc)})
                 else:

@@ -54,14 +54,15 @@ post-hoc.
 
 from __future__ import annotations
 
+import itertools
 import json
 import random
 import threading
 import time
 import urllib.error
 import urllib.request
-from contextlib import contextmanager
-from typing import Callable, Dict, Iterator, List, Optional, Sequence
+from contextlib import contextmanager, nullcontext
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from unionml_tpu import telemetry
 from unionml_tpu._logging import logger
@@ -118,6 +119,12 @@ class ReplicaHandle:
 
     name: str = "replica"
 
+    # True for handles whose observability fetches cross a network
+    # (the fleet debug surfaces fan those out on bounded-deadline
+    # threads; in-process fetches run inline — a local registry read
+    # must not pay a thread spawn per scrape)
+    remote: bool = False
+
     def generate_stream(
         self, prompt: Sequence[int], *, max_new_tokens: Optional[int] = None,
     ) -> Iterator[List[int]]:
@@ -170,6 +177,69 @@ class ReplicaHandle:
         before this replica takes traffic; returns blocks attached (0
         when unsupported)."""
         return 0
+
+    # -- fleet observability hooks (docs/observability.md "Fleet
+    # observability"): how the router app's federated /metrics, merged
+    # /debug/flight, stitched /debug/trace, and fleet /debug/slo +
+    # /debug/usage read THIS replica. Defaults say "nothing to
+    # contribute"; every implementation must degrade (None/empty),
+    # never raise — a dead replica degrades a debug surface, it does
+    # not break it.
+
+    def metrics_registry(self) -> Optional[telemetry.MetricsRegistry]:
+        """The in-process registry behind :meth:`metrics_text`, when
+        one exists — the router app skips replicas whose registry IS
+        its own (their series are already in the local exposition)."""
+        return None
+
+    def metrics_text(self) -> Optional[str]:
+        """This replica's Prometheus exposition body (``None`` =
+        nothing to federate)."""
+        return None
+
+    def flight_recorder(self) -> Optional[telemetry.FlightRecorder]:
+        """The in-process flight ring behind :meth:`flight_events`
+        (identity with the router app's ring = already merged)."""
+        return None
+
+    def flight_events(self, n: Optional[int] = None) -> Optional[List[dict]]:
+        """This replica's newest flight events (oldest first); ``[]``
+        = genuinely empty ring, ``None`` = the fetch FAILED (the
+        router app counts the failure — an empty ring and a dead
+        replica must not read the same)."""
+        return []
+
+    def trace_recorder(self) -> Optional[telemetry.TraceRecorder]:
+        """The in-process trace recorder behind :meth:`stitched_spans`
+        (identity with the router app's recorder = already stitched)."""
+        return None
+
+    def stitched_spans(
+        self, trace_id: str
+    ) -> Optional[Tuple[List[dict], List[dict]]]:
+        """``(spans, events)`` this replica holds for ``trace_id``, in
+        :func:`~unionml_tpu.telemetry.stitched_trace` span form — the
+        fetch half of cross-hop stitching. ``None`` = the fetch
+        FAILED (counted by the router app), distinct from holding
+        nothing for the trace."""
+        return [], []
+
+    def slo_report(self) -> Optional[dict]:
+        """This replica's ``/debug/slo`` evaluation (``None`` when it
+        runs no watchdog)."""
+        return None
+
+    def usage_ledger(self):
+        """The in-process :class:`~unionml_tpu.serving.usage
+        .UsageLedger` behind :meth:`usage_report`, when one exists —
+        replicas sharing ONE ledger must be merged once, not per
+        replica."""
+        return None
+
+    def usage_report(self) -> Optional[dict]:
+        """This replica's ``/debug/usage`` body (``None`` when it
+        meters nothing)."""
+        return None
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Finish in-flight work; stop admitting. True when drained."""
@@ -242,6 +312,38 @@ class EngineReplica(ReplicaHandle):
             return 0
         return int(cache.import_blocks(entries))
 
+    def metrics_registry(self):
+        return self.engine.registry
+
+    def metrics_text(self) -> Optional[str]:
+        return self.engine.registry.exposition()
+
+    def flight_recorder(self):
+        return self.engine.flight
+
+    def flight_events(self, n: Optional[int] = None) -> List[dict]:
+        flight = self.engine.flight
+        return [] if flight is None else flight.dump(n=n)
+
+    def trace_recorder(self):
+        return self.engine.tracer
+
+    def stitched_spans(self, trace_id: str) -> Tuple[List[dict], List[dict]]:
+        doc = telemetry.stitched_trace(
+            trace_id, self.engine.tracer.requests_for_trace(trace_id)
+        )
+        return doc["spans"], doc["events"]
+
+    def slo_report(self) -> Optional[dict]:
+        return None if self._slo is None else self._slo.evaluate()
+
+    def usage_ledger(self):
+        return self.engine.usage
+
+    def usage_report(self) -> Optional[dict]:
+        ledger = self.engine.usage
+        return None if ledger is None else ledger.report()
+
     def drain(self, timeout: Optional[float] = None) -> bool:
         return self.engine.drain(timeout)
 
@@ -264,11 +366,14 @@ class HttpReplica(ReplicaHandle):
     kinds.
     """
 
+    remote = True  # observability fetches cross the network: fan out
+
     def __init__(
         self, base_url: str, *, name: Optional[str] = None,
         timeout_s: float = 60.0, peek_ttl_s: float = 1.0,
         peek_cache_size: int = 256, peek_timeout_s: float = 2.0,
-        peek_prompt_tokens: int = 128,
+        peek_prompt_tokens: int = 128, metrics_ttl_s: float = 2.0,
+        obs_timeout_s: float = 5.0,
     ):
         self.base_url = base_url.rstrip("/")
         self.name = name if name is not None else self.base_url
@@ -292,6 +397,20 @@ class HttpReplica(ReplicaHandle):
         self._peek_cache: Dict[bytes, tuple] = {}
         self._peek_lock = threading.Lock()
         self._peek_supported = True  # flips off on a 404 (older remote)
+        # metrics-federation scrape cache (health-TTL pattern, strict
+        # `<` so metrics_ttl_s=0 means always-fresh): the router app's
+        # /metrics federates every replica, so a hot scraper must not
+        # fan out one remote GET per replica per scrape. On failure the
+        # LAST-SEEN body keeps serving (a killed replica degrades the
+        # fleet scrape to stale-or-absent series, never to an error).
+        self.metrics_ttl_s = float(metrics_ttl_s)
+        # the operator/debug fetch timeout (flight/slo/usage/trace
+        # pulls): bounded so one wedged replica cannot stall a fleet
+        # debug surface for the full 60 s dispatch timeout
+        self.obs_timeout_s = float(obs_timeout_s)
+        self._metrics_lock = threading.Lock()
+        self._metrics_cache: Optional[str] = None
+        self._metrics_at = float("-inf")
 
     def _headers(self) -> dict:
         headers = {"Content-Type": "application/json"}
@@ -425,10 +544,13 @@ class HttpReplica(ReplicaHandle):
             ) from exc
         return [int(t) for t in rows[0]]
 
-    def _get_json(self, path: str) -> dict:
+    def _get_json(
+        self, path: str, timeout_s: Optional[float] = None
+    ) -> dict:
         req = urllib.request.Request(f"{self.base_url}{path}")
+        timeout = timeout_s if timeout_s is not None else self.timeout_s
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
                 return json.loads(resp.read().decode())
         except urllib.error.HTTPError as exc:
             # /health answers 503 WITH the body when degraded/draining
@@ -445,7 +567,99 @@ class HttpReplica(ReplicaHandle):
             ) from exc
 
     def health(self) -> dict:
-        return self._get_json("/health")
+        # the control-plane read gets the bounded observability
+        # timeout, not the 60 s dispatch timeout: health is probed on
+        # the pick path (TTL-missed) and by /debug/fleet — a wedged-
+        # but-accepting host must not stall either for a minute (the
+        # same argument that gave the cache peek its own timeout)
+        return self._get_json("/health", timeout_s=self.obs_timeout_s)
+
+    def _get_debug_json(self, path: str) -> Optional[dict]:
+        """Best-effort debug-surface fetch on the bounded
+        ``obs_timeout_s``: any failure — unreachable host, 4xx (the
+        surface isn't wired remotely), garbage body — answers ``None``
+        so a fleet debug merge degrades instead of erroring."""
+        try:
+            req = urllib.request.Request(f"{self.base_url}{path}")
+            with urllib.request.urlopen(
+                req, timeout=self.obs_timeout_s
+            ) as resp:
+                return json.loads(resp.read().decode())
+        except BaseException:
+            return None
+
+    def metrics_text(self) -> Optional[str]:
+        """The remote ``GET /metrics`` body, TTL-cached
+        (``metrics_ttl_s``, strict ``<``); failures serve the
+        last-seen body (or ``None`` before the first success) — the
+        federation contract: a killed replica degrades the fleet
+        scrape, never breaks it."""
+        now = time.monotonic()
+        with self._metrics_lock:
+            if now - self._metrics_at < self.metrics_ttl_s:
+                return self._metrics_cache
+        body: Optional[str] = None
+        try:
+            req = urllib.request.Request(f"{self.base_url}/metrics")
+            with urllib.request.urlopen(
+                req, timeout=self.obs_timeout_s
+            ) as resp:
+                body = resp.read().decode()
+        except BaseException:
+            body = None
+        with self._metrics_lock:
+            if body is not None:
+                self._metrics_cache = body
+            # a FAILED scrape also refreshes the TTL stamp — and the
+            # stamp is taken AFTER the fetch: a black-holed host's
+            # obs_timeout_s (5 s) exceeds metrics_ttl_s (2 s), so a
+            # pre-fetch stamp would already be expired by the next
+            # scrape and every fleet scrape would re-pay the full
+            # connect timeout
+            self._metrics_at = time.monotonic()
+            return self._metrics_cache
+
+    def flight_events(self, n: Optional[int] = None) -> Optional[List[dict]]:
+        path = "/debug/flight" + (f"?n={int(n)}" if n is not None else "")
+        body = self._get_debug_json(path)
+        if body is None:
+            return None  # fetch failed: the app counts it
+        events = body.get("events", [])
+        if not isinstance(events, list):
+            return []
+        # rebase the REMOTE host's monotonic t_ms onto the wall clock
+        # using the anchor the remote computed itself — cross-host
+        # monotonic readings are incomparable (each host's epoch is
+        # its boot time); wall-anchored ones merge at NTP accuracy.
+        # An older remote without the anchor returns raw readings
+        # (degraded ordering, still merged).
+        offset = body.get("wall_offset_ms")
+        if isinstance(offset, (int, float)):
+            events = [
+                {**e, "t_ms": round(e.get("t_ms", 0.0) + offset, 3)}
+                if isinstance(e, dict) else e
+                for e in events
+            ]
+        return events
+
+    def stitched_spans(
+        self, trace_id: str
+    ) -> Optional[Tuple[List[dict], List[dict]]]:
+        body = self._get_debug_json(
+            f"/debug/trace?trace={trace_id}&format=stitched"
+        )
+        if body is None:
+            return None  # fetch failed: the app counts it
+        return (
+            body.get("spans", []) or [],
+            body.get("events", []) or [],
+        )
+
+    def slo_report(self) -> Optional[dict]:
+        return self._get_debug_json("/debug/slo")
+
+    def usage_report(self) -> Optional[dict]:
+        return self._get_debug_json("/debug/usage")
 
     def cached_prefix_len(self, prompt) -> int:
         """Cache-affinity across hosts: probe the remote transport's
@@ -672,6 +886,12 @@ class FleetRouter:
     ``sleep`` likewise for backoff.
     """
 
+    # fleet lifecycle events per fleet-timeline rotation: the timeline
+    # must FINISH to export (OTLP listeners fire on finish), so a busy
+    # fleet rotates often enough that events ship within minutes while
+    # a quiet one holds a mostly-empty timeline open
+    FLEET_TIMELINE_ROTATE = 256
+
     def __init__(
         self,
         replicas: Sequence[ReplicaHandle],
@@ -679,6 +899,7 @@ class FleetRouter:
         policy: Optional[RouterPolicy] = None,
         registry: Optional[telemetry.MetricsRegistry] = None,
         flight: Optional[telemetry.FlightRecorder] = None,
+        tracer: Optional[telemetry.TraceRecorder] = None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
     ):
@@ -708,8 +929,41 @@ class FleetRouter:
         self._flight = (
             flight if flight is not None else telemetry.get_flight_recorder()
         )
+        # the stitching recorder: every routed request opens a "route"
+        # timeline here (pick / attempt / backoff / hedge-lane spans)
+        # parented into the caller's ambient trace scope, and each
+        # attempt's child context propagates to the replica — assign
+        # None to the `tracer` property to turn the plane off (the
+        # bench's paired-leg seam)
+        self._tracer = tracer if tracer is not None else telemetry.get_tracer()
+        self._fleet_lock = threading.Lock()
+        self._fleet_rid: Optional[str] = None
+        self._fleet_events = 0
+        # set by a FleetAutoscaler operating this router; the fleet
+        # dashboard (GET /debug/fleet) reads its last decision through it
+        self.autoscaler = None
         self._build_instruments()
         self._g_live.set_function(self._live_count)
+
+    @property
+    def tracer(self) -> Optional[telemetry.TraceRecorder]:
+        """The recorder routing timelines land in (``None`` = trace
+        stitching off)."""
+        return self._tracer
+
+    @tracer.setter
+    def tracer(self, recorder: Optional[telemetry.TraceRecorder]) -> None:
+        """Swap (or disable, with ``None``) the stitching recorder —
+        ONLY while idle, the same contract as ``engine.usage``: the
+        ``serve_fleet_obs`` bench toggles this between its paired
+        overhead legs so both run on the SAME router instance."""
+        with self._fleet_lock:
+            old_rid, old = self._fleet_rid, self._tracer
+            self._fleet_rid = None
+            self._fleet_events = 0
+            self._tracer = recorder
+        if old_rid is not None and old is not None:
+            old.finish_request(old_rid)
 
     # -- instruments -------------------------------------------------------
 
@@ -764,7 +1018,103 @@ class FleetRouter:
                 if s.state in (_LIVE, _HALF_OPEN)
             ))
 
+    # -- fleet lifecycle timeline ------------------------------------------
+
+    def trace_event(self, name: str, **args) -> None:
+        """Record one fleet-lifecycle instant — the router's
+        ``eject``/``probe``/``rejoin`` transitions, the autoscaler's
+        ``scale_*`` decisions — onto a rotating ``kind="fleet"``
+        recorder timeline, exported over OTLP as span EVENTS on the
+        fleet root span: a latency spike is then explainable from the
+        trace alone, with the scale/eject marks sitting on the same
+        wall-anchored axis as the request spans. Rotates every
+        :data:`FLEET_TIMELINE_ROTATE` events (a finished timeline is
+        what actually exports); no-op while stitching is off."""
+        tracer = self._tracer
+        if tracer is None:
+            return
+        finish_rid = None
+        with self._fleet_lock:
+            if tracer is not self._tracer:
+                return  # swapped between the read and the lock
+            if (
+                self._fleet_rid is None
+                or self._fleet_events >= self.FLEET_TIMELINE_ROTATE
+            ):
+                finish_rid = self._fleet_rid
+                # trace_scope(None) masks any ambient request scope:
+                # the fleet timeline is a ROOT trace, not a child of
+                # whichever request's thread happened to eject first
+                with telemetry.trace_scope(None):
+                    self._fleet_rid = tracer.new_request(
+                        "fleet", component="router",
+                    )
+                self._fleet_events = 0
+            self._fleet_events += 1
+            rid = self._fleet_rid
+        if finish_rid is not None:
+            tracer.finish_request(finish_rid)
+        tracer.record_event(rid, name, **args)
+
+    def _close_fleet_timeline(self) -> None:
+        with self._fleet_lock:
+            rid, self._fleet_rid = self._fleet_rid, None
+            self._fleet_events = 0
+            tracer = self._tracer
+        if rid is not None and tracer is not None:
+            tracer.finish_request(rid)
+
     # -- membership / choreography ----------------------------------------
+
+    def members(self) -> Dict[str, ReplicaHandle]:
+        """Every registered replica handle by name (any lifecycle
+        state) — the fleet observability surfaces iterate membership
+        through this instead of reaching into router internals."""
+        with self._lock:
+            return {n: s.handle for n, s in self._replicas.items()}
+
+    def fleet_report(self) -> dict:
+        """The ``GET /debug/fleet`` operator dashboard: per-replica
+        router state + health (breaker, drain, queue depth), cache
+        blocks, burn scores, retry-budget level — and, when a
+        :class:`~unionml_tpu.serving.autoscaler.FleetAutoscaler`
+        operates this router, its dashboard (usage headroom, burn
+        windows, last scale decision + reason) under
+        ``"autoscaler"``."""
+        signals = self.replica_signals()  # ONE health sweep, TTL-cached
+        health = self.health()            # router-local state, no probes
+        with self._lock:
+            budget = self._budget_tokens
+        replicas = {}
+        for name, s in signals.items():
+            h = s["health"]
+            replicas[name] = {
+                "state": s["state"],
+                "status": h.get("status", "unknown"),
+                "queue_depth": h.get("queue_depth", 0),
+                "breaker_open": bool(h.get("breaker_open", False)),
+                "burn": float(h.get("burn", 0.0) or 0.0),
+                "cache_blocks": s["cache_blocks"],
+                "consecutive_failures": s["consecutive_failures"],
+            }
+        report = {
+            "status": health["status"],
+            "live_replicas": health["live_replicas"],
+            "min_live": health["min_live"],
+            "retry_budget_tokens": round(budget, 3),
+            "replicas": replicas,
+        }
+        auto = self.autoscaler
+        if auto is not None:
+            try:
+                # hand over the sweep this call already did, so the
+                # dashboard costs zero additional health probes
+                report["autoscaler"] = auto.dashboard(signals=signals)
+            except BaseException as exc:
+                # the dashboard is a debug read: a mid-teardown
+                # autoscaler degrades it, never breaks /debug/fleet
+                report["autoscaler"] = {"error": str(exc)}
+        return report
 
     def replica_handle(self, name: str) -> ReplicaHandle:
         """The handle registered under ``name`` (KeyError when absent)
@@ -832,6 +1182,7 @@ class FleetRouter:
             state.health_at = float("-inf")
         self._m_rejoins.labels(name).inc()
         self._flight.record("rejoin", replica=name, cause="operator")
+        self.trace_event("rejoin", replica=name, cause="operator")
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Drain the WHOLE fleet (router stops admitting; every replica
@@ -858,6 +1209,8 @@ class FleetRouter:
             self.rejoin_replica(name)
 
     def close(self) -> None:
+        # flush the pending fleet-lifecycle events to any exporter
+        self._close_fleet_timeline()
         for state in list(self._replicas.values()):
             state.handle.close()
 
@@ -1024,6 +1377,11 @@ class FleetRouter:
             consecutive=state.consecutive_failures,
             cooldown_s=round(cooldown, 3),
         )
+        self.trace_event(
+            "eject", replica=name, cause=cause,
+            consecutive=state.consecutive_failures,
+            cooldown_s=round(cooldown, 3),
+        )
         logger.info(
             f"router: ejected {name} ({cause}, "
             f"{state.consecutive_failures} consecutive, "
@@ -1071,6 +1429,7 @@ class FleetRouter:
                 state.eject_count = 0  # probe succeeded: reset the ladder
                 self._m_rejoins.labels(name).inc()
                 self._flight.record("rejoin", replica=name, cause="probe_ok")
+                self.trace_event("rejoin", replica=name, cause="probe_ok")
                 logger.info(f"router: {name} rejoined after probe")
 
     # -- picking -----------------------------------------------------------
@@ -1110,6 +1469,7 @@ class FleetRouter:
                     self._flight.record(
                         "probe", replica=state.handle.name
                     )
+                    self.trace_event("probe", replica=state.handle.name)
                 if state.state == _LIVE:
                     candidates.append(state)
                 elif state.state == _HALF_OPEN and not state.probe_inflight:
@@ -1212,6 +1572,44 @@ class FleetRouter:
             self._latency.percentile(self.policy.hedge_quantile),
         )
 
+    # -- stitched routing timeline ----------------------------------------
+
+    def _open_timeline(self, prompt_tokens: int):
+        """``(rid, ctx, tracer)`` for one routed request: when
+        stitching is on, a ``kind="route"`` recorder timeline keyed by
+        the routing rid, parented into the caller's ambient trace
+        scope (the transport's server span on a router app) — ``ctx``
+        is its root context, the parent every pick/attempt span hangs
+        from. The OPENING recorder is returned and threaded through to
+        the close: a mid-request ``tracer`` swap must finish the
+        timeline in the recorder it was opened in, never leak it live
+        in the old one. ``(rid, None, None)`` when the plane is off."""
+        tracer = self._tracer
+        rid = telemetry.new_request_id()
+        if tracer is None:
+            return rid, None, None
+        rid = tracer.new_request(
+            "route", rid=rid, prompt_tokens=int(prompt_tokens),
+        )
+        return rid, tracer.trace_context(rid), tracer
+
+    @staticmethod
+    def _finish_timeline(tracer, rid: str) -> None:
+        if tracer is not None:
+            tracer.finish_request(rid)
+
+    def _attempt_scope(self, t_ctx, span_id):
+        """The child context one dispatch attempt propagates: the
+        replica's server-side timeline (in-process engine, or a remote
+        transport via the ``traceparent`` header) parents to the
+        ATTEMPT span, so retried/hedged dispatches nest under the
+        attempt that caused them, not interleaved under one parent."""
+        if t_ctx is None:
+            return nullcontext()
+        return telemetry.trace_scope(telemetry.TraceContext(
+            t_ctx.trace_id, span_id, t_ctx.sampled,
+        ))
+
     def generate_stream(
         self, prompt: Sequence[int], *, max_new_tokens: Optional[int] = None,
     ) -> Iterator[List[int]]:
@@ -1227,13 +1625,23 @@ class FleetRouter:
                 "router is draining", reason="draining",
             )
         self._deposit_budget()
-        rid = telemetry.new_request_id()
-        return self._stream_with_failover(
-            rid, prompt, max_new_tokens=max_new_tokens
+        rid, t_ctx, tracer = self._open_timeline(len(prompt))
+        inner = self._stream_with_failover(
+            rid, prompt, max_new_tokens=max_new_tokens, t_ctx=t_ctx,
+            tracer=tracer,
         )
+        if t_ctx is None:
+            return inner
+        # _TracedStream (not a plain generator): the timeline must
+        # close on EVERY exit, including the caller dropping the
+        # iterator without ever pulling it (a generator's finally
+        # never runs for a never-started body — a leaked live
+        # timeline forever)
+        return _TracedStream(tracer, rid, inner)
 
     def _stream_with_failover(self, rid, prompt, *, max_new_tokens,
-                              dispatch=None, initial_exclude=()):
+                              dispatch=None, initial_exclude=(),
+                              t_ctx=None, tracer=None):
         """The retry envelope. ``dispatch(replica) -> chunk iterator``
         defaults to the replica's streaming primitive; the blocking
         path passes a single-yield wrapper over ``replica.generate``
@@ -1241,12 +1649,19 @@ class FleetRouter:
         implementation. ``initial_exclude`` seeds the exclusion list
         with replicas a caller already saw fail (the hedge fallback) —
         the soft exclusion: if nothing else is routable, the pick
-        fallback below relaxes it."""
+        fallback below relaxes it. ``t_ctx`` (the routing timeline's
+        root context, when stitching is on) turns every decision into
+        a recorded span: ``pick``, per-dispatch ``attempt`` (whose
+        pre-minted span id is the child context the replica's own
+        spans nest under), ``backoff`` — recorded into ``tracer``, the
+        recorder captured at open (a mid-request swap must not split a
+        timeline across recorders)."""
         emitted = 0          # tokens already yielded to the caller
         attempt = 1
         tried: List[str] = list(initial_exclude)
         last_exc: Optional[BaseException] = None
         while attempt <= self.policy.max_attempts:
+            t_pick0 = time.perf_counter()
             try:
                 replica = self._pick(prompt, exclude=tried)
             except EngineUnavailable:
@@ -1263,24 +1678,40 @@ class FleetRouter:
                         raise last_exc
                     raise
             name = replica.name
+            if tracer is not None:
+                tracer.record_span(
+                    rid, "pick", t_pick0, time.perf_counter(),
+                    replica=name, attempt=attempt,
+                )
             if attempt == 1:
                 self._flight.record("route", rid=rid, replica=name)
             else:
                 self._m_retries.labels(name).inc()
+            attempt_span = (
+                telemetry.new_span_id() if tracer is not None else None
+            )
             t0 = time.perf_counter()
             skip = emitted
+            replayed = emitted   # tokens this attempt must regenerate
             try:
-                with _rid_scope(rid):
-                    # the rid scope covers dispatch: HttpReplica builds
-                    # its X-Request-ID header here, so the remote
-                    # flight recorder tags the SAME rid as ours
-                    source = (
+                with _rid_scope(rid), self._attempt_scope(
+                    t_ctx, attempt_span
+                ):
+                    # dispatch AND the first chunk pull run inside the
+                    # scopes: HttpReplica builds its X-Request-ID /
+                    # traceparent headers here (eager), and an
+                    # in-process engine's lazy generator creates its
+                    # request timeline on the first next() — both must
+                    # see the attempt's child context so cross-hop
+                    # spans nest under THIS attempt
+                    source = iter(
                         dispatch(replica) if dispatch is not None
                         else replica.generate_stream(
                             prompt, max_new_tokens=max_new_tokens
                         )
                     )
-                for chunk in source:
+                    head = list(itertools.islice(source, 1))
+                for chunk in itertools.chain(head, source):
                     # replay-skip: a retry regenerates from the start;
                     # tokens the caller already holds are dropped here
                     if skip >= len(chunk):
@@ -1293,8 +1724,25 @@ class FleetRouter:
                 self._note_latency(name, time.perf_counter() - t0)
                 self._record_success(name)
                 self._m_routed.labels(name, "ok").inc()
+                if tracer is not None:
+                    tracer.record_span(
+                        rid, "attempt", t0, time.perf_counter(),
+                        span_id=attempt_span, replica=name,
+                        attempt=attempt, outcome="ok", replayed=replayed,
+                    )
                 return
             except BaseException as exc:
+                if tracer is not None:
+                    outcome = (
+                        "abandoned" if isinstance(exc, GeneratorExit)
+                        else "error"
+                    )
+                    tracer.record_span(
+                        rid, "attempt", t0, time.perf_counter(),
+                        span_id=attempt_span, replica=name,
+                        attempt=attempt, outcome=outcome,
+                        error=type(exc).__name__, replayed=replayed,
+                    )
                 if not _retryable(exc):
                     # includes GeneratorExit (caller abandoned the
                     # stream): if this dispatch was a half-open probe,
@@ -1324,7 +1772,13 @@ class FleetRouter:
                     reason=type(exc).__name__, backoff_s=round(delay, 4),
                     emitted=emitted,
                 )
+                t_back0 = time.perf_counter()
                 self._sleep(delay)
+                if tracer is not None:
+                    tracer.record_span(
+                        rid, "backoff", t_back0, time.perf_counter(),
+                        attempt=attempt, delay_s=round(delay, 4),
+                    )
                 attempt += 1
         raise last_exc if last_exc is not None else EngineUnavailable(
             "retry attempts exhausted", reason="no_live_replicas",
@@ -1350,13 +1804,17 @@ class FleetRouter:
                 "router is draining", reason="draining",
             )
         self._deposit_budget()
-        rid = telemetry.new_request_id()
-        return self._collect(self._stream_with_failover(
-            rid, prompt, max_new_tokens=max_new_tokens,
-            dispatch=lambda rep: iter(
-                [rep.generate(prompt, max_new_tokens=max_new_tokens)]
-            ),
-        ))
+        rid, t_ctx, tracer = self._open_timeline(len(prompt))
+        try:
+            return self._collect(self._stream_with_failover(
+                rid, prompt, max_new_tokens=max_new_tokens,
+                dispatch=lambda rep: iter(
+                    [rep.generate(prompt, max_new_tokens=max_new_tokens)]
+                ),
+                t_ctx=t_ctx, tracer=tracer,
+            ))
+        finally:
+            self._finish_timeline(tracer, rid)
 
     @staticmethod
     def _collect(stream: Iterator[List[int]]) -> List[int]:
@@ -1369,13 +1827,47 @@ class FleetRouter:
         if self._draining:
             raise EngineUnavailable("router is draining", reason="draining")
         self._deposit_budget()
-        rid = telemetry.new_request_id()
+        rid, t_ctx, tracer = self._open_timeline(len(prompt))
+        try:
+            return self._hedged_inner(
+                rid, t_ctx, tracer, prompt, max_new_tokens,
+            )
+        finally:
+            # success, fallback, or error alike: the routing timeline
+            # closes exactly once, exporting lanes + win/lose events —
+            # in the recorder it was OPENED in, swap-proof
+            self._finish_timeline(tracer, rid)
+
+    def _hedged_inner(
+        self, rid, t_ctx, tracer, prompt, max_new_tokens,
+    ) -> List[int]:
         delay_s = self._hedge_delay_s()
         done = threading.Event()
         results: List = [None, None]   # per-lane (tokens | exception)
         lanes: List[Optional[str]] = [None, None]
+        lane_spans: List[Optional[str]] = [None, None]
+        lane_t0: List[Optional[float]] = [None, None]
+        lane_recorded = [False, False]  # under winner_lock: span written
         winner_lock = threading.Lock()
         winner: List[Optional[int]] = [None]
+
+        def record_lane(idx: int, outcome: str, end_s: float) -> None:
+            """Write lane ``idx``'s span exactly once — from the lane's
+            own finally, OR from the coordinator when the LOSER is
+            still mid-decode at win time (the routing timeline closes
+            with the response; a span recorded after that would be
+            dropped, and the loser would vanish from the stitch)."""
+            if tracer is None or lane_t0[idx] is None:
+                return
+            with winner_lock:
+                if lane_recorded[idx]:
+                    return
+                lane_recorded[idx] = True
+            tracer.record_span(
+                rid, "hedge-lane", lane_t0[idx], end_s,
+                span_id=lane_spans[idx], lane=idx,
+                replica=lanes[idx] or "none", outcome=outcome,
+            )
 
         # scopes are thread-local: capture the caller's and re-open
         # them inside each lane so deadlines/tenants/traces survive
@@ -1385,11 +1877,37 @@ class FleetRouter:
         priority = current_priority()
         trace_ctx = telemetry.current_trace_context()
 
+        def start_lane(idx: int, exclude: List[str]) -> threading.Thread:
+            """Pre-mint the lane's span id and start time BEFORE the
+            thread spawns: the coordinator's loser-span write must
+            never lose the race against a lane thread the scheduler
+            hasn't run yet (lane_t0 unset → record_lane would no-op,
+            the lane's own finally would then record into a finished
+            timeline, and the loser would vanish from the stitch)."""
+            if tracer is not None:
+                lane_spans[idx] = telemetry.new_span_id()
+            lane_t0[idx] = time.perf_counter()
+            thread = threading.Thread(
+                target=lane, args=(idx, exclude), daemon=True,
+            )
+            thread.start()
+            return thread
+
         def lane(idx: int, exclude: List[str]) -> None:
+            # each lane is its own recorded span; the lane's child
+            # context (trace id + lane span id) is what the replica's
+            # spans nest under, so win AND lose lanes stay separable
+            # in the stitched timeline
+            if tracer is not None:
+                lane_ctx = telemetry.TraceContext(
+                    t_ctx.trace_id, lane_spans[idx], t_ctx.sampled,
+                )
+            else:
+                lane_ctx = trace_ctx
             try:
                 with deadline_scope(deadline), tenant_scope(tenant), \
                         priority_scope(priority), \
-                        telemetry.trace_scope(trace_ctx), _rid_scope(rid):
+                        telemetry.trace_scope(lane_ctx), _rid_scope(rid):
                     replica = self._pick(prompt, exclude=exclude)
                     lanes[idx] = replica.name
                     t0 = time.perf_counter()
@@ -1421,6 +1939,16 @@ class FleetRouter:
                 if lanes[idx] is not None and _retryable(exc):
                     self._record_failure(lanes[idx], exc)
             finally:
+                record_lane(
+                    idx,
+                    (
+                        "error"
+                        if isinstance(results[idx], BaseException)
+                        else "ok" if results[idx] is not None
+                        else "abandoned"
+                    ),
+                    time.perf_counter(),
+                )
                 if lanes[idx] is not None:
                     # lost-and-abandoned or non-retryable exits say
                     # nothing about health: free the probe slot if this
@@ -1434,8 +1962,7 @@ class FleetRouter:
                 done.set()
 
         self._flight.record("route", rid=rid, replica="<hedged>")
-        t_first = threading.Thread(target=lane, args=(0, []), daemon=True)
-        t_first.start()
+        t_first = start_lane(0, [])
         t_first.join(timeout=delay_s)
         hedged = False
         exclude = [lanes[0]] if lanes[0] else []
@@ -1453,10 +1980,7 @@ class FleetRouter:
                 "hedge", rid=rid, after_s=round(delay_s, 4),
                 exclude=exclude,
             )
-            t_second = threading.Thread(
-                target=lane, args=(1, exclude), daemon=True
-            )
-            t_second.start()
+            t_second = start_lane(1, exclude)
         while True:
             # short-timeout wait: a lane's done.set() landing between
             # our clear() and wait() must not strand this loop
@@ -1514,6 +2038,7 @@ class FleetRouter:
                         [rep.generate(prompt, max_new_tokens=max_new_tokens)]
                     ),
                     initial_exclude=failed,
+                    t_ctx=t_ctx, tracer=tracer,
                 ))
             if last is not None:
                 raise last
@@ -1524,13 +2049,70 @@ class FleetRouter:
         self._m_routed.labels(win_name, "ok").inc()
         if hedged:
             self._m_hedges.labels(win_name, "win").inc()
+            if tracer is not None:
+                tracer.record_event(rid, "hedge_win", replica=win_name)
+                # the loser may still be mid-decode (it abandons at its
+                # next chunk): write its span NOW, before the timeline
+                # closes with the response
+                record_lane(1 - w, "abandoned", time.perf_counter())
             lose = lanes[1 - w]
             if lose:
                 self._m_hedges.labels(lose, "lose").inc()
                 # the loser's dispatch gets its own disjoint outcome
                 # (it was neither ok nor an error — it was sacrificed)
                 self._m_routed.labels(lose, "hedge_lose").inc()
+                if tracer is not None:
+                    tracer.record_event(rid, "hedge_lose", replica=lose)
         return results[w]
+
+
+class _TracedStream:
+    """A streaming-response iterator that finishes its routing
+    timeline exactly once, on EVERY exit: exhaustion, error,
+    ``close()`` (client disconnect → the transport closes the SSE
+    source), or garbage collection of a never-started iterator. Holds
+    the recorder the timeline was OPENED in, so a mid-stream tracer
+    swap on the router cannot leak the timeline live."""
+
+    __slots__ = ("_tracer", "_rid", "_inner", "_finished")
+
+    def __init__(self, tracer, rid, inner):
+        self._tracer = tracer
+        self._rid = rid
+        self._inner = inner
+        self._finished = False
+
+    def _finish(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        self._tracer.finish_request(self._rid)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._inner)
+        except BaseException:
+            # StopIteration included: the stream is over either way
+            self._finish()
+            raise
+
+    def close(self) -> None:
+        try:
+            self._inner.close()
+        finally:
+            self._finish()
+
+    def __del__(self):
+        try:
+            # close (not just finish): the inner envelope's finally
+            # must record its abandoned-attempt span BEFORE the
+            # timeline closes, or a GC'd stream loses its last span
+            self.close()
+        except BaseException:
+            pass  # interpreter teardown: never raise from __del__
 
 
 class _RouterModel:
@@ -1544,7 +2126,7 @@ class _RouterModel:
 
 
 def make_router_app(router: FleetRouter, *, name: str = "fleet-router",
-                    **kwargs):
+                    federate: bool = True, **kwargs):
     """The fleet router behind the standard serving surface.
 
     Returns a :class:`~unionml_tpu.serving.http.ServingApp` subclass
@@ -1556,6 +2138,27 @@ def make_router_app(router: FleetRouter, *, name: str = "fleet-router",
     echo, ``X-Deadline-Ms`` scope, ``/metrics``, ``/debug/flight``,
     ``/debug/trace`` included. ``health``/``stats``/``drain`` default
     to the router's own (override via kwargs like any ServingApp).
+
+    The router app is also the fleet's ONE observability plane
+    (docs/observability.md "Fleet observability"):
+
+    - ``GET /metrics`` federates every replica's exposition under a
+      ``replica`` label next to the router's own series (one scrape
+      target for the fleet; ``federate=False`` restores the local-only
+      body). A failed replica scrape degrades to its last-seen-or-
+      absent series — never an error.
+    - ``GET /debug/trace?rid=<X-Request-ID>`` answers with ONE
+      stitched end-to-end timeline: the router's pick/attempt/backoff/
+      hedge spans plus the involved replicas' server-side spans,
+      correctly parented across the hop (in-process replicas merge
+      through the shared recorder; HTTP replicas are fetched).
+    - ``GET /debug/flight`` merges replica flight rings time-ordered
+      under a ``replica`` tag; ``GET /debug/fleet`` is the operator
+      dashboard (per-replica health/breaker/drain, queue depth, cache
+      blocks, burn, usage headroom, last scale decision).
+    - ``GET /debug/slo`` / ``GET /debug/usage`` answer with
+      fleet-aggregated views (router-side watchdog/ledger + merged
+      per-replica reports).
 
     Subclassing (not transport changes) keeps the transports' single
     dispatch seam: everything the handlers know about routing an app
@@ -1573,11 +2176,397 @@ def make_router_app(router: FleetRouter, *, name: str = "fleet-router",
             # the fleet-wide peek: a router app answers /debug/cache/
             # peek with the max over its replicas, so routers compose
             kw.setdefault("cache_peek", router.cached_prefix_len)
+            # the app's telemetry sinks FOLLOW the router's: a router
+            # built with an isolated tracer/flight/registry must not
+            # silently serve /debug/trace?rid=, the fleet flight
+            # merge, or /metrics from the process-global sinks its
+            # routing timelines never land in
+            kw.setdefault("registry", router._registry)
+            kw.setdefault("flight", router._flight)
+            if router.tracer is not None:
+                kw.setdefault("tracer", router.tracer)
             super().__init__(_RouterModel(name), **kw)
             self.router = router
+            self.federate = bool(federate)
+            self._m_federation_failures = self.registry.counter(
+                "unionml_router_federation_failures_total",
+                "Replica observability fetches (metrics scrape, "
+                "flight/trace pulls) that yielded NO data and degraded "
+                "to absent series, by replica and surface (an "
+                "HttpReplica serving its last-seen metrics body does "
+                "not count — stale beats absent beats error; slo/usage "
+                "pulls are uncounted, None legitimately means 'not "
+                "wired' there).",
+                ("replica", "surface"),
+            )
 
         def setup_model(self):  # the fleet needs no artifact load
             return None
+
+        # -- fleet observability plane --------------------------------
+
+        # overall fan-out budget per fleet surface: slightly above one
+        # HttpReplica obs_timeout_s, because fetches run CONCURRENTLY —
+        # N wedged replicas must cost max(one timeout), never the sum
+        # (a Prometheus scrape_timeout is ~10 s; a sequential walk of
+        # three dead replicas would blow it and blind the operator to
+        # the healthy fleet)
+        FANOUT_TIMEOUT_S = 6.0
+
+        def _fanout(self, items, fn) -> Dict[str, object]:
+            """Fetch ``fn(handle)`` for every ``(name, handle)``: only
+            ``remote`` handles (network fetches) go onto threads,
+            concurrently under ONE overall deadline — in-process
+            handles are lock-free local reads that must not pay a
+            thread spawn per scrape. A replica that raises, or fails
+            to answer inside the budget, maps to ``None`` (its daemon
+            thread is abandoned, never joined past the deadline)."""
+            if not items:
+                return {}
+            results: Dict[str, object] = {}
+            threads = []
+            for name, handle in items:
+                if not getattr(handle, "remote", False):
+                    try:
+                        results[name] = fn(handle)
+                    except BaseException:
+                        results[name] = None
+                    continue
+
+                def run(name=name, handle=handle):
+                    try:
+                        results[name] = fn(handle)
+                    except BaseException:
+                        results[name] = None
+
+                t = threading.Thread(target=run, daemon=True)
+                t.start()
+                threads.append(t)
+            deadline = time.monotonic() + self.FANOUT_TIMEOUT_S
+            for t in threads:
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+            return {name: results.get(name) for name, _ in items}
+
+        def metrics_text(self) -> str:
+            """The federated ``GET /metrics`` body: the router's own
+            registry plus every replica's exposition under a
+            ``replica`` label (bounded by fleet membership). Replicas
+            sharing THIS app's registry are skipped — their series are
+            already in the local body under their own instance
+            labels."""
+            local = super().metrics_text()
+            if not self.federate:
+                return local
+            items = []
+            for rep_name, handle in self.router.members().items():
+                if (
+                    type(handle).metrics_text
+                    is ReplicaHandle.metrics_text
+                ):
+                    # the handle never wired a metrics source ("None =
+                    # nothing to federate"): absent by design, not a
+                    # failure — counting it would climb the failure
+                    # counter forever with nothing failing
+                    continue
+                try:
+                    reg = handle.metrics_registry()
+                except BaseException:
+                    reg = None
+                if reg is not None and reg is self.registry:
+                    continue  # already in the local exposition
+                items.append((rep_name, handle))
+            texts: Dict[str, str] = {}
+            for rep_name, body in self._fanout(
+                items, lambda h: h.metrics_text(),
+            ).items():
+                if body is None:
+                    self._m_federation_failures.labels(
+                        rep_name, "metrics",
+                    ).inc()
+                elif body:
+                    texts[rep_name] = body
+            if not texts:
+                return local
+            return telemetry.merge_expositions(local, texts)
+
+        def debug_flight(self, n=None, kind=None, rid=None, tenant=None):
+            """The fleet ``GET /debug/flight``: the router's own ring
+            (route/retry/eject/scale_* events) merged with every
+            replica's ring under a ``replica`` tag, time-ordered on
+            WALL-ANCHORED ``t_ms`` (epoch milliseconds): each host's
+            raw monotonic readings are rebased by its own
+            ``wall_offset_ms`` anchor, because monotonic epochs are
+            per-boot and a long-lived replica host would otherwise
+            sort after everything the router recorded — and a
+            ``?n=`` cut would then drop exactly the router's own
+            events. Cross-host order is NTP-accurate; within one host
+            it stays monotonic-exact. Replicas sharing this app's
+            recorder are skipped (already merged). The merged
+            response reports ``wall_offset_ms: 0`` — its events are
+            pre-anchored."""
+            local = super().debug_flight(n=None, kind=kind, rid=rid,
+                                         tenant=tenant)
+            # local + in-process rings share THIS host's clock: one
+            # anchor rebases them all (copies — the ring's own dicts
+            # must never be mutated)
+            local_off = telemetry.wall_clock_offset_ms()
+            events = [
+                {**e, "t_ms": round(e.get("t_ms", 0.0) + local_off, 3)}
+                for e in local["events"]
+            ]
+            replicas_merged = []
+            items = []
+            for rep_name, handle in self.router.members().items():
+                try:
+                    ring = handle.flight_recorder()
+                except BaseException:
+                    ring = None
+                if ring is not None and ring is self._flight:
+                    continue  # same ring: already in the local dump
+                items.append((rep_name, handle))
+            # ?n= may thin the FETCH only when no filter is active:
+            # with a kind/rid/tenant filter, a per-replica newest-n cut
+            # would run BEFORE the filter and silently drop matching
+            # events that n newer non-matching ones displaced — filter
+            # first, truncate the merged stream last, exactly like
+            # FlightRecorder.dump
+            fetch_n = n if (kind is None and rid is None
+                            and tenant is None) else None
+            handles = dict(items)
+            for rep_name, fetched in self._fanout(
+                items, lambda h: h.flight_events(n=fetch_n),
+            ).items():
+                if fetched is None:
+                    self._m_federation_failures.labels(
+                        rep_name, "flight",
+                    ).inc()
+                    continue
+                if not fetched:
+                    continue
+                replicas_merged.append(rep_name)
+                anchored = getattr(handles[rep_name], "remote", False)
+                for event in fetched:
+                    if not isinstance(event, dict):
+                        continue
+                    tagged = dict(event)
+                    if not anchored:
+                        # in-process ring: same host, local anchor
+                        # (remote events arrive pre-anchored by
+                        # HttpReplica.flight_events)
+                        tagged["t_ms"] = round(
+                            tagged.get("t_ms", 0.0) + local_off, 3,
+                        )
+                    tagged.setdefault("replica", rep_name)
+                    if kind is not None and tagged.get("kind") != kind:
+                        continue
+                    if rid is not None and not (
+                        tagged.get("rid") == rid
+                        or rid in tagged.get("rids", ())
+                    ):
+                        continue
+                    if tenant is not None and (
+                        tagged.get("tenant") != tenant
+                    ):
+                        continue
+                    events.append(tagged)
+            events.sort(key=lambda e: e.get("t_ms", 0.0))
+            if n is not None:
+                n_int = int(n)
+                events = events[-n_int:] if n_int > 0 else []
+            return {**local, "wall_offset_ms": 0.0, "events": events,
+                    "merged_replicas": sorted(replicas_merged)}
+
+        def debug_trace(self, format: str = "chrome", rid=None,
+                        trace=None):
+            """``GET /debug/trace`` on the front door. Without
+            ``rid``/``trace``: the local recorder export, unchanged.
+            With them: ONE stitched end-to-end timeline for that
+            request — the base stitching over this app's recorder
+            (transport + router + any shared-recorder engine spans)
+            plus the involved replicas' spans fetched through their
+            handles (HTTP replicas answer their own
+            ``/debug/trace?trace=``), deduplicated by span id and
+            sorted on the wall-anchored axis."""
+            if rid is None and trace is None:
+                return super().debug_trace(format)
+            doc, content_type = super().debug_trace(
+                format, rid=rid, trace=trace,
+            )
+            trace_id = doc.get("trace_id")
+            if not trace_id:
+                return doc, content_type
+            seen = {s.get("span_id") for s in doc["spans"]}
+            items = []
+            for rep_name, handle in self.router.members().items():
+                try:
+                    recorder = handle.trace_recorder()
+                except BaseException:
+                    recorder = None
+                if recorder is not None and recorder is self._tracer:
+                    continue  # shared recorder: already stitched
+                items.append((rep_name, handle))
+            for rep_name, fetched in self._fanout(
+                items, lambda h: h.stitched_spans(trace_id),
+            ).items():
+                if fetched is None:
+                    self._m_federation_failures.labels(
+                        rep_name, "trace",
+                    ).inc()
+                    continue
+                spans, events = fetched
+                for span in spans:
+                    if not isinstance(span, dict):
+                        continue
+                    if span.get("span_id") in seen:
+                        continue
+                    seen.add(span.get("span_id"))
+                    tagged = dict(span)
+                    tagged.setdefault("replica", rep_name)
+                    doc["spans"].append(tagged)
+                for event in events:
+                    if isinstance(event, dict):
+                        tagged = dict(event)
+                        tagged.setdefault("replica", rep_name)
+                        doc["events"].append(tagged)
+            doc["spans"].sort(key=lambda s: s.get("start_unix_ms", 0.0))
+            doc["events"].sort(key=lambda e: e.get("t_unix_ms", 0.0))
+            return doc, content_type
+
+        def debug_slo(self) -> dict:
+            """The fleet ``GET /debug/slo``: the router-side
+            watchdog's report (when the app was built with ``slo=``)
+            plus every replica's own evaluation, with the fleet-level
+            max fast/slow burn and the union of breached objectives on
+            top. 422 only when NOTHING anywhere runs a watchdog."""
+            router_report = (
+                self._slo.evaluate() if self._slo is not None else None
+            )
+            replicas: Dict[str, Optional[dict]] = dict(self._fanout(
+                list(self.router.members().items()),
+                lambda h: h.slo_report(),
+            ))
+            reports = [r for r in replicas.values() if r]
+            if router_report is not None:
+                reports.append(router_report)
+            if not reports:
+                raise ValueError(
+                    "no SLO watchdog anywhere in the fleet — build the "
+                    "router app with slo=SloWatchdog([...]) or the "
+                    "replicas with per-replica watchdogs"
+                )
+            burn = {"fast": 0.0, "slow": 0.0}
+            breached: List[str] = []
+            for report in reports:
+                for obj in report.get("objectives", ()):
+                    for window in ("fast", "slow"):
+                        rate = (
+                            obj.get("windows", {})
+                            .get(window, {})
+                            .get("burn_rate", 0.0)
+                        )
+                        burn[window] = max(burn[window], float(rate))
+                breached.extend(report.get("breached", ()))
+            return {
+                "fleet": {
+                    "burn": burn,
+                    "breached": sorted(set(breached)),
+                },
+                "router": router_report,
+                "replicas": replicas,
+            }
+
+        def debug_usage(self) -> dict:
+            """The fleet ``GET /debug/usage``: per-replica ledger
+            reports plus merged per-tenant vectors summed across the
+            fleet (numeric fields add; distinct ledgers only — N
+            replicas sharing ONE ledger merge once). 422 only when no
+            ledger exists anywhere."""
+            router_report = (
+                self._usage.report() if self._usage is not None else None
+            )
+            replicas: Dict[str, Optional[dict]] = {}
+            seen_ledgers = {id(self._usage)} if (
+                self._usage is not None
+            ) else set()
+            merge_from: List[dict] = []
+            if router_report is not None:
+                merge_from.append(router_report)
+            # in-process ledger-identity dedup happens BEFORE the
+            # fan-out: N replicas sharing one ledger fetch it once
+            items = []
+            for rep_name, handle in self.router.members().items():
+                try:
+                    ledger = handle.usage_ledger()
+                except BaseException:
+                    ledger = None
+                if ledger is not None:
+                    if id(ledger) in seen_ledgers:
+                        replicas[rep_name] = {"shared_ledger": True}
+                        continue
+                    seen_ledgers.add(id(ledger))
+                items.append((rep_name, handle))
+            fetched = self._fanout(items, lambda h: h.usage_report())
+            for rep_name, _ in items:
+                report = fetched.get(rep_name)
+                replicas[rep_name] = report
+                if report:
+                    merge_from.append(report)
+            if not merge_from:
+                raise ValueError(
+                    "no usage ledger anywhere in the fleet — build the "
+                    "replicas with DecodeEngine(usage=True) or the "
+                    "router app with usage=UsageLedger()"
+                )
+            tenants: Dict[str, dict] = {}
+            totals = {"device_seconds": 0.0, "flops": 0.0, "tokens": 0}
+            cap_steps = used_weighted = 0.0
+            savings = 0
+            for report in merge_from:
+                for tenant_name, vector in report.get(
+                    "tenants", {}
+                ).items():
+                    acc = tenants.setdefault(tenant_name, {})
+                    for field, value in vector.items():
+                        if isinstance(value, (int, float)):
+                            acc[field] = acc.get(field, 0) + value
+                for field in totals:
+                    totals[field] += report.get("totals", {}).get(
+                        field, 0
+                    )
+                savings += report.get("cache_savings_tokens", 0)
+                capacity = report.get("capacity", {})
+                steps = float(capacity.get("slot_steps", 0.0))
+                cap_steps += steps
+                used_weighted += steps * sum(
+                    capacity.get("per_tenant", {}).values()
+                )
+            headroom = (
+                max(0.0, 1.0 - used_weighted / cap_steps)
+                if cap_steps > 0 else 1.0
+            )
+            return {
+                "fleet": {
+                    "tenants": tenants,
+                    "totals": totals,
+                    "cache_savings_tokens": savings,
+                    "capacity": {
+                        "slot_steps": cap_steps,
+                        "headroom": round(headroom, 4),
+                    },
+                    "merged_reports": len(merge_from),
+                },
+                "router": router_report,
+                "replicas": replicas,
+            }
+
+        def debug_fleet(self) -> dict:
+            """``GET /debug/fleet``: the operator dashboard —
+            :meth:`FleetRouter.fleet_report` (per-replica health/
+            breaker/drain state, queue depth, cache blocks, burn,
+            retry budget) plus the operating autoscaler's view (usage
+            headroom, burn windows, last scale decision + reason) when
+            one is attached."""
+            return self.router.fleet_report()
 
         def predict(self, payload: dict):
             if self._draining:

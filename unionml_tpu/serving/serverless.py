@@ -195,10 +195,11 @@ def gateway_handler(
                     content_type=telemetry.EXPOSITION_CONTENT_TYPE,
                 )
             if method == "GET" and path == "/debug/trace":
-                fmt = (event.get("queryStringParameters") or {}).get(
-                    "format", "chrome"
+                qs = event.get("queryStringParameters") or {}
+                body_out, content_type = app.debug_trace(
+                    qs.get("format", "chrome"),
+                    rid=qs.get("rid"), trace=qs.get("trace"),
                 )
-                body_out, content_type = app.debug_trace(fmt)
                 if not isinstance(body_out, str):
                     body_out = json.dumps(body_out)
                 return respond(200, body_out, content_type=content_type)
@@ -211,7 +212,11 @@ def gateway_handler(
                 deadline_ms = parse_deadline_header(
                     headers.get("x-deadline-ms")
                 )
-                with app.traced_request("/predict", raw_traceparent) as ctx:
+                # keyed by the response X-Request-ID, so
+                # /debug/trace?rid= resolves the id the client holds
+                with app.traced_request(
+                    "/predict", raw_traceparent, rid=rid,
+                ) as ctx:
                     trace_ctx = ctx
                     with tenant_scope(tenant):
                         with priority_scope(priority):

@@ -82,6 +82,7 @@ __all__ = [
     "get_registry",
     "get_tracer",
     "instance_label",
+    "merge_expositions",
     "new_request_id",
     "new_span_id",
     "new_trace_id",
@@ -90,7 +91,9 @@ __all__ = [
     "percentile_summary",
     "publish_process_metrics",
     "server_trace_context",
+    "stitched_trace",
     "trace_scope",
+    "wall_clock_offset_ms",
 ]
 
 
@@ -571,6 +574,151 @@ EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 # --------------------------------------------------------------------- #
+# metrics federation: exposition parse + merge
+# --------------------------------------------------------------------- #
+
+# one exposition sample line: `name{labels} value [timestamp]` or
+# `name value` (the subset both our exposition and Prometheus clients
+# emit; unparseable lines are dropped rather than corrupting the merge)
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(.+)$"
+)
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) ?(.*)$")
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (\w+)$")
+_EXPOSITION_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _parse_exposition(text: str) -> "List[dict]":
+    """Ordered families ``{name, help, type, samples: [(name, labels,
+    value)]}`` from one exposition body. Samples are grouped under the
+    nearest preceding ``# TYPE``/``# HELP`` family when their name
+    matches it (histogram ``_bucket``/``_sum``/``_count`` suffixes
+    included); headerless samples open an implicit family."""
+    families: List[dict] = []
+    by_name: Dict[str, dict] = {}
+
+    def family(name: str) -> dict:
+        fam = by_name.get(name)
+        if fam is None:
+            fam = {"name": name, "help": None, "type": None, "samples": []}
+            by_name[name] = fam
+            families.append(fam)
+        return fam
+
+    current: Optional[dict] = None
+    for line in text.splitlines():
+        line = line.rstrip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = _HELP_RE.match(line)
+            if m is not None:
+                current = family(m.group(1))
+                if current["help"] is None:
+                    current["help"] = m.group(2)
+                continue
+            m = _TYPE_RE.match(line)
+            if m is not None:
+                current = family(m.group(1))
+                if current["type"] is None:
+                    current["type"] = m.group(2)
+                continue
+            continue  # other comments dropped
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue  # unparseable line: drop, never corrupt the merge
+        name, labels, value = m.groups()
+        owner = None
+        if current is not None:
+            base = current["name"]
+            if name == base or (
+                name.startswith(base)
+                and name[len(base):] in _EXPOSITION_SUFFIXES
+            ):
+                owner = current
+        if owner is None:
+            owner = family(name)
+        owner["samples"].append((name, labels or "", value))
+    return families
+
+
+def _label_sample(
+    sample: "Tuple[str, str, str]", label: str, value: str
+) -> str:
+    """One sample line with ``label="value"`` injected as the first
+    label — unless the sample already carries ``label`` (a federated
+    replica that is itself a router keeps its own, more specific,
+    replica names)."""
+    name, labels, val = sample
+    pair = f'{label}="{_escape_label_value(value)}"'
+    if labels:
+        inner = labels[1:-1]
+        if re.search(rf'(^|,){label}="', inner):
+            return f"{name}{labels} {val}"
+        return f"{name}{{{pair},{inner}}} {val}"
+    return f"{name}{{{pair}}} {val}"
+
+
+def merge_expositions(
+    local: str,
+    replicas: Dict[str, str],
+    label: str = "replica",
+) -> str:
+    """One fleet-wide Prometheus exposition: ``local`` (the router's
+    own registry, untouched) merged with each replica's exposition
+    under an injected ``replica="<name>"`` label — the federation body
+    the router app serves at ``GET /metrics`` so an operator scrapes
+    ONE target for the whole fleet (docs/observability.md "Fleet
+    observability").
+
+    Families shared across sources render once (``# HELP``/``# TYPE``
+    from the first source that declared them — the text format
+    requires a family's samples grouped under one header); the
+    ``replica`` label's value set is the router's membership, so its
+    cardinality is bounded by the fleet size, never by traffic.
+    Replica bodies that fail to parse contribute nothing — a corrupt
+    scrape degrades to absent series, never to a broken exposition."""
+    merged = _parse_exposition(local)
+    by_name = {fam["name"]: fam for fam in merged}
+    for replica_name in sorted(replicas):
+        text = replicas[replica_name]
+        if not text:
+            continue
+        for fam in _parse_exposition(text):
+            target = by_name.get(fam["name"])
+            if target is None:
+                target = {
+                    "name": fam["name"], "help": fam["help"],
+                    "type": fam["type"], "samples": [],
+                }
+                by_name[fam["name"]] = target
+                merged.append(target)
+            elif target["help"] is None:
+                target["help"] = fam["help"]
+            if target["type"] is None:
+                target["type"] = fam["type"]
+            target["samples"].extend(
+                (None, None, _label_sample(s, label, replica_name))
+                for s in fam["samples"]
+            )
+    lines: List[str] = []
+    for fam in sorted(merged, key=lambda f: f["name"]):
+        if not fam["samples"]:
+            continue
+        if fam["help"] is not None:
+            lines.append(f"# HELP {fam['name']} {fam['help']}")
+        if fam["type"] is not None:
+            lines.append(f"# TYPE {fam['name']} {fam['type']}")
+        for sample in fam["samples"]:
+            if sample[0] is None:
+                lines.append(sample[2])  # pre-rendered replica line
+            else:
+                name, labels, value = sample
+                lines.append(f"{name}{labels} {value}")
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+# --------------------------------------------------------------------- #
 # W3C trace context (https://www.w3.org/TR/trace-context/)
 # --------------------------------------------------------------------- #
 
@@ -709,6 +857,7 @@ class TraceRecorder:
     """
 
     MAX_SPANS_PER_REQUEST = 4096
+    MAX_EVENTS_PER_REQUEST = 512
 
     def __init__(
         self,
@@ -758,16 +907,22 @@ class TraceRecorder:
         self,
         kind: str = "request",
         trace_ctx: Optional[TraceContext] = None,
+        rid: Optional[str] = None,
         **meta: Any,
     ) -> str:
         """Open a request timeline. ``trace_ctx`` (explicit, or the
         ambient :func:`trace_scope` one on this thread) is the PARENT
         context: the timeline joins its trace and its root span parents
         to ``trace_ctx.span_id``; with neither, a fresh root trace is
-        minted."""
-        rid = new_request_id()
+        minted. ``rid`` keys the timeline under a caller-chosen request
+        id (the transports pass their ``X-Request-ID`` so
+        ``/debug/trace?rid=`` answers with the id the client actually
+        holds); a colliding or absent ``rid`` falls back to a generated
+        one — the RETURNED id is authoritative."""
         parent = trace_ctx if trace_ctx is not None else current_trace_context()
         with self._lock:
+            if rid is None or rid in self._live or rid in self._tids:
+                rid = new_request_id()
             self._live[rid] = []
             self._meta[rid] = {
                 "kind": kind,
@@ -807,19 +962,30 @@ class TraceRecorder:
         name: str,
         start_s: float,
         end_s: float,
+        span_id: Optional[str] = None,
+        parent_span_id: Optional[str] = None,
         **args: Any,
     ) -> None:
         """Attach one completed span (``time.perf_counter()`` seconds).
         Unknown/finished rids are ignored — a late harvest for an
         already-exported request must not KeyError the engine. A live
         request past the span cap drops the span, counts it, and flags
-        the request ``truncated``."""
+        the request ``truncated``.
+
+        ``span_id`` lets a caller PRE-MINT the id (the fleet router
+        mints each dispatch attempt's span id before dispatching, so
+        the attempt's child context can propagate to the replica while
+        the span is still open); ``parent_span_id`` overrides the
+        default parent (the request's root span) for nested span
+        trees."""
         span = {
             "name": name,
             "start_s": float(start_s),
             "end_s": float(end_s),
-            "span_id": new_span_id(),
+            "span_id": span_id if span_id is not None else new_span_id(),
         }
+        if parent_span_id is not None:
+            span["parent_span_id"] = parent_span_id
         if args:
             span["args"] = args
         with self._lock:
@@ -840,6 +1006,68 @@ class TraceRecorder:
     def span(self, rid: str, name: str, **args: Any):
         """Context manager measuring one span around its body."""
         return _SpanContext(self, rid, name, args)
+
+    def record_event(
+        self, rid: str, name: str, t_s: Optional[float] = None, **args: Any
+    ) -> None:
+        """Attach one INSTANT event to a live request timeline (the
+        OTLP span-event mapping: exported as events on the request's
+        synthesized root span, as ``ph: "i"`` instants in the Chrome
+        export, and as ``"event": true`` lines in jsonl). The fleet
+        router's lifecycle (eject/probe/rejoin) and the autoscaler's
+        scale decisions ride the fleet timeline this way, so a latency
+        spike is explainable from the trace alone. Unknown rids are
+        ignored; a request past the event cap drops the event, counts
+        it, and flags the request ``truncated``."""
+        event = {
+            "name": name,
+            "t_s": float(t_s) if t_s is not None else time.perf_counter(),
+        }
+        if args:
+            event["args"] = args
+        with self._lock:
+            meta = self._meta.get(rid)
+            if meta is None or rid not in self._live:
+                return
+            events = meta.setdefault("events", [])
+            if len(events) >= self.MAX_EVENTS_PER_REQUEST:
+                meta["truncated"] = True
+                dropped = True
+            else:
+                events.append(event)
+                dropped = False
+        if dropped:
+            self._count_dropped()
+
+    def find_trace_id(self, rid: str) -> Optional[str]:
+        """The W3C trace id of a locally-known request id (live or
+        completed) — how ``/debug/trace?rid=`` resolves the id a
+        client holds into the trace to stitch. ``None`` when
+        unknown."""
+        with self._lock:
+            meta = self._meta.get(rid)
+            if meta is None:
+                for done_rid, done_meta, _ in reversed(self._done):
+                    if done_rid == rid:
+                        meta = done_meta
+                        break
+            if meta is None:
+                return None
+            return meta.get("trace_id")
+
+    def requests_for_trace(
+        self, trace_id: str
+    ) -> List[Tuple[str, dict, List[dict]]]:
+        """Every retained request (completed AND live) whose timeline
+        belongs to ``trace_id`` — the local half of cross-hop trace
+        stitching: one transport hop's server timeline, the router's
+        routing timeline, and any in-process engine timelines of the
+        same trace come back together."""
+        return [
+            (rid, meta, spans)
+            for rid, meta, spans in self._all_requests()
+            if meta.get("trace_id") == trace_id
+        ]
 
     def finish_request(self, rid: str) -> None:
         with self._lock:
@@ -892,6 +1120,17 @@ class TraceRecorder:
                     "args": {"request_id": rid, **span.get("args", {})},
                 }
                 events.append(event)
+            for instant in meta.get("events", ()):
+                events.append({
+                    "name": instant["name"],
+                    "cat": meta.get("kind", "request"),
+                    "ph": "i",
+                    "s": "t",
+                    "ts": round(instant["t_s"] * 1e6, 3),
+                    "pid": 0,
+                    "tid": tid,
+                    "args": {"request_id": rid, **instant.get("args", {})},
+                })
             events.append({
                 "name": "thread_name",
                 "ph": "M",
@@ -929,7 +1168,9 @@ class TraceRecorder:
                 if "trace_id" in meta:
                     record["trace_id"] = meta["trace_id"]
                     record["span_id"] = span.get("span_id")
-                    record["parent_span_id"] = meta["span_id"]
+                    record["parent_span_id"] = (
+                        span.get("parent_span_id") or meta["span_id"]
+                    )
                     if meta.get("parent_span_id"):
                         record["request_parent_span_id"] = (
                             meta["parent_span_id"]
@@ -937,6 +1178,19 @@ class TraceRecorder:
                 if meta.get("truncated"):
                     record["truncated"] = True
                 record.update(span.get("args", {}))
+                lines.append(json.dumps(record))
+            for instant in meta.get("events", ()):
+                record = {
+                    "request_id": rid,
+                    "kind": meta.get("kind", "request"),
+                    "event": True,
+                    "name": instant["name"],
+                    "t_ms": round(instant["t_s"] * 1e3, 3),
+                }
+                if "trace_id" in meta:
+                    record["trace_id"] = meta["trace_id"]
+                    record["span_id"] = meta["span_id"]
+                record.update(instant.get("args", {}))
                 lines.append(json.dumps(record))
         return "\n".join(lines) + "\n" if lines else ""
 
@@ -964,6 +1218,108 @@ class _SpanContext:
         self._recorder.record_span(
             self._rid, self._name, self._t0, time.perf_counter(), **self._args
         )
+
+
+def stitched_trace(
+    trace_id: Optional[str],
+    requests: Sequence[Tuple[str, dict, List[dict]]],
+) -> dict:
+    """Flatten recorder requests of ONE trace into the stitched
+    end-to-end timeline document ``GET /debug/trace?rid=`` serves:
+
+    ``{"trace_id", "request_ids", "spans": [...], "events": [...]}``
+
+    Each request contributes a synthesized root span (named by its
+    kind, spanning its children — the same root the OTLP exporter
+    ships, so the JSON view and the collector agree) plus its recorded
+    spans, every span carrying real W3C ``span_id``/``parent_span_id``
+    links: the parent chain caller → transport → router attempt →
+    replica server span is reconstructible from one document.
+
+    Timestamps are ``start_unix_ms`` — the monotonic readings anchored
+    to THIS process's wall clock at export time — so spans fetched
+    from different replicas sort into one timeline at NTP accuracy
+    (within one process, offsets keep monotonic-clock exactness).
+    """
+    # wall anchor (lint: wall clock is fine here — this converts to an
+    # epoch timestamp for cross-process alignment, not a duration)
+    wall_offset_s = time.time() - time.perf_counter()
+
+    def unix_ms(perf_s: float) -> float:
+        return round((perf_s + wall_offset_s) * 1e3, 3)
+
+    spans: List[dict] = []
+    events: List[dict] = []
+    request_ids: List[str] = []
+    for rid, meta, req_spans in requests:
+        request_ids.append(rid)
+        root_id = meta.get("span_id") or new_span_id()
+        start_s = meta.get("start_s")
+        end_s = meta.get("end_s")
+        if req_spans:
+            bounds = [s["start_s"] for s in req_spans]
+            start_s = min(bounds + ([start_s] if start_s is not None else []))
+            ends = [s["end_s"] for s in req_spans]
+            end_s = max(ends + ([end_s] if end_s is not None else []))
+        if start_s is None:
+            continue  # nothing measurable yet (empty live request)
+        if end_s is None:
+            end_s = start_s  # live request: zero-length root so far
+        root: dict = {
+            "request_id": rid,
+            "kind": meta.get("kind", "request"),
+            "name": str(meta.get("kind", "request")),
+            "span_id": root_id,
+            "parent_span_id": meta.get("parent_span_id"),
+            "root": True,
+            "start_unix_ms": unix_ms(start_s),
+            "duration_ms": round((end_s - start_s) * 1e3, 3),
+        }
+        if meta.get("truncated"):
+            root["truncated"] = True
+        spans.append(root)
+        for span in req_spans:
+            spans.append({
+                "request_id": rid,
+                "kind": meta.get("kind", "request"),
+                "name": span["name"],
+                "span_id": span.get("span_id"),
+                "parent_span_id": span.get("parent_span_id") or root_id,
+                "start_unix_ms": unix_ms(span["start_s"]),
+                "duration_ms": round(
+                    (span["end_s"] - span["start_s"]) * 1e3, 3
+                ),
+                **span.get("args", {}),
+            })
+        for instant in meta.get("events", ()):
+            events.append({
+                "request_id": rid,
+                "name": instant["name"],
+                "span_id": root_id,
+                "t_unix_ms": unix_ms(instant["t_s"]),
+                **instant.get("args", {}),
+            })
+    spans.sort(key=lambda s: s["start_unix_ms"])
+    events.sort(key=lambda e: e["t_unix_ms"])
+    return {
+        "trace_id": trace_id,
+        "request_ids": request_ids,
+        "spans": spans,
+        "events": events,
+    }
+
+
+def wall_clock_offset_ms() -> float:
+    """Milliseconds to ADD to a monotonic-clock ``t_ms`` reading to
+    get epoch milliseconds — the per-host anchor the fleet flight
+    merge rebases replica rings with: each host's monotonic epoch is
+    its boot time, so raw ``t_ms`` values are incomparable across
+    machines (a host up 30 days sorts after a fresh one regardless of
+    real time). Wall-anchored times compare at NTP accuracy; within
+    one host, offsets between events stay monotonic-exact. (Lint: the
+    wall clock is fine here — this is epoch anchoring, not a
+    duration.)"""
+    return (time.time() - time.monotonic()) * 1e3
 
 
 # --------------------------------------------------------------------- #
