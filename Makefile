@@ -45,6 +45,7 @@ bench-all: bench
 	UNIONML_TPU_BENCH_PRESET=serve_usage python benchmarks/serve_latency.py
 	UNIONML_TPU_BENCH_PRESET=serve_preempt python benchmarks/serve_latency.py
 	UNIONML_TPU_BENCH_PRESET=serve_router python benchmarks/serve_latency.py
+	UNIONML_TPU_BENCH_PRESET=serve_disagg python benchmarks/serve_latency.py
 	UNIONML_TPU_BENCH_PRESET=serve_autoscale python benchmarks/serve_latency.py
 	UNIONML_TPU_BENCH_PRESET=serve_fleet_obs python benchmarks/serve_latency.py
 	python benchmarks/serve_http.py

@@ -316,6 +316,11 @@ TRACE_SPAN_NAMES = (
     # fleet router decision machinery (docs/observability.md
     # "Fleet observability")
     "pick", "attempt", "backoff", "hedge-lane",
+    # disaggregated two-leg dispatch (docs/serving.md "Disaggregated
+    # serving"): the prefill leg, the KV handoff between pools, and
+    # the decode leg — all under one routing rid, joining the engine
+    # prefill/prefix-splice families each leg records on its replica
+    "prefill-leg", "handoff", "decode-leg",
 )
 # indexed span families (f-strings with a bounded constant prefix) and
 # the transport server span (f"http {path}" — path is route-bounded)

@@ -560,15 +560,22 @@ def test_hedge_spends_no_budget_without_second_replica():
     assert not [e for e in router._flight.dump(kind="hedge")]
 
 
-def test_http_replica_refuses_unforwardable_token_cap():
-    """max_new_tokens cannot cross the /predict hop (no payload field)
-    — HttpReplica must refuse loudly, not silently decode to the
-    remote default (which would break failover token parity)."""
+def test_http_replica_forwards_token_cap_in_payload():
+    """max_new_tokens crosses the /predict hop as a payload field (the
+    old loud refusal was a stopgap — disaggregated two-leg dispatch
+    needs the cap to survive the hop for token parity): explicit
+    argument first, else the ambient token_cap_scope, else absent."""
+    from unionml_tpu.serving.scheduler import token_cap_scope
+
     replica = HttpReplica("http://example.invalid:1", name="remote")
-    with pytest.raises(ValueError, match="max_new_tokens"):
-        replica.generate([1, 2, 3], max_new_tokens=8)
-    with pytest.raises(ValueError, match="max_new_tokens"):
-        replica.generate_stream([1, 2, 3], max_new_tokens=8)
+    assert replica._payload([1, 2, 3], 8) == {
+        "features": [[1, 2, 3]], "max_new_tokens": 8,
+    }
+    with token_cap_scope(5):
+        assert replica._payload([1, 2, 3], None)["max_new_tokens"] == 5
+        # explicit beats ambient
+        assert replica._payload([1, 2, 3], 8)["max_new_tokens"] == 8
+    assert "max_new_tokens" not in replica._payload([1, 2, 3], None)
 
 
 def test_router_app_multi_prompt_concurrent():

@@ -81,6 +81,18 @@ optional tail-latency hedging, passive outlier ejection with half-open
 rejoin, drain/join choreography), so a replica loss, hang, or drain is
 invisible to callers. :func:`~unionml_tpu.serving.router
 .make_router_app` mounts it on either transport.
+
+Disaggregated prefill/decode serving
+(:mod:`unionml_tpu.serving.disagg`, docs/serving.md "Disaggregated
+serving"): a :class:`~unionml_tpu.serving.disagg.DisaggRouter` splits
+the fleet into a prefill pool and a decode pool (DistServe/Splitwise
+lineage) — a routed request prefills on a prefill replica, its KV
+blocks cross to a decode replica through the prefix-cache block
+machinery (shared host store same-host, ``/debug/kv/export``/
+``/debug/kv/import`` cross-host), and the decode leg splices them and
+streams with tokens bit-identical to the colocated run. Long prompts
+stop stalling resident decode lanes; short prompts keep the colocated
+fast path.
 """
 
 from unionml_tpu.serving.autoscaler import (
@@ -91,6 +103,7 @@ from unionml_tpu.serving.autoscaler import (
     ReplicaProvisioner,
 )
 from unionml_tpu.serving.batcher import MicroBatcher
+from unionml_tpu.serving.disagg import DisaggRouter
 from unionml_tpu.serving.engine import DecodeEngine
 from unionml_tpu.serving.faults import (
     DeadlineExceeded,
@@ -111,12 +124,15 @@ from unionml_tpu.serving.router import (
     make_router_app,
 )
 from unionml_tpu.serving.scheduler import (
+    PHASES,
     PRIORITIES,
     PreemptiveScheduler,
     SchedulerConfig,
     WaitingRoom,
     current_priority,
     priority_scope,
+    token_cap_scope,
+    validate_phase,
     validate_priority,
 )
 from unionml_tpu.serving.usage import (
@@ -128,13 +144,15 @@ from unionml_tpu.serving.usage import (
 
 __all__ = [
     "AutoscalerPolicy", "DeadlineExceeded", "DecodeEngine",
-    "EngineReplica", "EngineReplicaProvisioner", "EngineUnavailable",
-    "FaultInjector", "FleetAutoscaler", "FleetRouter", "HttpReplica",
-    "HttpReplicaProvisioner", "KVBlockPool", "MicroBatcher",
-    "Overloaded", "PRIORITIES", "PoolExhausted", "PreemptiveScheduler",
-    "RadixPrefixCache", "ReplicaHandle", "ReplicaProvisioner",
-    "RouterPolicy", "SchedulerConfig", "ServingApp", "UsageLedger",
-    "WaitingRoom", "create_app", "current_priority", "current_tenant",
+    "DisaggRouter", "EngineReplica", "EngineReplicaProvisioner",
+    "EngineUnavailable", "FaultInjector", "FleetAutoscaler",
+    "FleetRouter", "HttpReplica", "HttpReplicaProvisioner",
+    "KVBlockPool", "MicroBatcher", "Overloaded", "PHASES", "PRIORITIES",
+    "PoolExhausted", "PreemptiveScheduler", "RadixPrefixCache",
+    "ReplicaHandle", "ReplicaProvisioner", "RouterPolicy",
+    "SchedulerConfig", "ServingApp", "UsageLedger", "WaitingRoom",
+    "create_app", "current_priority", "current_tenant",
     "deadline_scope", "make_router_app", "priority_scope",
-    "tenant_scope", "validate_priority", "validate_tenant",
+    "tenant_scope", "token_cap_scope", "validate_phase",
+    "validate_priority", "validate_tenant",
 ]

@@ -68,6 +68,7 @@ from unionml_tpu.serving.router import (
     HttpReplica,
     ReplicaHandle,
 )
+from unionml_tpu.serving.scheduler import validate_phase
 
 __all__ = [
     "AutoscalerPolicy",
@@ -97,6 +98,9 @@ DECISION_REASONS = (
     "recovery_in_flight",  # in wanted, a replica is ejected/half-open
     "drain_in_flight",    # a drain is running (fleet or replica)
     "min_live",           # in wanted, would breach the routable floor
+    "no_pool_victim",     # in wanted, but every drainable candidate is a
+    #                       shared colocated replica this POOL autoscaler
+    #                       observes without owning
     "provision_failed",   # provisioner raised; backoff retry scheduled
     "provision_backoff",  # out wanted, still inside the failure backoff
 )
@@ -317,10 +321,35 @@ class FleetAutoscaler:
         registry: Optional[telemetry.MetricsRegistry] = None,
         flight: Optional[telemetry.FlightRecorder] = None,
         clock: Callable[[], float] = time.monotonic,
+        phase: Optional[str] = None,
     ):
         self.router = router
         self.provisioner = provisioner
         self.policy = policy if policy is not None else AutoscalerPolicy()
+        # per-pool scaling (docs/serving.md "Disaggregated serving"):
+        # when set, this autoscaler observes the replicas of its phase
+        # PLUS shared colocated members (the phase-aware router routes
+        # either leg to those, so they are real pool capacity and
+        # their corpses must be reaped by somebody), but only ACTS —
+        # scale-in drains — on exact-phase members it owns. A
+        # phase-split fleet runs one autoscaler per pool, each with
+        # its own signal wiring (a TTFT-objective watchdog scales
+        # prefill; the decode pool's ledger headroom scales decode)
+        # and its own min/max band. Provisioned replicas are stamped
+        # with the phase so the router's phase-aware pick and the
+        # next evaluation both see them in the right pool.
+        # None (default) operates the whole fleet — the single-pool
+        # behavior, unchanged.
+        self.phase = None if phase is None else validate_phase(phase)
+        # pool-scoped names: two pool autoscalers (possibly sharing
+        # one policy object — custom name_prefix included) each start
+        # their counters at 0, so the phase must be IN the name or
+        # the second pool's first scale-out dies on the router's
+        # name-collision join check exactly when it needed capacity
+        self._name_prefix = (
+            f"{self.policy.name_prefix}-{self.phase}"
+            if self.phase is not None else self.policy.name_prefix
+        )
         self._slo = slo
         self._usage = usage
         self._clock = clock
@@ -349,8 +378,12 @@ class FleetAutoscaler:
         self._ticker: Optional[threading.Thread] = None
         self._ticker_stop = threading.Event()
         # the fleet dashboard (GET /debug/fleet on the router app)
-        # reads the operating autoscaler's view through this link
+        # reads the operating autoscaler's view through this link;
+        # phase-split fleets additionally register per pool, so the
+        # dashboard can show every pool's autoscaler side by side
         router.autoscaler = self
+        if isinstance(getattr(router, "autoscalers", None), dict):
+            router.autoscalers[self.phase or "fleet"] = self
         R = self._registry
         self._m_decisions = R.counter(
             "unionml_autoscaler_decisions_total",
@@ -430,11 +463,40 @@ class FleetAutoscaler:
                 now = self._clock()
             return self._evaluate_locked(now)
 
+    def _pool_signals(self, signals: Dict[str, dict]) -> Dict[str, dict]:
+        """Restrict a fleet signal sweep to this autoscaler's pool
+        (no-op for a fleet-wide autoscaler). COLOCATED replicas are
+        included — the phase-aware router routes either leg to them,
+        so they are real pool capacity (and their corpses must still
+        be reaped by SOMEBODY in a fleet running only pool
+        autoscalers); :meth:`_owned` narrows back to exact-phase
+        members wherever the autoscaler ACTS rather than observes.
+        Phase rides the signal dicts, so filtering costs no extra
+        probes."""
+        if self.phase is None:
+            return signals
+        return {
+            n: s for n, s in signals.items()
+            if s.get("phase", "colocated") in (self.phase, "colocated")
+        }
+
+    def _owned(self, signals: Dict[str, dict]) -> Dict[str, dict]:
+        """The members this autoscaler may DRAIN (scale-in victims):
+        exact-phase only — a shared colocated replica serves both
+        pools, and one pool's consolidation must not remove capacity
+        the other depends on."""
+        if self.phase is None:
+            return signals
+        return {
+            n: s for n, s in signals.items()
+            if s.get("phase", "colocated") == self.phase
+        }
+
     def _evaluate_locked(self, now: float) -> dict:
         p = self.policy
         if self._last_in_at is None:
             self._last_in_at = now
-        signals = self.router.replica_signals()
+        signals = self._pool_signals(self.router.replica_signals())
         signals = self._reap_dead(signals)
         routable = {
             n: s for n, s in signals.items()
@@ -542,8 +604,14 @@ class FleetAutoscaler:
                 return self._hold(now, "min_live", detail)
             if now - self._last_in_at < p.cooldown_in_s:
                 return self._hold(now, "cooldown_in", detail)
+            victims = self._owned(routable)
+            if not victims:
+                # every drainable candidate is shared colocated
+                # capacity this pool autoscaler observes but does not
+                # own — consolidating it would steal from the peer pool
+                return self._hold(now, "no_pool_victim", detail)
             reason = "surplus" if traffic else "idle"
-            return self._scale_in(now, reason, routable, detail)
+            return self._scale_in(now, reason, victims, detail)
 
         return self._hold(now, "steady", detail)
 
@@ -635,9 +703,14 @@ class FleetAutoscaler:
         routable: Dict[str, dict], detail: dict,
     ) -> dict:
         p = self.policy
-        name = f"{p.name_prefix}-{self._next_id}"
+        name = f"{self._name_prefix}-{self._next_id}"
         try:
             handle = self.provisioner.provision(name)
+            if self.phase is not None:
+                # the joiner belongs to this autoscaler's pool: the
+                # phase-aware pick and the next evaluation's filter
+                # both key on the handle's tag
+                handle.phase = self.phase
         except BaseException as exc:
             self._provision_failures += 1
             backoff = min(
@@ -796,10 +869,11 @@ class FleetAutoscaler:
             # never under the evaluation lock
             if signals is None:
                 signals = self.router.replica_signals()
+            pool = self._pool_signals(signals)
             replica_burn = max(
                 (
                     float(s["health"].get("burn", 0.0) or 0.0)
-                    for s in signals.values()
+                    for s in pool.values()
                 ),
                 default=0.0,
             )
@@ -814,6 +888,9 @@ class FleetAutoscaler:
                     headroom = max(0.0, 1.0 - d_used / d_cap)
                     traffic = True
             return {
+                # which pool this autoscaler operates (None = the
+                # whole fleet — the single-pool view, unchanged)
+                "phase": self.phase,
                 "burn": burn,
                 "burn_streak": self._burn_streak,
                 "headroom": round(headroom, 4),

@@ -113,7 +113,9 @@ from unionml_tpu.serving.scheduler import (
     PreemptiveScheduler,
     SchedulerConfig,
     current_priority,
+    current_token_cap,
     priority_rank,
+    validate_phase,
     validate_priority,
 )
 from unionml_tpu.serving.usage import (
@@ -280,6 +282,11 @@ class _Request:
     # resume: the next eviction appends only tokens[_prompt_incl:], or
     # a twice-preempted stream would duplicate its first segment
     _prompt_incl: int = 0
+    # disaggregated prefill (docs/serving.md "Disaggregated serving"):
+    # set by prefill_export and signalled once the request's KV blocks
+    # have landed in the host prefix-cache store (the insert entry's
+    # lease release — or any terminal path, so a waiter never hangs)
+    _kv_event: Optional[threading.Event] = None
 
     def emit(self, chunk: List[int]) -> None:
         if self.stream is not None and chunk:
@@ -511,6 +518,7 @@ class DecodeEngine:
         kv_pool_blocks: Optional[int] = None,
         kv_block_size: Optional[int] = None,
         scheduler: Optional[SchedulerConfig] = None,
+        phase: Optional[str] = None,
     ):
         import jax
 
@@ -520,6 +528,13 @@ class DecodeEngine:
             raise ValueError("need at least one slot")
         if not prompt_buckets:
             raise ValueError("need at least one prompt bucket")
+        # serving phase (docs/serving.md "Disaggregated serving"):
+        # which half of a generative request this engine's pool owns.
+        # The engine itself serves any request either way — the label
+        # rides health()/stats()/flight events so a phase-split
+        # fleet's telemetry is attributable per pool, and the
+        # phase-aware router picks by it.
+        self.phase = validate_phase(phase)
         self.draft = draft_module
         self.speculate_k = int(speculate_k)
         if self.draft is not None:
@@ -804,6 +819,7 @@ class DecodeEngine:
         self._sched = PreemptiveScheduler(
             sched_cfg, registry=self._registry,
             engine_label=self.instance, usage=self._usage,
+            phase=self.phase,
         )
         self._room = self._sched.room
         self._lock = threading.Lock()
@@ -1016,7 +1032,11 @@ class DecodeEngine:
         numpy scalars (slot indices from mask walks) become plain ints
         so a dumped event is always JSON-safe."""
         if self._flight is not None:
-            self._flight.record(kind, engine=self.instance, **{
+            # phase-split fleets tag every lifecycle event with the
+            # pool that recorded it (colocated engines stay untagged —
+            # the historical event shape is unchanged for them)
+            tag = {} if self.phase == "colocated" else {"phase": self.phase}
+            self._flight.record(kind, engine=self.instance, **tag, **{
                 k: (v.item() if isinstance(v, np.generic) else v)
                 for k, v in fields.items()
             })
@@ -1193,11 +1213,14 @@ class DecodeEngine:
             status = "degraded"
         else:
             status = "ok"
-        return {
+        out = {
             "status": status,
             "queue_depth": self._room.qsize(),
             "breaker_open": breaker,
         }
+        if self.phase != "colocated":
+            out["phase"] = self.phase
+        return out
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful drain: stop admitting (new submissions raise
@@ -1938,6 +1961,12 @@ class DecodeEngine:
             validate_priority(priority) if priority is not None
             else current_priority()
         )
+        if max_new_tokens is None:
+            # the ambient per-request cap the transports open from the
+            # /predict payload's max_new_tokens field (the deadline-
+            # scope pattern) — how a caller's cap survives the router
+            # hop without threading a kwarg through every predictor
+            max_new_tokens = current_token_cap()
         n = max_new_tokens if max_new_tokens is not None else self.max_new_tokens
         if not 1 <= n <= self.max_new_tokens:
             raise ValueError(
@@ -1948,17 +1977,7 @@ class DecodeEngine:
             deadline_ms = current_deadline_ms()
         # validate EVERY prompt before creating any request or trace
         # rid, so a bad later prompt cannot leak earlier ones' state
-        rows = []
-        for p in prompts:
-            row = np.asarray(p, dtype=np.int32).ravel()
-            if row.size == 0:
-                raise ValueError("empty prompt")
-            # left-truncate BEFORE prepending any system prefix, so the
-            # prefix survives arbitrarily long prompts
-            row = row[-self._user_max:]
-            if self._prefix_tokens is not None:
-                row = np.concatenate([self._prefix_tokens, row])
-            rows.append(row)
+        rows = [self._canonical_row(p) for p in prompts]
         reqs = []
         for row in rows:
             req = _Request(
@@ -2022,6 +2041,8 @@ class DecodeEngine:
             validate_priority(priority) if priority is not None
             else current_priority()
         )
+        if max_new_tokens is None:
+            max_new_tokens = current_token_cap()  # payload-field cap
         n = max_new_tokens if max_new_tokens is not None else self.max_new_tokens
         if not 1 <= n <= self.max_new_tokens:
             raise ValueError(
@@ -2030,12 +2051,7 @@ class DecodeEngine:
             )
         if deadline_ms is None:
             deadline_ms = current_deadline_ms()
-        row = np.asarray(prompt, dtype=np.int32).ravel()
-        if row.size == 0:
-            raise ValueError("empty prompt")
-        row = row[-self._user_max:]
-        if self._prefix_tokens is not None:
-            row = np.concatenate([self._prefix_tokens, row])
+        row = self._canonical_row(prompt)
         req = _Request(
             prompt=row, max_new_tokens=n, stream=queue.Queue(),
             tenant=tenant, priority=priority,
@@ -2069,6 +2085,145 @@ class DecodeEngine:
             # a dead request
             if not req.event.is_set():
                 req.abandoned = True
+
+    def _canonical_row(self, prompt) -> np.ndarray:
+        """The engine's canonical prompt row: left-truncated to the
+        user budget, system prefix prepended — ONE home shared by the
+        generate paths and the KV export, so a disaggregated prefill
+        engine and its decode peer (configured identically) key the
+        same bytes under the same tokens."""
+        row = np.asarray(prompt, dtype=np.int32).ravel()
+        if row.size == 0:
+            raise ValueError("empty prompt")
+        row = row[-self._user_max:]
+        if self._prefix_tokens is not None:
+            row = np.concatenate([self._prefix_tokens, row])
+        return row
+
+    def prefill_export(
+        self,
+        params,
+        prompt: Sequence[int],
+        *,
+        deadline_ms: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
+    ) -> dict:
+        """Prefill-only admission — the disaggregated serving prefill
+        leg (docs/serving.md "Disaggregated serving", DistServe/
+        Splitwise lineage): run the prompt's (possibly chunked)
+        prefill through the NORMAL admission machinery, let the
+        harvest finalize the prompt's full KV blocks into the host
+        prefix-cache store (the same extract/insert path every
+        admission takes — pointer handoff, no extra copies), and
+        return a KV handle instead of streaming:
+
+        ``{"tokens": [first_token], "prompt": [...canonical row...],
+        "cached_tokens": N, "lease": PrefixLease, "rid": ...}``
+
+        The first sampled token gives the router its TTFT emission;
+        ``lease`` pins the exported path against LRU eviction until
+        the decode leg has spliced (release it exactly once — it is
+        idempotent, so the router's finally is safe under retries);
+        ``cached_tokens`` is how much of the prompt a decode engine
+        sharing this host store will splice instead of recomputing.
+        Blocks that could not be stored (byte budget) simply shrink
+        the match — the decode leg recomputes the difference, so the
+        handoff degrades, never errors. Billing is exactly a normal
+        1-token request's: the prefill window goes to the admitting
+        tenant under this engine's ``phase`` label."""
+        if self.prefix_cache is None:
+            raise ValueError(
+                "prefill_export needs a prefix cache — the harvested "
+                "KV blocks land in its host block store for the decode "
+                "leg to splice; construct the engine with "
+                "prefix_cache=True (or a shared RadixPrefixCache)"
+            )
+        self.bind(params)
+        tenant = (
+            validate_tenant(tenant) if tenant is not None
+            else current_tenant()
+        )
+        priority = (
+            validate_priority(priority) if priority is not None
+            else current_priority()
+        )
+        if deadline_ms is None:
+            deadline_ms = current_deadline_ms()
+        row = self._canonical_row(prompt)
+        req = _Request(
+            prompt=row, max_new_tokens=1, tenant=tenant, priority=priority,
+        )
+        req._kv_event = threading.Event()
+        if deadline_ms is not None:
+            req.deadline = req.submitted + deadline_ms / 1e3
+        req.rid = self._tracer.new_request("prefill")
+        try:
+            self._gated_submit([req])
+        except BaseException:
+            self._tracer.finish_request(req.rid)  # no leak on rejection
+            raise
+        if not req.event.wait(self.submit_timeout):
+            self._m_timeouts.inc()
+            req.abandoned = True
+            raise TimeoutError("prefill did not finish in time")
+        if req.error is not None:
+            raise req.error
+        # the request finished at its prefill harvest; the insert
+        # entry carrying its KV blocks into the host store is FIFO
+        # right behind it — wait for the lease release that marks the
+        # insert processed, so the handle's lease actually covers the
+        # just-exported path (a timeout here degrades to a shorter
+        # match, never an error)
+        req._kv_event.wait(self.submit_timeout)
+        lease = self.prefix_cache.lease(row)
+        return {
+            "tokens": list(req.tokens),
+            "prompt": [int(t) for t in row],
+            "cached_tokens": int(lease.n_tokens),
+            "lease": lease,
+            "rid": req.rid,
+            "engine": self.instance,
+        }
+
+    def kv_export(
+        self, prompt: Sequence[int], *, wait_s: float = 0.25,
+    ) -> List[dict]:
+        """Export the host prefix-cache block entries covering
+        ``prompt`` — the donor half of the CROSS-PROCESS KV handoff
+        (the ``POST /debug/kv/export`` handler; same-host pools share
+        the store object and never need this). ``wait_s`` bounds a
+        short poll for in-flight inserts: the caller typically asks
+        right after its prefill response, while the harvest pipeline
+        may still be attaching the final blocks — whatever is covered
+        when the budget expires is exported (the decode side
+        recomputes the rest: degrade, never error)."""
+        cache = self.prefix_cache
+        if cache is None:
+            raise ValueError(
+                "no prefix cache on this engine — KV export needs the "
+                "host block store; construct with prefix_cache=True"
+            )
+        row = self._canonical_row(prompt)
+        target = (len(row) // cache.block_size) * cache.block_size
+        deadline = time.monotonic() + max(0.0, wait_s)
+        while cache.peek(row) < target and time.monotonic() < deadline:
+            time.sleep(0.005)
+        return cache.export_request(row)
+
+    def kv_import(self, entries: Sequence[dict]) -> int:
+        """Attach a donor's exported block entries to this engine's
+        host prefix-cache store (the ``POST /debug/kv/import``
+        handler / the router's cross-store transfer): each entry
+        rides the normal insert budget/eviction machinery; returns
+        blocks newly attached."""
+        cache = self.prefix_cache
+        if cache is None:
+            raise ValueError(
+                "no prefix cache on this engine — KV import needs the "
+                "host block store; construct with prefix_cache=True"
+            )
+        return int(cache.import_blocks(entries))
 
     def bind(self, params):
         """Set (or swap) the served weights; state allocates lazily.
@@ -2164,6 +2319,7 @@ class DecodeEngine:
         occupied = int(self._m_occupied.value)
         out = {
             "engine": "continuous",
+            "phase": self.phase,
             "slots": self.slots,
             "chunk_steps": self.chunk_steps,
             "pipeline_depth": self.pipeline_depth,
@@ -2464,6 +2620,12 @@ class DecodeEngine:
         if lease is not None:
             lease.release()
         self._release_resume_lease(req)
+        if req._kv_event is not None:
+            # prefill_export waits on this: the normal insert entry
+            # lands here after attaching the request's KV blocks, and
+            # every failure path lands here too — the export waiter
+            # wakes either way (checking req.error), never hangs
+            req._kv_event.set()
 
     def _release_resume_lease(self, req: _Request) -> None:
         """Drop the pin holding a preempted stream's evicted KV blocks
@@ -2682,6 +2844,7 @@ class DecodeEngine:
                         prefill_tokens=req._prefilled_tokens,
                         cached_tokens=req._saved_tokens,
                         priority=req.priority,
+                        phase=self.phase,
                     )
             self._flight_rec(
                 "finish", rid=req.rid, tenant=req.tenant, slot=slot,

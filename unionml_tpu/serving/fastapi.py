@@ -266,8 +266,29 @@ def serving_app(
         kind: Optional[str] = None,
         rid: Optional[str] = None,
         tenant: Optional[str] = None,
+        phase: Optional[str] = None,
     ):
-        return core.debug_flight(n=n, kind=kind, rid=rid, tenant=tenant)
+        return core.debug_flight(
+            n=n, kind=kind, rid=rid, tenant=tenant, phase=phase,
+        )
+
+    # the cross-host KV handoff surface (docs/serving.md
+    # "Disaggregated serving") — same ServingApp methods as the
+    # stdlib transport. Sync `def`: the export may briefly poll for
+    # in-flight inserts and must not freeze the event loop.
+    @app.post("/debug/kv/export")
+    def debug_kv_export(payload: dict):
+        try:
+            return core.debug_kv_export(payload.get("prompt") or [])
+        except (ValueError, TypeError) as exc:
+            raise HTTPException(status_code=422, detail=str(exc))
+
+    @app.post("/debug/kv/import")
+    def debug_kv_import(payload: dict):
+        try:
+            return core.debug_kv_import(payload.get("entries"))
+        except (ValueError, TypeError) as exc:
+            raise HTTPException(status_code=422, detail=str(exc))
 
     @app.get("/debug/usage")
     async def debug_usage():

@@ -136,7 +136,9 @@ from unionml_tpu.serving.faults import (
 from unionml_tpu.serving.scheduler import (
     DEFAULT_PRIORITY,
     priority_scope,
+    token_cap_scope,
     validate_priority,
+    validate_token_cap,
 )
 from unionml_tpu.serving.usage import (
     DEFAULT_TENANT,
@@ -150,6 +152,7 @@ KNOWN_ROUTES = (
     "/", "/predict", "/predict/stream", "/health", "/stats", "/metrics",
     "/debug/profile", "/debug/memory", "/debug/flight", "/debug/trace",
     "/debug/slo", "/debug/usage", "/debug/cache/peek", "/debug/fleet",
+    "/debug/kv/export", "/debug/kv/import",
 )
 
 # the routes that open a RECORDED trace timeline (a server span the
@@ -206,6 +209,8 @@ class ServingApp:
         slo: Optional[Any] = None,
         usage: Optional[Any] = None,
         cache_peek: Optional[Any] = None,
+        kv_export: Optional[Any] = None,
+        kv_import: Optional[Any] = None,
         **batcher_kwargs,
     ):
         """``warmup``: optional callable invoked with the loaded model
@@ -282,7 +287,18 @@ class ServingApp:
         router's fleet-wide ``cached_prefix_len``) — served at
         ``GET /debug/cache/peek?prompt=...`` so the fleet router's
         :class:`~unionml_tpu.serving.router.HttpReplica` can make
-        cache-affinity routing decisions across hosts."""
+        cache-affinity routing decisions across hosts.
+
+        ``kv_export`` / ``kv_import``: the cross-host KV handoff
+        surface (docs/serving.md "Disaggregated serving") — wire
+        ``engine.kv_export`` and ``engine.kv_import``. ``POST
+        /debug/kv/export`` (body ``{"prompt": [...]}``) answers this
+        process's cached block entries covering the prompt, wire-
+        encoded; ``POST /debug/kv/import`` (body ``{"entries":
+        [...]}``) attaches a donor's entries to this process's store.
+        A disaggregated router uses the pair to move a prefill
+        replica's finalized KV onto a decode replica on another host;
+        both answer 422 when unwired."""
         self.model = model
         self.remote = remote
         self.app_version = app_version
@@ -307,6 +323,8 @@ class ServingApp:
         self._slo = slo
         self._usage = usage
         self._cache_peek = cache_peek
+        self._kv_export = kv_export
+        self._kv_import = kv_import
         self._otlp = None
         endpoint = otlp_endpoint or os.getenv("UNIONML_TPU_OTLP_ENDPOINT")
         if endpoint:
@@ -486,20 +504,24 @@ class ServingApp:
     def debug_flight(
         self, n: Optional[int] = None, kind: Optional[str] = None,
         rid: Optional[str] = None, tenant: Optional[str] = None,
+        phase: Optional[str] = None,
     ) -> dict:
         """``GET /debug/flight?n=K``: the newest ``K`` request
         lifecycle events from the flight recorder (all retained when
         unset), optionally filtered by event kind / request id /
         tenant tag (``?tenant=`` names who was shed in an overload
-        postmortem). ``wall_offset_ms`` is the value to ADD to each
-        event's monotonic ``t_ms`` for epoch milliseconds — the fleet
-        router's flight merge rebases per-host rings with it, since
-        raw monotonic readings are incomparable across machines."""
+        postmortem) / serving-phase tag (``?phase=prefill`` isolates
+        one pool of a disaggregated fleet — handoff events carry both
+        legs' phases and match either). ``wall_offset_ms`` is the
+        value to ADD to each event's monotonic ``t_ms`` for epoch
+        milliseconds — the fleet router's flight merge rebases
+        per-host rings with it, since raw monotonic readings are
+        incomparable across machines."""
         return {
             **self._flight.stats(),
             "wall_offset_ms": round(telemetry.wall_clock_offset_ms(), 3),
             "events": self._flight.dump(
-                n=n, kind=kind, rid=rid, tenant=tenant
+                n=n, kind=kind, rid=rid, tenant=tenant, phase=phase,
             ),
         }
 
@@ -539,6 +561,46 @@ class ServingApp:
             if not tokens:
                 raise ValueError("prompt must be non-empty")
         return {"cached_prefix_len": int(self._cache_peek(tokens))}
+
+    def debug_kv_export(self, prompt: Any) -> dict:
+        """``POST /debug/kv/export`` (body ``{"prompt": [...]}``): the
+        cached KV block entries covering ``prompt``, wire-encoded —
+        the donor half of the cross-host disaggregated handoff
+        (docs/serving.md "Disaggregated serving"). Raises
+        ``ValueError`` (→ 422) when the app has no export source or
+        the prompt doesn't parse."""
+        from unionml_tpu.serving.prefix_cache import encode_entries
+
+        if self._kv_export is None:
+            raise ValueError(
+                "no KV export on this app — construct "
+                "ServingApp(kv_export=engine.kv_export) with a "
+                "prefix-cached engine"
+            )
+        tokens = [int(t) for t in prompt]
+        if not tokens:
+            raise ValueError("prompt must be non-empty token ids")
+        entries = self._kv_export(tokens)
+        return {"entries": encode_entries(entries), "blocks": len(entries)}
+
+    def debug_kv_import(self, entries: Any) -> dict:
+        """``POST /debug/kv/import`` (body ``{"entries": [...]}``):
+        attach wire-encoded donor entries to this process's host block
+        store — the import half of the cross-host handoff AND of
+        remote fleet warming. Raises ``ValueError`` (→ 422) when the
+        app has no import sink or the body is malformed."""
+        from unionml_tpu.serving.prefix_cache import decode_entries
+
+        if self._kv_import is None:
+            raise ValueError(
+                "no KV import on this app — construct "
+                "ServingApp(kv_import=engine.kv_import) with a "
+                "prefix-cached engine"
+            )
+        if not isinstance(entries, (list, tuple)):
+            raise ValueError("'entries' must be a list of KV entries")
+        attached = int(self._kv_import(decode_entries(entries)))
+        return {"attached": attached}
 
     def debug_trace(
         self,
@@ -691,16 +753,37 @@ class ServingApp:
         features = payload.get("features")
         if (inputs is None) == (features is None):
             raise ValueError("provide exactly one of 'inputs' or 'features'")
-        if inputs is not None:
-            return _to_jsonable(self.model.predict(**inputs))
-        loaded = self.model.dataset.get_features(features)
-        if self._batcher is not None:
-            return _to_jsonable(self._batcher.submit(loaded))
-        return _to_jsonable(
-            self.model.predict_from_features_workflow()(
-                model_object=self.model.artifact.model_object, features=loaded
+        # the payload-contract per-request token cap: validated here
+        # (422 on garbage) and opened as an ambient scope around the
+        # dispatch, so an engine-backed predictor honors it without a
+        # kwarg threading through every wrapper — and the cap survives
+        # the router hop, which two-leg disaggregated dispatch needs
+        # for token parity. Non-engine predictors ignore it.
+        cap = validate_token_cap(payload.get("max_new_tokens"))
+        if cap is not None and self._batcher is not None:
+            # the micro-batcher dispatches full batches on its own
+            # flush thread — a per-request cap cannot bind there, and
+            # silently decoding to the default would break exactly the
+            # cross-hop token parity the payload field exists for:
+            # refuse loudly (→ 422) instead
+            raise ValueError(
+                "max_new_tokens is not supported on a batched "
+                "(MicroBatcher) app — the batcher computes full "
+                "batches in one device call; serve the engine "
+                "directly for per-request caps"
             )
-        )
+        with token_cap_scope(cap):
+            if inputs is not None:
+                return _to_jsonable(self.model.predict(**inputs))
+            loaded = self.model.dataset.get_features(features)
+            if self._batcher is not None:
+                return _to_jsonable(self._batcher.submit(loaded))
+            return _to_jsonable(
+                self.model.predict_from_features_workflow()(
+                    model_object=self.model.artifact.model_object,
+                    features=loaded,
+                )
+            )
 
     def predict_stream(self, payload: dict):
         """Yield token chunks for ONE prompt (the SSE event source).
@@ -733,7 +816,28 @@ class ServingApp:
                 f"streaming serves one prompt per request, got {len(rows)}"
             )
         loaded = self.model.dataset.get_features(rows)
-        return self._stream_fn(self.model.artifact.model_object, loaded)
+        # same payload-contract cap as predict() — but a generator-
+        # backed stream hook defers its body (where the engine reads
+        # the ambient cap) to the FIRST next(), which happens after
+        # this frame returns. The wrapper re-opens the scope around
+        # exactly that first pull, so the cap binds for ANY caller of
+        # this public method, not just predict_stream_events.
+        cap = validate_token_cap(payload.get("max_new_tokens"))
+        stream = self._stream_fn(self.model.artifact.model_object, loaded)
+        if cap is None:
+            return stream
+
+        def capped():
+            it = iter(stream)
+            with token_cap_scope(cap):
+                try:
+                    first = next(it)
+                except StopIteration:
+                    return
+            yield first
+            yield from it
+
+        return capped()
 
     def predict_stream_events(self, payload: dict):
         """The SSE wire protocol, shared by every transport: an iterator
@@ -744,6 +848,8 @@ class ServingApp:
         chunk is pulled eagerly here — generator-backed streams defer
         their checks to the first ``next()``, and those errors still
         deserve a 422 response, not a committed-then-dropped 200).
+        The payload token cap binds inside :meth:`predict_stream`'s
+        wrapper (its one home), which covers this eager pull too.
         """
         it = iter(self.predict_stream(payload))
         try:
@@ -892,11 +998,13 @@ class ServingApp:
                         kind = query.get("kind", [None])[0]
                         rid = query.get("rid", [None])[0]
                         tenant = query.get("tenant", [None])[0]
+                        phase = query.get("phase", [None])[0]
                     except (ValueError, IndexError) as exc:
                         self._send(422, {"error": f"bad query: {exc}"})
                         return
                     self._send(200, app.debug_flight(
                         n=n, kind=kind, rid=rid, tenant=tenant,
+                        phase=phase,
                     ))
                 elif path == "/debug/usage":
                     try:
@@ -973,6 +1081,9 @@ class ServingApp:
                 if path == "/debug/profile":
                     self._debug_profile(query)
                     return
+                if path in ("/debug/kv/export", "/debug/kv/import"):
+                    self._debug_kv(path)
+                    return
                 if path not in ("/predict", "/predict/stream"):
                     self._send(404, {"error": f"no route {path}"})
                     return
@@ -1014,6 +1125,45 @@ class ServingApp:
                     self._send(422, {"error": str(exc)})
                 except Exception as exc:  # unexpected: surface as 500
                     logger.info(f"predict error: {exc!r}")
+                    self._send(500, {"error": str(exc)})
+
+            def _debug_kv(self, path):
+                """POST /debug/kv/export | /debug/kv/import — the
+                cross-host KV handoff surface (JSON body either way;
+                422 on an unwired hook or malformed body)."""
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    try:
+                        payload = json.loads(self.rfile.read(length) or b"{}")
+                    except json.JSONDecodeError as exc:
+                        self._send(
+                            422,
+                            {"error": f"request body must be JSON: {exc}"},
+                        )
+                        return
+                    if not isinstance(payload, dict):
+                        # `[]`/`"x"` parse as JSON but aren't the
+                        # object contract — 422 like the FastAPI
+                        # transport's `payload: dict` coercion, never
+                        # a 500 from payload.get
+                        self._send(
+                            422,
+                            {"error": "request body must be a JSON "
+                                      "object"},
+                        )
+                        return
+                    if path == "/debug/kv/export":
+                        self._send(200, app.debug_kv_export(
+                            payload.get("prompt") or []
+                        ))
+                    else:
+                        self._send(200, app.debug_kv_import(
+                            payload.get("entries")
+                        ))
+                except (ValueError, KeyError, TypeError) as exc:
+                    self._send(422, {"error": str(exc)})
+                except Exception as exc:
+                    logger.info(f"kv handoff error: {exc!r}")
                     self._send(500, {"error": str(exc)})
 
             def _debug_profile(self, query):

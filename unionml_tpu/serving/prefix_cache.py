@@ -51,6 +51,7 @@ label):
 
 from __future__ import annotations
 
+import base64
 import heapq
 import threading
 import time
@@ -60,7 +61,13 @@ import numpy as np
 
 from unionml_tpu import telemetry
 
-__all__ = ["RadixPrefixCache", "PrefixLease", "tree_nbytes"]
+__all__ = [
+    "RadixPrefixCache",
+    "PrefixLease",
+    "decode_entries",
+    "encode_entries",
+    "tree_nbytes",
+]
 
 
 def tree_nbytes(rows: Any) -> int:
@@ -70,6 +77,82 @@ def tree_nbytes(rows: Any) -> int:
         for buf in layer:
             total += int(np.asarray(buf).nbytes)
     return total
+
+
+# --------------------------------------------------------------------- #
+# wire codecs for export entries (the cross-host KV handoff)
+# --------------------------------------------------------------------- #
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name back to numpy, covering the accelerator
+    extension dtypes (``bfloat16`` etc.) numpy itself cannot name —
+    they come from ``ml_dtypes``, which jax always ships."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _encode_buf(buf: Any) -> dict:
+    a = np.ascontiguousarray(np.asarray(buf))
+    return {
+        "dtype": str(a.dtype),
+        "shape": list(a.shape),
+        "data": base64.b64encode(a.tobytes()).decode("ascii"),
+    }
+
+
+def _decode_buf(spec: dict) -> np.ndarray:
+    raw = base64.b64decode(spec["data"])
+    a = np.frombuffer(raw, dtype=_np_dtype(spec["dtype"]))
+    # .copy(): frombuffer views are read-only; the store's arrays must
+    # be ordinary owned host buffers like every other inserted block
+    return a.reshape([int(d) for d in spec["shape"]]).copy()
+
+
+def encode_entries(entries: Sequence[dict]) -> List[dict]:
+    """JSON-safe form of :meth:`RadixPrefixCache.export_request` /
+    :meth:`~RadixPrefixCache.export_hot` entries — the wire format of
+    ``POST /debug/kv/export`` ↔ ``/debug/kv/import`` (docs/serving.md
+    "Disaggregated serving"). Each KV buffer ships as dtype + shape +
+    base64 bytes; rank-generic, so bf16 KV buffers and int8-cache
+    scale planes ride along unchanged."""
+    out: List[dict] = []
+    for entry in entries:
+        out.append({
+            "tokens": [int(t) for t in np.asarray(entry["tokens"]).ravel()],
+            "first_block": int(entry["first_block"]),
+            "rows": [
+                [_encode_buf(buf) for buf in layer]
+                for layer in entry["rows"]
+            ],
+        })
+    return out
+
+
+def decode_entries(payload: Sequence[dict]) -> List[dict]:
+    """Inverse of :func:`encode_entries`: rebuild importable entries
+    (numpy rows) from the wire form. Raises ``ValueError`` on a
+    malformed body — the transports map it to 422."""
+    out: List[dict] = []
+    try:
+        for entry in payload:
+            out.append({
+                "tokens": np.asarray(
+                    [int(t) for t in entry["tokens"]], np.int32,
+                ),
+                "first_block": int(entry["first_block"]),
+                "rows": tuple(
+                    tuple(_decode_buf(buf) for buf in layer)
+                    for layer in entry["rows"]
+                ),
+            })
+    except (KeyError, TypeError, AttributeError) as exc:
+        raise ValueError(f"malformed KV entry payload: {exc!r}") from exc
+    return out
 
 
 class _Node:
@@ -491,6 +574,42 @@ class RadixPrefixCache:
                     "tokens": tokens,
                     "first_block": node.depth,
                     "rows": node.rows,
+                })
+            return entries
+        finally:
+            lease.release()
+
+    def export_request(self, tokens: Sequence[int]) -> List[dict]:
+        """Export the cached blocks covering ONE specific prompt — the
+        disaggregated-serving KV handoff donor path (docs/serving.md
+        "Disaggregated serving"), the per-request twin of the fleet-
+        warming :meth:`export_hot`: a prefill engine finalizes a
+        request's KV into this store, then the router (or the remote
+        ``POST /debug/kv/export`` handler) pulls exactly that request's
+        blocks to splice on a decode engine in another process.
+
+        Walks the longest cached block-prefix of ``tokens`` and emits
+        one ``{"tokens", "first_block", "rows"}`` entry per matched
+        block, parent-before-child (each is exactly one
+        :meth:`insert`/:meth:`import_blocks` call on the importer).
+        The path is pinned under a :class:`PrefixLease` while entries
+        are built — a concurrent insert's eviction pass can never
+        reclaim a block mid-export — and ``rows`` reference the
+        store's own write-once arrays, so a same-process export costs
+        pointers, not copies (the wire serialization, when the import
+        crosses a host boundary, is the transport's business). Like
+        :meth:`peek`/:meth:`lease`, no hit/miss counters move: the
+        handoff is bookkeeping, not a cache lookup."""
+        tokens = np.ascontiguousarray(tokens, np.int32).ravel()
+        lease = self.lease(tokens)
+        try:
+            blk = self.block_size
+            entries: List[dict] = []
+            for i, rows in enumerate(lease.rows):
+                entries.append({
+                    "tokens": tokens[: (i + 1) * blk].copy(),
+                    "first_block": i,
+                    "rows": rows,
                 })
             return entries
         finally:

@@ -73,7 +73,14 @@ from unionml_tpu.serving.faults import (
     current_deadline_ms,
     deadline_scope,
 )
-from unionml_tpu.serving.scheduler import current_priority, priority_scope
+from unionml_tpu.serving.scheduler import (
+    current_priority,
+    current_token_cap,
+    priority_scope,
+    token_cap_scope,
+    validate_phase,
+    validate_token_cap,
+)
 from unionml_tpu.serving.usage import current_tenant, tenant_scope
 
 # the router's request id, exposed to replica dispatches on this thread
@@ -124,6 +131,13 @@ class ReplicaHandle:
     # threads; in-process fetches run inline — a local registry read
     # must not pay a thread spawn per scrape)
     remote: bool = False
+
+    # which serving phase this replica's pool owns (docs/serving.md
+    # "Disaggregated serving"): "prefill" / "decode" / "colocated"
+    # (default — serves both). The DisaggRouter's phase-aware pick
+    # routes by it; fleet_report / GET /debug/fleet tag replicas with
+    # it so the operator dashboard shows per-pool state.
+    phase: str = "colocated"
 
     def generate_stream(
         self, prompt: Sequence[int], *, max_new_tokens: Optional[int] = None,
@@ -177,6 +191,44 @@ class ReplicaHandle:
         before this replica takes traffic; returns blocks attached (0
         when unsupported)."""
         return 0
+
+    # -- disaggregated prefill/decode hooks (docs/serving.md
+    # "Disaggregated serving"): the two-leg dispatch primitives. Every
+    # implementation must either work or raise — the DisaggRouter
+    # degrades a failed prefill leg to a cold decode-side prefill, so
+    # none of these can ever cost a caller-visible failure.
+
+    def prefill_export(
+        self, prompt: Sequence[int], *, max_new_tokens: Optional[int] = None,
+    ) -> dict:
+        """Run prefill ONLY and finalize the prompt's KV into the
+        replica's host block store; returns the KV handle
+        (``{"tokens": [first], "cached_tokens": N, "lease": ...}`` —
+        see :meth:`~unionml_tpu.serving.engine.DecodeEngine
+        .prefill_export`). A replica that CANNOT serve a prefill leg
+        (no prefix cache) raises the infra-class
+        :class:`~unionml_tpu.serving.faults.EngineUnavailable` — a
+        pool misconfiguration must degrade the request to a cold
+        decode-side prefill, not surface as a caller error (the
+        router re-raises only deterministic caller faults)."""
+        raise EngineUnavailable(
+            f"{self.name}: replica does not support prefill_export",
+            reason="no_prefill",
+        )
+
+    def export_request_blocks(self, prompt: Sequence[int]) -> List[dict]:
+        """The cross-store handoff donor hook: this replica's cached
+        blocks covering ``prompt`` as importable entries
+        (:meth:`~unionml_tpu.serving.prefix_cache.RadixPrefixCache
+        .export_request`); empty when nothing is cached."""
+        return []
+
+    def kv_store(self):
+        """The in-process :class:`~unionml_tpu.serving.prefix_cache
+        .RadixPrefixCache` behind this replica, when one exists —
+        identity comparison is how the router detects SAME-HOST pools
+        sharing one store (pointer handoff, no transfer needed)."""
+        return None
 
     # -- fleet observability hooks (docs/observability.md "Fleet
     # observability"): how the router app's federated /metrics, merged
@@ -264,11 +316,19 @@ class EngineReplica(ReplicaHandle):
     caller's (or hedge worker's re-scoped) thread.
     """
 
-    def __init__(self, engine, params, *, name: str, slo=None):
+    def __init__(self, engine, params, *, name: str, slo=None,
+                 phase: Optional[str] = None):
         self.engine = engine
         self.params = params
         self.name = name
         self._slo = slo
+        # phase defaults to the engine's own declaration, so a
+        # DecodeEngine(phase="prefill") replica routes correctly
+        # without repeating itself at wrap time
+        self.phase = validate_phase(
+            phase if phase is not None
+            else getattr(engine, "phase", None)
+        )
 
     def generate_stream(self, prompt, *, max_new_tokens=None):
         return self.engine.generate_stream(
@@ -279,6 +339,23 @@ class EngineReplica(ReplicaHandle):
         return self.engine.generate(
             self.params, [prompt], max_new_tokens=max_new_tokens
         )[0]
+
+    def prefill_export(self, prompt, *, max_new_tokens=None):
+        if getattr(self.engine, "prefix_cache", None) is None:
+            # misconfigured pool member: speak the infra vocabulary so
+            # the disagg router degrades instead of erroring the caller
+            raise EngineUnavailable(
+                f"{self.name}: engine has no prefix cache — cannot "
+                "serve a prefill leg",
+                reason="no_prefill",
+            )
+        return self.engine.prefill_export(self.params, prompt)
+
+    def export_request_blocks(self, prompt) -> List[dict]:
+        return self.engine.kv_export(prompt)
+
+    def kv_store(self):
+        return getattr(self.engine, "prefix_cache", None)
 
     def health(self) -> dict:
         out = dict(self.engine.health())
@@ -373,10 +450,14 @@ class HttpReplica(ReplicaHandle):
         timeout_s: float = 60.0, peek_ttl_s: float = 1.0,
         peek_cache_size: int = 256, peek_timeout_s: float = 2.0,
         peek_prompt_tokens: int = 128, metrics_ttl_s: float = 2.0,
-        obs_timeout_s: float = 5.0,
+        obs_timeout_s: float = 5.0, phase: Optional[str] = None,
     ):
         self.base_url = base_url.rstrip("/")
         self.name = name if name is not None else self.base_url
+        # a remote's phase is the OPERATOR's declaration (the process
+        # behind the URL can't be introspected per pick): pass
+        # phase="prefill"/"decode" when registering pool members
+        self.phase = validate_phase(phase)
         self.timeout_s = timeout_s
         # remote cache-peek probe cache (health-TTL-style): the router
         # peeks per pick, and a per-pick HTTP round trip would make
@@ -460,22 +541,26 @@ class HttpReplica(ReplicaHandle):
         )
 
     @staticmethod
-    def _refuse_cap(max_new_tokens) -> None:
-        """The ``/predict`` payload contract has no per-request token
-        cap, so a non-None ``max_new_tokens`` CANNOT cross this hop —
-        refusing loudly beats silently decoding to the remote default
-        (which would break token parity the moment a failover lands a
-        capped request here)."""
-        if max_new_tokens is not None:
-            raise ValueError(
-                "HttpReplica cannot forward max_new_tokens — the remote "
-                "/predict contract has no field for it; configure the "
-                "cap on the remote engine instead"
-            )
+    def _payload(prompt, max_new_tokens) -> dict:
+        """The ``/predict``/``/predict/stream`` request body. The
+        per-request token cap rides the payload's ``max_new_tokens``
+        field (both transports parse it into a ``token_cap_scope``
+        around the engine dispatch) — explicit argument first, else
+        the ambient scope, mirroring how ``_headers`` re-emits the
+        deadline/tenant scopes: a capped request keeps its cap across
+        the hop, which failover token parity and the disaggregated
+        two-leg dispatch both depend on."""
+        payload = {"features": [list(int(t) for t in prompt)]}
+        cap = (
+            max_new_tokens if max_new_tokens is not None
+            else current_token_cap()
+        )
+        if cap is not None:
+            payload["max_new_tokens"] = int(cap)
+        return payload
 
     def generate_stream(self, prompt, *, max_new_tokens=None):
-        self._refuse_cap(max_new_tokens)
-        payload = {"features": [list(int(t) for t in prompt)]}
+        payload = self._payload(prompt, max_new_tokens)
         req = urllib.request.Request(
             f"{self.base_url}/predict/stream",
             data=json.dumps(payload).encode(),
@@ -524,8 +609,7 @@ class HttpReplica(ReplicaHandle):
             resp.close()
 
     def generate(self, prompt, *, max_new_tokens=None):
-        self._refuse_cap(max_new_tokens)
-        payload = {"features": [list(int(t) for t in prompt)]}
+        payload = self._payload(prompt, max_new_tokens)
         req = urllib.request.Request(
             f"{self.base_url}/predict",
             data=json.dumps(payload).encode(),
@@ -716,6 +800,95 @@ class HttpReplica(ReplicaHandle):
                 }
             self._peek_cache[key] = (cached, now)
         return cached
+
+    def _post_json(
+        self, path: str, body: dict, timeout_s: Optional[float] = None,
+    ) -> dict:
+        req = urllib.request.Request(
+            f"{self.base_url}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        timeout = timeout_s if timeout_s is not None else self.timeout_s
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as exc:
+            text = exc.read().decode(errors="replace")
+            self._raise_typed(exc.code, text, exc.headers)
+        except (urllib.error.URLError, OSError, TimeoutError) as exc:
+            raise EngineUnavailable(
+                f"{self.name}: unreachable ({exc})", reason="unreachable",
+            ) from exc
+
+    def prefill_export(self, prompt, *, max_new_tokens=None):
+        """The remote prefill leg: ONE 1-token ``/predict`` (the cap
+        rides the payload) — the remote engine prefills, samples the
+        first token, and finalizes the prompt's KV into ITS host
+        store through the normal harvest path. The block entries only
+        cross the wire later, if and when the decode side actually
+        pulls them (:meth:`export_request_blocks`) — a same-fleet
+        decode replica that turns out to share the store never pays
+        the serialization."""
+        out = self.generate(prompt, max_new_tokens=1)
+        if not out:
+            raise EngineUnavailable(
+                f"{self.name}: empty prefill response",
+                reason="http_error",
+            )
+        return {
+            "tokens": out[:1],
+            "prompt": [int(t) for t in prompt],
+            # unknown from here — the transfer step discovers coverage
+            "cached_tokens": 0,
+            "lease": None,  # remote store: no local pin to hold
+            "engine": self.name,
+        }
+
+    def _kv_export_wire(self, prompt) -> List[dict]:
+        """The remote store's blocks covering ``prompt`` in WIRE form
+        (``POST /debug/kv/export``, bounded by ``obs_timeout_s`` — a
+        wedged prefill host must degrade the handoff to a cold decode
+        prefill, not stall it for the dispatch timeout). The
+        disaggregated router's remote→remote handoff relays this form
+        untouched: transcoding megabytes of KV through numpy just to
+        re-encode them would be pure churn on the handoff path."""
+        body = self._post_json(
+            "/debug/kv/export",
+            {"prompt": [int(t) for t in prompt]},
+            timeout_s=self.obs_timeout_s,
+        )
+        entries = body.get("entries", [])
+        return entries if isinstance(entries, list) else []
+
+    def _kv_import_wire(self, encoded: Sequence[dict]) -> int:
+        """Push already-wire-form entries over ``POST
+        /debug/kv/import``; returns blocks attached remotely."""
+        if not encoded:
+            return 0
+        body = self._post_json(
+            "/debug/kv/import", {"entries": list(encoded)},
+            timeout_s=self.obs_timeout_s,
+        )
+        return int(body.get("attached", 0))
+
+    def export_request_blocks(self, prompt) -> List[dict]:
+        """The in-process entry form of :meth:`_kv_export_wire` (for
+        an in-process importer on this side of the hop)."""
+        from unionml_tpu.serving.prefix_cache import decode_entries
+
+        return decode_entries(self._kv_export_wire(prompt))
+
+    def import_cache_blocks(self, entries: Sequence[dict]) -> int:
+        """Push block entries into the remote store over
+        ``POST /debug/kv/import`` (the cross-host halves of both the
+        KV handoff and fleet warming)."""
+        from unionml_tpu.serving.prefix_cache import encode_entries
+
+        if not entries:
+            return 0
+        return self._kv_import_wire(encode_entries(entries))
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         # remote drain is an operator action on the remote process;
@@ -940,8 +1113,14 @@ class FleetRouter:
         self._fleet_rid: Optional[str] = None
         self._fleet_events = 0
         # set by a FleetAutoscaler operating this router; the fleet
-        # dashboard (GET /debug/fleet) reads its last decision through it
+        # dashboard (GET /debug/fleet) reads its last decision through
+        # it. A phase-split fleet runs one autoscaler PER POOL (TTFT
+        # burn scales prefill, decode headroom scales decode) — each
+        # registers under its phase in `autoscalers`, and `autoscaler`
+        # keeps pointing at the most recent registration (the single-
+        # pool back-compat view).
         self.autoscaler = None
+        self.autoscalers: Dict[str, object] = {}
         self._build_instruments()
         self._g_live.set_function(self._live_count)
 
@@ -1086,10 +1265,13 @@ class FleetRouter:
         with self._lock:
             budget = self._budget_tokens
         replicas = {}
+        phases: Dict[str, dict] = {}
         for name, s in signals.items():
             h = s["health"]
+            phase = s.get("phase", "colocated")
             replicas[name] = {
                 "state": s["state"],
+                "phase": phase,
                 "status": h.get("status", "unknown"),
                 "queue_depth": h.get("queue_depth", 0),
                 "breaker_open": bool(h.get("breaker_open", False)),
@@ -1097,12 +1279,22 @@ class FleetRouter:
                 "cache_blocks": s["cache_blocks"],
                 "consecutive_failures": s["consecutive_failures"],
             }
+            # per-pool rollup: the operator dashboard's phase-split
+            # view (docs/serving.md "Disaggregated serving")
+            pool = phases.setdefault(
+                phase, {"replicas": 0, "routable": 0, "queue_depth": 0},
+            )
+            pool["replicas"] += 1
+            if s["state"] in (_LIVE, _HALF_OPEN):
+                pool["routable"] += 1
+            pool["queue_depth"] += int(h.get("queue_depth", 0) or 0)
         report = {
             "status": health["status"],
             "live_replicas": health["live_replicas"],
             "min_live": health["min_live"],
             "retry_budget_tokens": round(budget, 3),
             "replicas": replicas,
+            "phases": phases,
         }
         auto = self.autoscaler
         if auto is not None:
@@ -1114,6 +1306,16 @@ class FleetRouter:
                 # the dashboard is a debug read: a mid-teardown
                 # autoscaler degrades it, never breaks /debug/fleet
                 report["autoscaler"] = {"error": str(exc)}
+        if len(self.autoscalers) > 1:
+            # phase-split fleets: every pool's autoscaler view, keyed
+            # by the phase it operates
+            per_pool = {}
+            for key, pool_auto in list(self.autoscalers.items()):
+                try:
+                    per_pool[key] = pool_auto.dashboard(signals=signals)
+                except BaseException as exc:
+                    per_pool[key] = {"error": str(exc)}
+            report["autoscalers"] = per_pool
         return report
 
     def replica_handle(self, name: str) -> ReplicaHandle:
@@ -1288,6 +1490,7 @@ class FleetRouter:
                 blocks = 0
             out[state.handle.name] = {
                 "state": state.state,
+                "phase": getattr(state.handle, "phase", "colocated"),
                 "health": dict(health),
                 "cache_blocks": blocks,
                 "consecutive_failures": state.consecutive_failures,
@@ -1870,11 +2073,14 @@ class FleetRouter:
             )
 
         # scopes are thread-local: capture the caller's and re-open
-        # them inside each lane so deadlines/tenants/traces survive
+        # them inside each lane so deadlines/tenants/traces — and the
+        # ambient per-request token cap, which decides OUTPUT LENGTH
+        # and therefore token parity across dispatch paths — survive
         # the hop onto worker threads
         deadline = current_deadline_ms()
         tenant = current_tenant()
         priority = current_priority()
+        token_cap = current_token_cap()
         trace_ctx = telemetry.current_trace_context()
 
         def start_lane(idx: int, exclude: List[str]) -> threading.Thread:
@@ -1907,6 +2113,7 @@ class FleetRouter:
             try:
                 with deadline_scope(deadline), tenant_scope(tenant), \
                         priority_scope(priority), \
+                        token_cap_scope(token_cap), \
                         telemetry.trace_scope(lane_ctx), _rid_scope(rid):
                     replica = self._pick(prompt, exclude=exclude)
                     lanes[idx] = replica.name
@@ -2289,7 +2496,8 @@ def make_router_app(router: FleetRouter, *, name: str = "fleet-router",
                 return local
             return telemetry.merge_expositions(local, texts)
 
-        def debug_flight(self, n=None, kind=None, rid=None, tenant=None):
+        def debug_flight(self, n=None, kind=None, rid=None, tenant=None,
+                         phase=None):
             """The fleet ``GET /debug/flight``: the router's own ring
             (route/retry/eject/scale_* events) merged with every
             replica's ring under a ``replica`` tag, time-ordered on
@@ -2305,7 +2513,7 @@ def make_router_app(router: FleetRouter, *, name: str = "fleet-router",
             response reports ``wall_offset_ms: 0`` — its events are
             pre-anchored."""
             local = super().debug_flight(n=None, kind=kind, rid=rid,
-                                         tenant=tenant)
+                                         tenant=tenant, phase=phase)
             # local + in-process rings share THIS host's clock: one
             # anchor rebases them all (copies — the ring's own dicts
             # must never be mutated)
@@ -2331,7 +2539,7 @@ def make_router_app(router: FleetRouter, *, name: str = "fleet-router",
             # first, truncate the merged stream last, exactly like
             # FlightRecorder.dump
             fetch_n = n if (kind is None and rid is None
-                            and tenant is None) else None
+                            and tenant is None and phase is None) else None
             handles = dict(items)
             for rep_name, fetched in self._fanout(
                 items, lambda h: h.flight_events(n=fetch_n),
@@ -2366,6 +2574,11 @@ def make_router_app(router: FleetRouter, *, name: str = "fleet-router",
                         continue
                     if tenant is not None and (
                         tagged.get("tenant") != tenant
+                    ):
+                        continue
+                    if phase is not None and not (
+                        tagged.get("phase") == phase
+                        or phase in tagged.get("phases", ())
                     ):
                         continue
                     events.append(tagged)
@@ -2574,8 +2787,11 @@ def make_router_app(router: FleetRouter, *, name: str = "fleet-router",
                     "router app is draining", reason="draining",
                 )
             rows = _prompt_rows(payload)
+            # the payload-contract token cap (422 on garbage), passed
+            # explicitly so HttpReplica forwards it across a further hop
+            cap = validate_token_cap(payload.get("max_new_tokens"))
             if len(rows) == 1:
-                return [self.router.generate(rows[0])]
+                return [self.router.generate(rows[0], max_new_tokens=cap)]
             # multi-prompt: dispatch rows CONCURRENTLY so the replica
             # engines continuous-batch them, instead of serializing N
             # full generations behind one another (each worker re-opens
@@ -2591,7 +2807,9 @@ def make_router_app(router: FleetRouter, *, name: str = "fleet-router",
                     with deadline_scope(deadline), tenant_scope(tenant), \
                             priority_scope(priority), \
                             telemetry.trace_scope(trace_ctx):
-                        results[i] = self.router.generate(rows[i])
+                        results[i] = self.router.generate(
+                            rows[i], max_new_tokens=cap,
+                        )
                 except BaseException as exc:  # relayed in submit order
                     results[i] = exc
 
@@ -2619,7 +2837,12 @@ def make_router_app(router: FleetRouter, *, name: str = "fleet-router",
                     f"streaming serves one prompt per request, "
                     f"got {len(rows)}"
                 )
-            return self.router.generate_stream(rows[0])
+            return self.router.generate_stream(
+                rows[0],
+                max_new_tokens=validate_token_cap(
+                    payload.get("max_new_tokens")
+                ),
+            )
 
         def resume(self):
             super().resume()

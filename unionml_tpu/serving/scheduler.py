@@ -64,14 +64,20 @@ from typing import Any, Dict, Iterator, List, Mapping, Optional, Tuple
 from unionml_tpu import telemetry
 
 __all__ = [
+    "DEFAULT_PHASE",
     "DEFAULT_PRIORITY",
+    "PHASES",
     "PRIORITIES",
     "PreemptiveScheduler",
     "SchedulerConfig",
     "WaitingRoom",
     "current_priority",
+    "current_token_cap",
     "priority_scope",
+    "token_cap_scope",
+    "validate_phase",
     "validate_priority",
+    "validate_token_cap",
 ]
 
 # CLOSED value set (metric-label-safe, like usage.DROP_CAUSES): the
@@ -85,6 +91,32 @@ _RANK = {p: i for i, p in enumerate(PRIORITIES)}  # 0 = most urgent
 # unionml_preemptions_total{cause} label): "priority" = a
 # higher-priority waiter displaced a lower-priority resident
 PREEMPT_CAUSES = ("priority",)
+
+# serving PHASES (docs/serving.md "Disaggregated serving"): which half
+# of a generative request an engine pool owns. ``colocated`` (the
+# default) serves both — the historical single-pool architecture; a
+# phase-split fleet runs ``prefill`` engines (prompt prefill + KV
+# export, DistServe/Splitwise lineage) and ``decode`` engines (KV
+# splice + token streaming) behind one phase-aware router. A CLOSED
+# set like PRIORITIES: phase rides metric labels, flight-event tags,
+# and the fleet dashboard, so the value space must stay enumerable.
+PHASES = ("prefill", "decode", "colocated")
+DEFAULT_PHASE = "colocated"
+
+
+def validate_phase(value: Optional[str]) -> str:
+    """Normalize an engine/replica ``phase``: ``None``/empty →
+    :data:`DEFAULT_PHASE`; anything outside :data:`PHASES` raises
+    ``ValueError`` — the set is closed (label- and dashboard-safe)."""
+    if value is None or value == "":
+        return DEFAULT_PHASE
+    phase = str(value).lower()
+    if phase not in PHASES:
+        raise ValueError(
+            f"unknown serving phase {value!r}: must be one of "
+            f"{'/'.join(PHASES)}"
+        )
+    return phase
 
 
 def validate_priority(value: Optional[str]) -> str:
@@ -136,6 +168,59 @@ def current_priority() -> str:
     :data:`DEFAULT_PRIORITY`."""
     priority = getattr(_priority_tls, "priority", None)
     return priority if priority else DEFAULT_PRIORITY
+
+
+def validate_token_cap(value) -> Optional[int]:
+    """Normalize a per-request ``max_new_tokens`` cap from a payload
+    field: ``None`` → no cap (the engine default applies); anything
+    else must be an integer ``>= 1`` or ``ValueError`` (→ 422) — the
+    cap crosses the router hop in the ``/predict`` payload, so a
+    hostile body must be rejected at the boundary like a hostile
+    header."""
+    if value is None:
+        return None
+    if isinstance(value, bool) or (
+        not isinstance(value, int) and not (
+            isinstance(value, float) and value.is_integer()
+        )
+    ):
+        raise ValueError(
+            f"max_new_tokens must be an integer >= 1, got {value!r}"
+        )
+    cap = int(value)
+    if cap < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {cap}")
+    return cap
+
+
+_token_cap_tls = threading.local()
+
+
+@contextmanager
+def token_cap_scope(cap: Optional[int]) -> Iterator[None]:
+    """Expose a per-request ``max_new_tokens`` cap to engine
+    submissions on this thread (``None`` leaves any outer scope
+    visible) — the deadline-scope plumbing applied to the token cap:
+    the transports open it from the ``/predict`` payload's
+    ``max_new_tokens`` field, so an engine-backed predictor honors the
+    caller's cap without threading a kwarg through every wrapper (and
+    the cap survives the router hop — disaggregated two-leg dispatch
+    needs it for token parity)."""
+    if cap is None:
+        yield
+        return
+    prev = getattr(_token_cap_tls, "cap", None)
+    _token_cap_tls.cap = int(cap)
+    try:
+        yield
+    finally:
+        _token_cap_tls.cap = prev
+
+
+def current_token_cap() -> Optional[int]:
+    """The innermost :func:`token_cap_scope` value on this thread, or
+    ``None`` (no per-request cap — the engine default applies)."""
+    return getattr(_token_cap_tls, "cap", None)
 
 
 @dataclass(frozen=True)
@@ -456,8 +541,13 @@ class PreemptiveScheduler:
         registry: Optional[telemetry.MetricsRegistry] = None,
         engine_label: str = "engine-0",
         usage=None,
+        phase: Optional[str] = None,
     ):
         self.config = config if config is not None else SchedulerConfig()
+        # the owning engine's serving phase (prefill/decode/colocated):
+        # rides stats() so a phase-split fleet's per-engine scheduler
+        # views are attributable to their pool
+        self.phase = validate_phase(phase)
         self._registry = (
             registry if registry is not None else telemetry.get_registry()
         )
@@ -537,6 +627,7 @@ class PreemptiveScheduler:
     def stats(self) -> dict:
         """The ``scheduler`` section of ``DecodeEngine.stats()``."""
         return {
+            "phase": self.phase,
             "waiting": self.room.depths(),
             "parked": self.room.parked_count(),
             "preemptions": self.preemptions(),

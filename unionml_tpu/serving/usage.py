@@ -134,10 +134,12 @@ class _TenantUsage:
         "requests", "queue_ms", "prefill_tokens", "cached_tokens",
         "decode_tokens", "device_seconds", "flops", "kv_block_seconds",
         "rejected", "deadline_shed", "dropped", "by_priority",
+        "by_phase",
     )
 
     def __init__(self):
         self.by_priority: Dict[str, int] = {}
+        self.by_phase: Dict[str, int] = {}
         self.requests = 0
         self.queue_ms = 0.0
         self.prefill_tokens = 0
@@ -158,6 +160,11 @@ class _TenantUsage:
             # kept out of the metric surface: the per-tenant label
             # cardinality budget is spent)
             "requests_by_priority": dict(self.by_priority),
+            # serving-phase breakdown (closed set — scheduler.PHASES):
+            # on a disaggregated fleet the prefill pool's 1-token legs
+            # and the decode pool's streams are separately countable
+            # per tenant (JSON-only, same cardinality argument)
+            "requests_by_phase": dict(self.by_phase),
             "queue_ms": round(self.queue_ms, 3),
             "prefill_tokens": self.prefill_tokens,
             "cached_tokens": self.cached_tokens,
@@ -372,12 +379,14 @@ class UsageLedger:
         prefill_tokens: int = 0,
         cached_tokens: int = 0,
         priority: Optional[str] = None,
+        phase: Optional[str] = None,
     ) -> None:
         """One request completed and delivered: the per-request scalars
-        (queue wait, prefill split, and the scheduling ``priority``
-        class it ran under) land here; decode tokens and device
-        attribution accumulated through :meth:`attribute` as the
-        request's chunks harvested."""
+        (queue wait, prefill split, the scheduling ``priority`` class
+        it ran under, and the serving ``phase`` of the engine that
+        completed it) land here; decode tokens and device attribution
+        accumulated through :meth:`attribute` as the request's chunks
+        harvested."""
         with self._lock:
             label = self._label_locked(tenant)
             acct = self._acct_locked(tenant)
@@ -389,6 +398,8 @@ class UsageLedger:
                 acct.by_priority[priority] = (
                     acct.by_priority.get(priority, 0) + 1
                 )
+            if phase is not None:
+                acct.by_phase[phase] = acct.by_phase.get(phase, 0) + 1
         lbl = (self.instance, label)
         self._f_requests.labels(*lbl).inc()
         if queue_ms > 0:

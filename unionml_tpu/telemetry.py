@@ -1368,12 +1368,15 @@ class FlightRecorder:
         kind: Optional[str] = None,
         rid: Optional[str] = None,
         tenant: Optional[str] = None,
+        phase: Optional[str] = None,
     ) -> List[dict]:
         """The newest ``n`` retained events (all when ``None``), oldest
-        first; optionally filtered by ``kind``, request id, and/or
-        tenant tag (engines/batchers stamp request lifecycle events
-        with the submitting tenant — the ``/debug/flight?tenant=``
-        postmortem filter)."""
+        first; optionally filtered by ``kind``, request id, tenant tag
+        (engines/batchers stamp request lifecycle events with the
+        submitting tenant — the ``/debug/flight?tenant=`` postmortem
+        filter), and/or serving ``phase`` tag (phase-split engines
+        stamp their pool — prefill/decode — on every lifecycle event,
+        and the router's ``handoff`` events carry both legs')."""
         with self._lock:
             events = list(self._events)
         if kind is not None:
@@ -1385,6 +1388,12 @@ class FlightRecorder:
             ]
         if tenant is not None:
             events = [e for e in events if e.get("tenant") == tenant]
+        if phase is not None:
+            events = [
+                e for e in events
+                if e.get("phase") == phase
+                or phase in e.get("phases", ())
+            ]
         if n is not None:
             n = int(n)
             events = events[-n:] if n > 0 else []
