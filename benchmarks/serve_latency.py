@@ -80,6 +80,16 @@ Usage::
     #   paired plane-on/off legs asserting <= 2% p99 overhead at
     #   bit-identical tokens (docs/observability.md "Fleet
     #   observability")
+    UNIONML_TPU_BENCH_PRESET=serve_rollout python benchmarks/serve_latency.py
+    # ^ zero-downtime model lifecycle: a 2-engine fleet under flood
+    #   has a bad version rolled forward and auto-rolled back on its
+    #   shadow parity regression, then a clean version baked and
+    #   promoted through rolling drain/bind/rejoin — per sweep, three
+    #   sweeps; 0 caller-visible failures, exact token parity on the
+    #   live path, lifecycle-churn TTFT p99 within 2x of the
+    #   steady-state baseline measured by the same min-over-rounds /
+    #   unrounded-nearest-rank / median-of-three estimator
+    #   (docs/robustness.md "Rollouts & rollback")
 """
 
 from __future__ import annotations
@@ -3015,6 +3025,308 @@ def fleet_obs_leg() -> None:
             e.close()
 
 
+def rollout_leg() -> None:
+    """Zero-downtime model lifecycle under flood
+    (``UNIONML_TPU_BENCH_PRESET=serve_rollout``;
+    docs/robustness.md "Rollouts & rollback").
+
+    A 2-engine fleet serves continuous background flood plus a
+    measured short-request set. Leg 1 measures the STEADY-STATE
+    streaming TTFT baseline. Leg 2 repeats the identical measurement
+    while a full release lifecycle churns underneath each sweep: a bad
+    version (negated weights) is rolled forward, its shadow diffs
+    catch the parity regression and auto-roll it back; then a clean
+    version rolls forward, bakes through shadow matches, and is
+    operator-promoted through rolling drain → bind → rejoin.
+
+    Estimator protocol (PR 8/13 lineage): per-request MIN over rounds
+    (each round fully contended), nearest-rank p99 across requests
+    computed UNROUNDED, headline = MEDIAN OF THREE sweeps per leg.
+    Bars: 0 caller-visible failures across BOTH legs (rollback and
+    promotion drains retry inside the router envelope — callers never
+    see them); every completed request bit-identical to the solo
+    oracle (canary_percent=0: live traffic is never steered onto the
+    canary, and shadow dispatches are free-riders); lifecycle-churn
+    p99 within 2.0x of steady-state (per-request min absorbs the
+    drain windows — the bar says churn costs tail, never availability
+    or correctness); after the last sweep the fleet serves the final
+    promoted version with the canary pool reaped and the decision
+    counters telling the whole story.
+    """
+    import gc
+    import statistics
+    import tempfile
+    import threading
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu import telemetry
+    from unionml_tpu.models import Llama, LlamaConfig, make_generator
+    from unionml_tpu.serving.autoscaler import EngineReplicaProvisioner
+    from unionml_tpu.serving.engine import DecodeEngine
+    from unionml_tpu.serving.prefix_cache import RadixPrefixCache
+    from unionml_tpu.serving.rollout import (
+        RolloutController, RolloutPolicy, VersionRegistry,
+    )
+    from unionml_tpu.serving.router import (
+        EngineReplica, FleetRouter, RouterPolicy,
+    )
+
+    backend = jax.default_backend()
+    if backend == "cpu":
+        cfg = LlamaConfig.tiny(vocab_size=256)
+        module = Llama(cfg)
+        tokens0 = jnp.zeros((1, 8), jnp.int32)
+        params = jax.jit(module.init)(jax.random.PRNGKey(0), tokens0)["params"]
+        short_n, rounds, sweeps = 10, 3, 3
+        flood_clients, n_new, slots = 2, 8, 4
+        buckets, chunk_steps, short_len = (16,), 4, 8
+    else:
+        cfg = serving_config("serve_1p5b")
+        module = Llama(cfg)
+        params = random_quantized_params(module)
+        short_n, rounds, sweeps = 24, 3, 3
+        flood_clients, n_new, slots = 4, 32, 8
+        buckets, chunk_steps, short_len = (64,), 8, 48
+
+    # same VALUES, new identity: promotion exercises the full drain →
+    # bind → rejoin machinery without changing one emitted token
+    params_good = jax.tree_util.tree_map(lambda x: jnp.array(x), params)
+    params_bad = jax.tree_util.tree_map(lambda x: -x, params)
+
+    reg = telemetry.MetricsRegistry()
+    flight = telemetry.FlightRecorder()
+
+    def make_engine():
+        return DecodeEngine(
+            module, slots=slots, max_new_tokens=n_new,
+            prompt_buckets=buckets, chunk_steps=chunk_steps,
+            prefix_cache=RadixPrefixCache(registry=reg), registry=reg,
+        )
+
+    engines = [make_engine() for _ in range(2)]
+    canary_engines = []
+
+    def factory():
+        e = make_engine()
+        canary_engines.append(e)
+        return e, params
+
+    router = FleetRouter(
+        [EngineReplica(engines[i], params, name=f"r{i}") for i in range(2)],
+        policy=RouterPolicy(
+            health_ttl_s=0.05, backoff_base_s=0.001, jitter_s=0.0,
+        ),
+        registry=reg, flight=flight,
+    )
+
+    vroot = tempfile.mkdtemp(prefix="unionml_rollout_bench_")
+    vreg = VersionRegistry(vroot)
+    for k in range(1, sweeps + 1):
+        vreg.publish(f"bad-{k}", {"w": np.zeros(2, np.float32)})
+        vreg.publish(f"good-{k}", {"w": np.ones(2, np.float32)})
+    ctl = RolloutController(
+        router, EngineReplicaProvisioner(factory), vreg,
+        policy=RolloutPolicy(
+            canary_replicas=1, canary_percent=0.0, shadow=True,
+            shadow_queue=128, bake_evals=2, sustain_evals=2,
+            auto_promote=False, warm_blocks=0, drain_timeout_s=60.0,
+        ),
+        params_loader=lambda v: (
+            params_bad if v.startswith("bad") else params_good
+        ),
+        registry=reg, flight=flight,
+    )
+
+    # the solo oracle at the engines' exact cache geometry (the disagg
+    # leg's root cause — a padded-length mismatch flips near-tie
+    # argmaxes and reads as lost parity)
+    oracle_len = engines[0].cache_len
+    gen = make_generator(module, max_new_tokens=n_new, max_len=oracle_len)
+    rng = np.random.default_rng(7)
+    shorts = [
+        rng.integers(1, cfg.vocab_size, short_len).tolist()
+        for _ in range(short_n)
+    ]
+    solo = {
+        tuple(p): np.asarray(
+            gen(params, jnp.asarray([p], jnp.int32))
+        )[0].tolist()
+        for p in shorts
+    }
+
+    failures: list = []
+
+    def run_sweep(churn_version_k=None):
+        """One sweep: background flood + measured rounds; when
+        ``churn_version_k`` is set, a choreographer thread drives the
+        full bad-rollback + good-promote lifecycle underneath."""
+        stop = threading.Event()
+
+        def flood_client(seed):
+            crng = np.random.default_rng(seed)
+            while not stop.is_set():
+                p = shorts[int(crng.integers(0, short_n))]
+                try:
+                    out = router.generate(p)
+                    if out != solo[tuple(p)]:
+                        failures.append("flood token mismatch")
+                except BaseException as exc:
+                    failures.append(f"flood: {type(exc).__name__}")
+                    return
+
+        def choreograph(k):
+            try:
+                deadline = time.monotonic() + 120.0
+                ctl.start_rollout(f"bad-{k}")
+                # provisioning ticks through; then shadow divergences
+                # sustain into the automatic rollback
+                while time.monotonic() < deadline:
+                    d = ctl.dashboard()
+                    if d["stage"] == "idle" and any(
+                        h["reason"] == "parity_regression"
+                        for h in d["history"]
+                    ):
+                        break
+                    time.sleep(0.02)
+                else:
+                    failures.append("bad version did not roll back")
+                    return
+                ctl.start_rollout(f"good-{k}")
+                while time.monotonic() < deadline:
+                    d = ctl.dashboard()
+                    if d["stage"] == "baking" and (
+                        d["shadow"]["match"] >= 1
+                    ):
+                        break
+                    time.sleep(0.02)
+                ctl.promote()
+                while time.monotonic() < deadline:
+                    if ctl.dashboard()["stage"] == "idle":
+                        break
+                    time.sleep(0.02)
+                if router.live_version != f"good-{k}":
+                    failures.append(
+                        f"good-{k} did not promote "
+                        f"(live={router.live_version})"
+                    )
+            except BaseException as exc:
+                failures.append(f"choreography: {type(exc).__name__}: {exc}")
+
+        flts = [
+            threading.Thread(target=flood_client, args=(1000 + i,))
+            for i in range(flood_clients)
+        ]
+        chor = None
+        if churn_version_k is not None:
+            ctl.start(interval_s=0.05)
+            chor = threading.Thread(
+                target=choreograph, args=(churn_version_k,)
+            )
+        ttft_min = [math.inf] * short_n
+        gc_was = gc.isenabled()
+        gc.disable()
+        for t in flts:
+            t.start()
+        if chor is not None:
+            chor.start()
+        try:
+            done = False
+            while not done:
+                # keep measuring full rounds until the lifecycle (when
+                # one is running) has completed — churn must overlap
+                # the measurement window, not straddle past it
+                for _ in range(rounds):
+                    for i, p in enumerate(shorts):
+                        try:
+                            t0 = time.perf_counter()
+                            stream = router.generate_stream(p)
+                            out = []
+                            for j, c in enumerate(stream):
+                                if j == 0:
+                                    dt = time.perf_counter() - t0
+                                    ttft_min[i] = min(ttft_min[i], dt)
+                                out.extend(c)
+                            if out != solo[tuple(p)]:
+                                failures.append("short token mismatch")
+                        except BaseException as exc:
+                            failures.append(
+                                f"short: {type(exc).__name__}"
+                            )
+                done = chor is None or not chor.is_alive()
+        finally:
+            stop.set()
+            for t in flts:
+                t.join(timeout=120)
+            if chor is not None:
+                chor.join(timeout=120)
+                ctl.stop()
+            if gc_was:
+                gc.enable()
+        v = sorted(ttft_min)
+        return v[max(0, math.ceil(0.99 * len(v)) - 1)]  # UNROUNDED
+
+    try:
+        for e in engines:
+            e.warmup(params)
+        steady_p99s = [run_sweep() for _ in range(sweeps)]
+        churn_p99s = [run_sweep(k) for k in range(1, sweeps + 1)]
+        assert not failures, failures[:5]
+        steady = statistics.median(steady_p99s)
+        churn = statistics.median(churn_p99s)
+        print(json.dumps({
+            "metric": "serve_rollout_ttft_p99_ms",
+            "steady": round(steady * 1e3, 3),
+            "under_lifecycle_churn": round(churn * 1e3, 3),
+            "value": round(churn * 1e3, 3),
+            "sweeps_steady_ms": [round(x * 1e3, 3) for x in steady_p99s],
+            "sweeps_churn_ms": [round(x * 1e3, 3) for x in churn_p99s],
+            "ratio": round(churn / max(steady, 1e-9), 3),
+            "unit": "ms",
+        }))
+        assert churn <= 2.0 * steady, (
+            f"lifecycle churn p99 {churn * 1e3:.2f} ms blew the bar "
+            f"(2.0x steady-state {steady * 1e3:.2f} ms) — a rollout "
+            "must cost tail latency, never availability"
+        )
+        # the fleet landed on the LAST promoted version with the
+        # canary pool reaped and the ledger at baseline
+        assert router.live_version == f"good-{sweeps}"
+        assert set(router.members()) == {"r0", "r1"}
+        assert len(canary_engines) == 2 * sweeps
+        snap = reg.snapshot()
+        assert snap["unionml_rollout_canary_replicas"] == {"": 0.0}
+        decisions = snap["unionml_rollout_decisions_total"]
+        rollbacks = sum(
+            v for k, v in decisions.items()
+            if "reason=parity_regression" in k
+        )
+        completes = sum(
+            v for k, v in decisions.items() if "reason=complete" in k
+        )
+        assert rollbacks >= sweeps and completes >= sweeps, decisions
+        print(json.dumps({
+            "metric": "serve_rollout_summary",
+            "lifecycles": sweeps,
+            "auto_rollbacks": int(rollbacks),
+            "promotions": int(completes),
+            "caller_visible_failures": 0,
+            "token_parity": "exact",
+            "live_version": router.live_version,
+        }))
+    finally:
+        ctl.close()
+        vreg.close()
+        router.close()
+        for e in engines + canary_engines:
+            e.close()
+
+
 if __name__ == "__main__":
     if os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_tracing":
         if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
@@ -3071,6 +3383,17 @@ if __name__ == "__main__":
                 "workload is hardcoded in fleet_obs_leg"
             )
         fleet_obs_leg()
+    elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_rollout":
+        if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
+            os.environ.get("UNIONML_TPU_BENCH_PREFIX")
+        ):
+            # hardcoded workload, same rule as the other engine legs
+            raise SystemExit(
+                "UNIONML_TPU_BENCH_PRESET=serve_rollout takes no CLI "
+                f"flags or KV/PREFIX env legs (got {sys.argv[1:]}); its "
+                "workload is hardcoded in rollout_leg"
+            )
+        rollout_leg()
     elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_autoscale":
         if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
             os.environ.get("UNIONML_TPU_BENCH_PREFIX")

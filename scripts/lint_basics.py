@@ -321,6 +321,11 @@ TRACE_SPAN_NAMES = (
     # the decode leg — all under one routing rid, joining the engine
     # prefill/prefix-splice families each leg records on its replica
     "prefill-leg", "handoff", "decode-leg",
+    # rollout shadow dispatch (docs/robustness.md "Rollouts &
+    # rollback"): the canary-side duplicate of a live request, on its
+    # own timeline under the live request's trace id so
+    # /debug/trace?rid=<live> stitches both paths
+    "shadow",
 )
 # indexed span families (f-strings with a bounded constant prefix) and
 # the transport server span (f"http {path}" — path is route-bounded)
@@ -414,6 +419,93 @@ def check_span_names(package_root: Path) -> list:
                 problems.append(
                     f"{METRICS_DOC}: span name {name!r} from the "
                     "TRACE_SPAN_NAMES enum is not documented"
+                )
+    return problems
+
+
+ROLLOUT_MODULE = "unionml_tpu/serving/rollout.py"
+ROLLOUT_DOC = "docs/robustness.md"
+# the doc's decision table is fenced by these markers so the reverse
+# direction of the drift check has a bounded region to scan (free-text
+# prose may mention a reason informally without being "the table")
+_ROLLOUT_DOC_BEGIN = "<!-- ROLLOUT_REASONS:begin -->"
+_ROLLOUT_DOC_END = "<!-- ROLLOUT_REASONS:end -->"
+_BACKTICK_TOKEN_RE = re.compile(r"`([a-z0-9_]+)`")
+
+
+def _module_tuple_literal(tree: ast.Module, name: str):
+    """The string elements of a module-level ``NAME = (...)`` tuple
+    assignment, or None when absent/not-a-literal."""
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets
+            )
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            return tuple(
+                e.value for e in node.value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            )
+    return None
+
+
+def check_rollout_reasons(root: Path) -> list:
+    """Two-way drift check between the rollout controller's closed
+    decision vocabulary (``ROLLOUT_DECISIONS``/``ROLLOUT_REASONS`` in
+    serving/rollout.py) and the decision table in docs/robustness.md
+    "Rollouts & rollback" — the DECISION_REASONS/span-name pattern
+    applied to the rollout state machine, so an operator paging
+    through ``unionml_rollout_decisions_total{decision,reason}`` can
+    trust every label value has a documented row."""
+    module_path = root / ROLLOUT_MODULE
+    doc_path = root / ROLLOUT_DOC
+    if not module_path.exists():
+        return [f"{ROLLOUT_MODULE}: missing (rollout drift check needs it)"]
+    try:
+        tree = ast.parse(module_path.read_text(encoding="utf-8"))
+    except SyntaxError:
+        return []  # reported by the per-file checker
+    reasons = _module_tuple_literal(tree, "ROLLOUT_REASONS")
+    decisions = _module_tuple_literal(tree, "ROLLOUT_DECISIONS")
+    problems = []
+    if reasons is None or decisions is None:
+        return [
+            f"{ROLLOUT_MODULE}: ROLLOUT_REASONS/ROLLOUT_DECISIONS must "
+            "be module-level literal tuples (the closed vocabulary the "
+            "doc-drift check parses)"
+        ]
+    if not doc_path.exists():
+        return [f"{ROLLOUT_DOC}: missing (rollout drift check needs it)"]
+    doc_text = doc_path.read_text(encoding="utf-8")
+    for value in decisions + reasons:
+        if f"`{value}`" not in doc_text:
+            problems.append(
+                f"{ROLLOUT_MODULE}: rollout vocabulary value "
+                f"{value!r} is not documented in {ROLLOUT_DOC}"
+            )
+    begin = doc_text.find(_ROLLOUT_DOC_BEGIN)
+    end = doc_text.find(_ROLLOUT_DOC_END)
+    if begin < 0 or end < 0 or end < begin:
+        problems.append(
+            f"{ROLLOUT_DOC}: decision table must be fenced by "
+            f"{_ROLLOUT_DOC_BEGIN} / {_ROLLOUT_DOC_END} markers (the "
+            "reverse drift direction scans that region)"
+        )
+        return problems
+    known = set(decisions) | set(reasons)
+    offset = doc_text[:begin].count("\n") + 1
+    for lineno, line in enumerate(
+        doc_text[begin:end].splitlines(), offset
+    ):
+        for token in _BACKTICK_TOKEN_RE.findall(line):
+            if token not in known:
+                problems.append(
+                    f"{ROLLOUT_DOC}:{lineno}: decision-table token "
+                    f"{token!r} is not in the ROLLOUT_DECISIONS/"
+                    f"ROLLOUT_REASONS vocabulary ({ROLLOUT_MODULE})"
                 )
     return problems
 
@@ -568,6 +660,7 @@ def main(argv) -> int:
         problems.extend(check_metrics_doc(ROOT))
         problems.extend(check_label_cardinality(ROOT / "unionml_tpu"))
         problems.extend(check_span_names(ROOT / "unionml_tpu"))
+        problems.extend(check_rollout_reasons(ROOT))
     for p in problems:
         print(p)
     print(f"lint_basics: {len(files)} files, {len(problems)} problem(s)")

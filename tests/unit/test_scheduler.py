@@ -59,6 +59,10 @@ def trained_model(model):
 
 
 def _solo(module, params, prompt, n_new, max_len=256):
+    # Oracle discipline: pass max_len=engine.cache_len when comparing
+    # against an engine.  A padded-length mismatch reorders the padded
+    # attention reductions, and a bf16 near-tie argmax can flip on that
+    # alone -- which a parity assert reads as lost token parity.
     gen = make_generator(module, max_new_tokens=n_new, max_len=max_len)
     return np.asarray(gen(params, jnp.asarray([prompt], jnp.int32)))[0].tolist()
 
@@ -317,8 +321,8 @@ def test_preempted_stream_resumes_with_token_parity(tiny_llama):
         assert not t.is_alive(), "low stream hung"
         assert not low_err, f"caller-visible failure: {low_err}"
         # bit-identical to the uncontended solo runs
-        assert high_out == _solo(module, params, high_prompt, 8)
-        assert low_out == _solo(module, params, low_prompt, 48)
+        assert high_out == _solo(module, params, high_prompt, 8, max_len=engine.cache_len)
+        assert low_out == _solo(module, params, low_prompt, 48, max_len=engine.cache_len)
         sched = engine.stats()["scheduler"]
         assert sched["preemptions"] >= 1
         kinds = [e["kind"] for e in flight.dump()]
@@ -389,9 +393,9 @@ def test_high_priority_promotes_past_parked_head(tiny_llama):
             t.join(timeout=120)
         assert not any(t.is_alive() for t in (t_a, t_b, t_c))
         assert not errors, f"caller-visible failures: {errors}"
-        assert results["a"] == _solo(module, params, p_a, 40)
-        assert results["b"] == _solo(module, params, p_b, 40)
-        assert results["c"] == _solo(module, params, p_c, 8)
+        assert results["a"] == _solo(module, params, p_a, 40, max_len=engine.cache_len)
+        assert results["b"] == _solo(module, params, p_b, 40, max_len=engine.cache_len)
+        assert results["c"] == _solo(module, params, p_c, 8, max_len=engine.cache_len)
         promotes = [e for e in flight.dump() if e["kind"] == "promote"]
         assert promotes and promotes[0]["priority"] == "high"
         assert promotes[0]["past_priority"] == "low"
@@ -412,7 +416,7 @@ def test_equal_priority_contention_parks_fifo(tiny_llama):
         prompts = [rng.integers(1, 97, 8).tolist() for _ in range(3)]
         outs = engine.generate(params, prompts, max_new_tokens=8)
         for p, out in zip(prompts, outs):
-            assert out == _solo(module, params, p, 8)
+            assert out == _solo(module, params, p, 8, max_len=engine.cache_len)
         assert engine.stats()["scheduler"]["preemptions"] == 0
         _assert_pool_drained(engine)
     finally:
@@ -476,12 +480,12 @@ def test_preemption_under_recovery_leaks_nothing(tiny_llama):
         )
         # completed requests (if any) are solo-parity
         for prompt, n, out in results:
-            assert out == _solo(module, params, prompt, n)
+            assert out == _solo(module, params, prompt, n, max_len=engine.cache_len)
         # the engine still serves after the storm
         probe = rng.integers(1, 97, 8).tolist()
         assert engine.generate(
             params, [probe], max_new_tokens=8
-        )[0] == _solo(module, params, probe, 8)
+        )[0] == _solo(module, params, probe, 8, max_len=engine.cache_len)
         _assert_pool_drained(engine)
         _assert_no_live_leases(engine.prefix_cache)
     finally:
@@ -504,7 +508,7 @@ def test_mix_budget_token_parity(tiny_llama):
         prompts = [rng.integers(1, 97, 50).tolist() for _ in range(3)]
         outs = engine.generate(params, prompts)
         for p, out in zip(prompts, outs):
-            assert out == _solo(module, params, p, 5)
+            assert out == _solo(module, params, p, 5, max_len=engine.cache_len)
         _assert_pool_drained(engine)
     finally:
         engine.close()

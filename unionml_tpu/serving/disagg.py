@@ -229,13 +229,16 @@ class DisaggRouter(FleetRouter):
 
     def _pick(
         self, prompt: Sequence[int], exclude: Sequence[str] = (),
+        **kw,
     ) -> ReplicaHandle:
         """The inherited scored pick, constrained to the ambient leg's
         pool: replicas of the OTHER dedicated phase are excluded
         (colocated replicas serve either leg). The exclusion is
         re-derived on every call, so the envelope's repeat-pick
         fallback can never leak a decode stream onto the prefill
-        pool."""
+        pool. Version constraints (``version=``/``version_soft=``,
+        docs/robustness.md "Rollouts & rollback") pass through to the
+        base pick and compose with the phase filter."""
         phase = _current_dispatch_phase()
         if phase is not None:
             with self._lock:
@@ -246,7 +249,7 @@ class DisaggRouter(FleetRouter):
                 ]
             if wrong:
                 exclude = list(exclude) + wrong
-        return super()._pick(prompt, exclude=exclude)
+        return super()._pick(prompt, exclude=exclude, **kw)
 
     def _has_routable_phase(self, phase: str) -> bool:
         """Does a DEDICATED ``phase`` replica look routable right now?
@@ -282,9 +285,14 @@ class DisaggRouter(FleetRouter):
                 "router is draining", reason="draining",
             )
         self._deposit_budget()
+        # resolved once on the caller's thread: BOTH legs of a pinned/
+        # split request must land on the same model version, or the
+        # decode leg would splice KV produced under different weights
+        version, version_soft, excl_version = self._resolve_route_version()
         rid, t_ctx, tracer = self._open_timeline(len(prompt))
         inner = self._two_leg_stream(
             rid, [int(t) for t in prompt], max_new_tokens, t_ctx, tracer,
+            version, version_soft, excl_version,
         )
         if t_ctx is None:
             return inner
@@ -303,7 +311,9 @@ class DisaggRouter(FleetRouter):
             self.generate_stream(prompt, max_new_tokens=max_new_tokens)
         )
 
-    def _two_leg_stream(self, rid, prompt, max_new_tokens, t_ctx, tracer):
+    def _two_leg_stream(self, rid, prompt, max_new_tokens, t_ctx, tracer,
+                        version=None, version_soft=True,
+                        exclude_version=None):
         handle: Optional[dict] = None
         prefill_replica: Optional[ReplicaHandle] = None
         emitted = 0
@@ -337,7 +347,12 @@ class DisaggRouter(FleetRouter):
                         for chunk in self._stream_with_failover(
                             rid, prompt, max_new_tokens=max_new_tokens,
                             dispatch=prefill_dispatch, t_ctx=t_ctx,
-                            tracer=tracer,
+                            tracer=tracer, version=version,
+                            version_soft=version_soft,
+                            exclude_version=exclude_version,
+                            # a prefill leg's 1-token result is not a
+                            # full answer: never offer it for shadowing
+                            notify_rollout=False,
                         ):
                             emitted += len(chunk)
                             yield chunk  # the TTFT emission
@@ -410,6 +425,8 @@ class DisaggRouter(FleetRouter):
                 for chunk in self._stream_with_failover(
                     rid, prompt, max_new_tokens=max_new_tokens,
                     dispatch=decode_dispatch, t_ctx=t_ctx, tracer=tracer,
+                    version=version, version_soft=version_soft,
+                    exclude_version=exclude_version,
                 ):
                     # the decode engine deterministically regenerates
                     # the first token(s) the prefill leg already

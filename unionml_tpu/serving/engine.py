@@ -535,6 +535,13 @@ class DecodeEngine:
         # fleet's telemetry is attributable per pool, and the
         # phase-aware router picks by it.
         self.phase = validate_phase(phase)
+        # model version currently bound into this engine (docs/
+        # robustness.md "Rollouts & rollback"): set by the rollout
+        # controller's bind()-then-tag choreography (and by
+        # EngineReplica(version=...)), None when nobody versioned the
+        # weights. Rides usage vectors so per-tenant billing splits by
+        # model version during a canary bake.
+        self.model_version: Optional[str] = None
         self.draft = draft_module
         self.speculate_k = int(speculate_k)
         if self.draft is not None:
@@ -2845,6 +2852,7 @@ class DecodeEngine:
                         cached_tokens=req._saved_tokens,
                         priority=req.priority,
                         phase=self.phase,
+                        version=self.model_version,
                     )
             self._flight_rec(
                 "finish", rid=req.rid, tenant=req.tenant, slot=slot,

@@ -22,7 +22,12 @@ from unionml_tpu.serving.faults import (
     parse_deadline_header,
 )
 from unionml_tpu.serving.http import ServingApp
-from unionml_tpu.serving.scheduler import priority_scope, validate_priority
+from unionml_tpu.serving.scheduler import (
+    model_version_scope,
+    priority_scope,
+    validate_model_version,
+    validate_priority,
+)
 from unionml_tpu.serving.usage import tenant_scope, validate_tenant
 
 
@@ -100,6 +105,14 @@ def serving_app(
         except ValueError as exc:
             raise HTTPException(status_code=422, detail=str(exc))
 
+    def _parse_model_version(request) -> str:
+        try:  # the shared validator: same 422 contract as stdlib
+            return validate_model_version(
+                request.headers.get("x-model-version")
+            )
+        except ValueError as exc:
+            raise HTTPException(status_code=422, detail=str(exc))
+
     def _fault_http(
         exc: Exception, rid: Optional[str] = None
     ) -> "HTTPException":
@@ -157,7 +170,9 @@ def serving_app(
                 # scopes must live on the threadpool thread that
                 # submits to the engine/batcher, not the event loop's
                 with tenant_scope(_parse_tenant(request)):
-                    with priority_scope(_parse_priority(request)):
+                    with priority_scope(_parse_priority(request)), \
+                            model_version_scope(
+                                _parse_model_version(request)):
                         with deadline_scope(_parse_deadline(request)):
                             return core.predict(payload)
         except _FAULTS as exc:
@@ -190,7 +205,9 @@ def serving_app(
         try:
             with telemetry.trace_scope(ctx):
                 with tenant_scope(_parse_tenant(request)):
-                    with priority_scope(_parse_priority(request)):
+                    with priority_scope(_parse_priority(request)), \
+                            model_version_scope(
+                                _parse_model_version(request)):
                         with deadline_scope(_parse_deadline(request)):
                             frames = core.predict_stream_events(payload)
         except _FAULTS as exc:
@@ -336,6 +353,13 @@ def serving_app(
         except ValueError as exc:
             raise HTTPException(status_code=422, detail=str(exc))
 
+    @app.get("/debug/rollout")
+    async def debug_rollout():
+        try:
+            return core.debug_rollout()
+        except ValueError as exc:
+            raise HTTPException(status_code=422, detail=str(exc))
+
     # one middleware gives every route the X-Request-ID header, the
     # traceparent echo (predict endpoints already set their recorded
     # server context — setdefault keeps it), and the per-endpoint
@@ -347,10 +371,13 @@ def serving_app(
         t0 = time.perf_counter()
         try:
             # same boundary validation as the stdlib transport: a
-            # hostile X-Tenant-ID or X-Priority answers 422 before
-            # any route runs
+            # hostile X-Tenant-ID, X-Priority, or X-Model-Version
+            # answers 422 before any route runs
             tenant = validate_tenant(request.headers.get("x-tenant-id"))
             priority = validate_priority(request.headers.get("x-priority"))
+            model_version = validate_model_version(
+                request.headers.get("x-model-version")
+            )
         except ValueError as exc:
             from fastapi.responses import JSONResponse
 
@@ -380,6 +407,7 @@ def serving_app(
             response.headers["X-Request-ID"] = rid
         response.headers["X-Tenant-ID"] = tenant
         response.headers["X-Priority"] = priority
+        response.headers["X-Model-Version"] = model_version
         if "traceparent" not in response.headers:
             response.headers["traceparent"] = telemetry.format_traceparent(
                 telemetry.server_trace_context(

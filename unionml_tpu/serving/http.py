@@ -81,6 +81,15 @@ every response and predict routes open a
 submissions enter the preemptive scheduler's waiting room under the
 caller's class.
 
+Model-version pinning (docs/robustness.md "Rollouts & rollback"):
+every request may carry an ``X-Model-Version`` header (a registry
+version slug, default ``auto`` = route wherever the rollout split
+says; malformed slugs answer **422** — the grammar is closed). The
+validated value is echoed on every response and predict routes open a
+:func:`~unionml_tpu.serving.scheduler.model_version_scope`, so a
+version-aware router pins the request to replicas serving exactly
+those weights.
+
 Distributed tracing (docs/observability.md): every request parses an
 inbound W3C ``traceparent`` header (a fresh root is minted when absent
 or malformed — tracing metadata can never 5xx a request) and the
@@ -134,9 +143,12 @@ from unionml_tpu.serving.faults import (
     parse_deadline_header,
 )
 from unionml_tpu.serving.scheduler import (
+    DEFAULT_MODEL_VERSION,
     DEFAULT_PRIORITY,
+    model_version_scope,
     priority_scope,
     token_cap_scope,
+    validate_model_version,
     validate_priority,
     validate_token_cap,
 )
@@ -152,7 +164,7 @@ KNOWN_ROUTES = (
     "/", "/predict", "/predict/stream", "/health", "/stats", "/metrics",
     "/debug/profile", "/debug/memory", "/debug/flight", "/debug/trace",
     "/debug/slo", "/debug/usage", "/debug/cache/peek", "/debug/fleet",
-    "/debug/kv/export", "/debug/kv/import",
+    "/debug/rollout", "/debug/kv/export", "/debug/kv/import",
 )
 
 # the routes that open a RECORDED trace timeline (a server span the
@@ -661,6 +673,16 @@ class ServingApp:
             "make_router_app for the fleet dashboard"
         )
 
+    def debug_rollout(self) -> dict:
+        """``GET /debug/rollout``: the rollout operator dashboard —
+        only a router app whose :class:`~unionml_tpu.serving.rollout
+        .RolloutController` is attached has one to report. Raises
+        ``ValueError`` (→ 422) here."""
+        raise ValueError(
+            "no rollout controller on this app — serve a FleetRouter "
+            "via make_router_app and attach a RolloutController"
+        )
+
     def debug_slo(self) -> dict:
         """``GET /debug/slo``: a fresh SLO watchdog evaluation (burn
         rates per objective and window, breach flags). Raises
@@ -879,6 +901,7 @@ class ServingApp:
             _trace_ctx: Optional[telemetry.TraceContext] = None
             _tenant = DEFAULT_TENANT
             _priority = DEFAULT_PRIORITY
+            _model_version = DEFAULT_MODEL_VERSION
 
             def log_message(self, fmt, *args):
                 logger.info(f"http: {fmt % args}")
@@ -895,6 +918,7 @@ class ServingApp:
                 self.send_header("X-Request-ID", self._rid)
                 self.send_header("X-Tenant-ID", self._tenant)
                 self.send_header("X-Priority", self._priority)
+                self.send_header("X-Model-Version", self._model_version)
                 if self._trace_ctx is not None:
                     self.send_header(
                         "traceparent",
@@ -934,6 +958,9 @@ class ServingApp:
                         self._priority = validate_priority(
                             self.headers.get("X-Priority")
                         )
+                        self._model_version = validate_model_version(
+                            self.headers.get("X-Model-Version")
+                        )
                     except ValueError as exc:
                         self._trace_ctx = telemetry.server_trace_context(
                             raw_tp
@@ -954,7 +981,9 @@ class ServingApp:
                             # visible to engine/batcher submissions on
                             # this request thread (deadline-scope-style)
                             with tenant_scope(self._tenant), \
-                                    priority_scope(self._priority):
+                                    priority_scope(self._priority), \
+                                    model_version_scope(
+                                        self._model_version):
                                 handler()
                     else:
                         self._trace_ctx = telemetry.server_trace_context(raw_tp)
@@ -1040,6 +1069,11 @@ class ServingApp:
                         self._send(200, app.debug_fleet())
                     except ValueError as exc:
                         self._send(422, {"error": str(exc)})
+                elif path == "/debug/rollout":
+                    try:
+                        self._send(200, app.debug_rollout())
+                    except ValueError as exc:
+                        self._send(422, {"error": str(exc)})
                 else:
                     self._send(404, {"error": f"no route {path}"})
 
@@ -1058,6 +1092,7 @@ class ServingApp:
                 self.send_header("X-Request-ID", self._rid)
                 self.send_header("X-Tenant-ID", self._tenant)
                 self.send_header("X-Priority", self._priority)
+                self.send_header("X-Model-Version", self._model_version)
                 if self._trace_ctx is not None:
                     self.send_header(
                         "traceparent",

@@ -74,8 +74,11 @@ from unionml_tpu.serving.faults import (
     deadline_scope,
 )
 from unionml_tpu.serving.scheduler import (
+    DEFAULT_MODEL_VERSION,
+    current_model_version,
     current_priority,
     current_token_cap,
+    model_version_scope,
     priority_scope,
     token_cap_scope,
     validate_phase,
@@ -138,6 +141,13 @@ class ReplicaHandle:
     # routes by it; fleet_report / GET /debug/fleet tag replicas with
     # it so the operator dashboard shows per-pool state.
     phase: str = "colocated"
+
+    # which model version this replica serves (docs/robustness.md
+    # "Rollouts & rollback"): None = the fleet's implicit live version
+    # (the router substitutes its `live_version`). The RolloutController
+    # stamps canaries and promoted replicas; the version-aware pick and
+    # every observability surface key on it.
+    version: Optional[str] = None
 
     def generate_stream(
         self, prompt: Sequence[int], *, max_new_tokens: Optional[int] = None,
@@ -317,11 +327,18 @@ class EngineReplica(ReplicaHandle):
     """
 
     def __init__(self, engine, params, *, name: str, slo=None,
-                 phase: Optional[str] = None):
+                 phase: Optional[str] = None,
+                 version: Optional[str] = None):
         self.engine = engine
         self.params = params
         self.name = name
         self._slo = slo
+        # the model version these weights are (None = the fleet's live
+        # version); stamped onto the engine so its usage vectors carry
+        # the same tag
+        self.version = version
+        if version is not None:
+            engine.model_version = version
         # phase defaults to the engine's own declaration, so a
         # DecodeEngine(phase="prefill") replica routes correctly
         # without repeating itself at wrap time
@@ -505,6 +522,12 @@ class HttpReplica(ReplicaHandle):
         # validates + re-opens it, so a routed high-priority request
         # keeps its preemption rights on the replica's engine
         headers["X-Priority"] = current_priority()
+        # the model-version pin survives the hop too: a pinned request
+        # routed through a fronting router must hit the same version
+        # on the inner fleet (the X-Priority re-emission pattern)
+        version = current_model_version()
+        if version != DEFAULT_MODEL_VERSION:
+            headers["X-Model-Version"] = version
         ctx = telemetry.current_trace_context()
         if ctx is not None:
             headers["traceparent"] = telemetry.format_traceparent(ctx)
@@ -1121,6 +1144,18 @@ class FleetRouter:
         # pool back-compat view).
         self.autoscaler = None
         self.autoscalers: Dict[str, object] = {}
+        # model-version rollout state (docs/robustness.md "Rollouts &
+        # rollback"): `rollout` is set by a RolloutController operating
+        # this router (fleet_report / GET /debug/rollout read through
+        # it, and every successful live dispatch offers itself for
+        # shadowing through it). `live_version` is the version every
+        # version-less replica implicitly serves; the split steers a
+        # percentage / per-tenant slice of UNPINNED traffic to the
+        # canary version while a rollout bakes.
+        self.rollout = None
+        self.live_version: Optional[str] = None
+        self._version_split: Optional[dict] = None
+        self._split_counter = 0
         self._build_instruments()
         self._g_live.set_function(self._live_count)
 
@@ -1243,6 +1278,82 @@ class FleetRouter:
         if rid is not None and tracer is not None:
             tracer.finish_request(rid)
 
+    # -- model-version routing (docs/robustness.md "Rollouts & rollback") --
+
+    def _replica_version(self, handle: ReplicaHandle) -> Optional[str]:
+        """The version ``handle`` serves: its own stamp, else the
+        fleet's implicit live version."""
+        return getattr(handle, "version", None) or self.live_version
+
+    def set_version_split(
+        self, version: str, *, percent: float = 0.0,
+        tenants: Optional[Dict[str, str]] = None,
+    ) -> None:
+        """Steer a slice of UNPINNED traffic to ``version``:
+        ``percent`` of requests (deterministic stride — no RNG, so
+        chaos tests replay exactly) plus every request from a tenant
+        in ``tenants`` (tenant → version). Split assignment is SOFT —
+        when no routable replica serves the split version, the pick
+        falls back to live capacity (a dying canary sheds its share,
+        it never fails a caller). A hard ``X-Model-Version`` pin
+        bypasses the split entirely."""
+        if not 0.0 <= float(percent) <= 100.0:
+            raise ValueError(
+                f"split percent must be in [0, 100], got {percent}"
+            )
+        with self._lock:
+            self._version_split = {
+                "version": version,
+                "percent": float(percent),
+                "tenants": dict(tenants or {}),
+            }
+            self._split_counter = 0
+
+    def clear_version_split(self) -> None:
+        with self._lock:
+            self._version_split = None
+
+    def version_split(self) -> Optional[dict]:
+        """The active split spec (a copy), or ``None``."""
+        with self._lock:
+            split = self._version_split
+            return None if split is None else {
+                "version": split["version"],
+                "percent": split["percent"],
+                "tenants": dict(split["tenants"]),
+            }
+
+    def _resolve_route_version(
+        self,
+    ) -> Tuple[Optional[str], bool, Optional[str]]:
+        """``(version, soft, exclude_version)`` for one request: a
+        hard ``X-Model-Version`` pin wins (strict — an unknown version
+        is a 422, an unroutable one a 503), else the rollout split
+        assigns softly (percentage stride / tenant pin, falling back
+        to live when the canary is unroutable). Unpinned traffic the
+        split did NOT assign carries the split version as a soft
+        EXCLUSION — the canary gets exactly its share, never
+        load-balancer spillover on top of it."""
+        pin = current_model_version()
+        if pin != DEFAULT_MODEL_VERSION:
+            return pin, False, None
+        with self._lock:
+            split = self._version_split
+            if split is None:
+                return None, True, None
+            tenant = current_tenant()
+            if tenant in split["tenants"]:
+                return split["tenants"][tenant], True, None
+            percent = split["percent"]
+            if percent > 0.0:
+                self._split_counter += 1
+                # deterministic percentage stride over the unit circle:
+                # floor(c*p/100) advances exactly on the canary's share
+                c = self._split_counter
+                if (c * percent) // 100.0 > ((c - 1) * percent) // 100.0:
+                    return split["version"], True, None
+            return None, True, split["version"]
+
     # -- membership / choreography ----------------------------------------
 
     def members(self) -> Dict[str, ReplicaHandle]:
@@ -1272,6 +1383,7 @@ class FleetRouter:
             replicas[name] = {
                 "state": s["state"],
                 "phase": phase,
+                "version": s.get("version"),
                 "status": h.get("status", "unknown"),
                 "queue_depth": h.get("queue_depth", 0),
                 "breaker_open": bool(h.get("breaker_open", False)),
@@ -1292,10 +1404,19 @@ class FleetRouter:
             "status": health["status"],
             "live_replicas": health["live_replicas"],
             "min_live": health["min_live"],
+            "live_version": self.live_version,
             "retry_budget_tokens": round(budget, 3),
             "replicas": replicas,
             "phases": phases,
         }
+        rollout = self.rollout
+        if rollout is not None:
+            try:
+                report["rollout"] = rollout.dashboard()
+            except BaseException as exc:
+                # a mid-teardown controller degrades the dashboard,
+                # never breaks /debug/fleet
+                report["rollout"] = {"error": str(exc)}
         auto = self.autoscaler
         if auto is not None:
             try:
@@ -1491,6 +1612,7 @@ class FleetRouter:
             out[state.handle.name] = {
                 "state": state.state,
                 "phase": getattr(state.handle, "phase", "colocated"),
+                "version": self._replica_version(state.handle),
                 "health": dict(health),
                 "cache_blocks": blocks,
                 "consecutive_failures": state.consecutive_failures,
@@ -1515,6 +1637,24 @@ class FleetRouter:
             except BaseException:
                 continue  # a peek failure must never fail the probe
         return best
+
+    def _notify_rollout(
+        self, rid: str, name: str, prompt, max_new_tokens,
+        tokens: List[int],
+    ) -> None:
+        """Offer one completed live dispatch to the rollout controller
+        for shadowing. Never raises into the dispatch path, and costs
+        one attribute read when no rollout is operating."""
+        rollout = self.rollout
+        if rollout is None:
+            return
+        try:
+            rollout.observe_live(
+                rid=rid, replica=name, prompt=prompt,
+                max_new_tokens=max_new_tokens, tokens=tokens,
+            )
+        except BaseException:
+            pass
 
     def _note_latency(self, name: str, seconds: float) -> None:
         """One successful dispatch's wall time: feeds the fleet-wide
@@ -1655,11 +1795,25 @@ class FleetRouter:
 
     def _pick(
         self, prompt: Sequence[int], exclude: Sequence[str] = (),
+        version: Optional[str] = None, version_soft: bool = True,
+        exclude_version: Optional[str] = None,
     ) -> ReplicaHandle:
         """Choose the dispatch target: over routable candidates, score
         ``cache_w * cached_fraction - queue_w * queue_depth -
         burn_w * burn`` and take the max (ties: round-robin). Raises
-        :class:`EngineUnavailable` when nothing is routable."""
+        :class:`EngineUnavailable` when nothing is routable.
+
+        ``version`` narrows the candidate set to replicas serving that
+        model version. A SOFT constraint (rollout split assignment)
+        falls back to the full routable set when nothing serves it —
+        a dying canary sheds its traffic share, never a caller error.
+        A HARD constraint (``X-Model-Version`` pin) raises: the
+        retryable :class:`EngineUnavailable` when the version exists
+        but nothing serving it is routable right now, ``ValueError``
+        (the deterministic 422 class) when the version is unknown to
+        the fleet. ``exclude_version`` is the soft inverse: prefer
+        candidates NOT serving that version (how unassigned traffic
+        stays off the canary while a split is open)."""
         t0 = time.perf_counter()
         now = self._clock()
         with self._lock:
@@ -1682,6 +1836,46 @@ class FleetRouter:
                     candidates.append(state)
             rr = self._rr
             self._rr += 1
+        if version is not None:
+            matched = [
+                c for c in candidates
+                if self._replica_version(c.handle) == version
+            ]
+            if matched:
+                candidates = matched
+            elif not version_soft:
+                with self._lock:
+                    known = {
+                        self._replica_version(s.handle)
+                        for s in self._replicas.values()
+                    }
+                known.discard(None)
+                if self.live_version is not None:
+                    known.add(self.live_version)
+                if version in known:
+                    raise EngineUnavailable(
+                        f"no routable replica serves model version "
+                        f"{version!r}",
+                        reason="no_live_replicas", retry_after_s=1.0,
+                    )
+                raise ValueError(
+                    f"unknown model version {version!r} — this fleet "
+                    f"serves {sorted(known)}"
+                )
+            # soft + no match: fall through on the full candidate set
+        elif exclude_version is not None:
+            # the inverse constraint: unpinned traffic NOT assigned to
+            # the split keeps off the split version's replicas (the
+            # canary receives exactly its percent/tenant share, never
+            # load-balancer spillover). Soft — when ONLY split-version
+            # capacity is live (promote endgame, mass ejection) serving
+            # beats refusing.
+            kept = [
+                c for c in candidates
+                if self._replica_version(c.handle) != exclude_version
+            ]
+            if kept:
+                candidates = kept
         if not candidates:
             raise EngineUnavailable(
                 "no live replicas (all ejected, draining, or excluded)",
@@ -1828,10 +2022,14 @@ class FleetRouter:
                 "router is draining", reason="draining",
             )
         self._deposit_budget()
+        # resolved ONCE, on the caller's thread (the pin is thread-
+        # local), so every retry of this request stays on one version
+        version, version_soft, excl_version = self._resolve_route_version()
         rid, t_ctx, tracer = self._open_timeline(len(prompt))
         inner = self._stream_with_failover(
             rid, prompt, max_new_tokens=max_new_tokens, t_ctx=t_ctx,
-            tracer=tracer,
+            tracer=tracer, version=version, version_soft=version_soft,
+            exclude_version=excl_version,
         )
         if t_ctx is None:
             return inner
@@ -1844,7 +2042,10 @@ class FleetRouter:
 
     def _stream_with_failover(self, rid, prompt, *, max_new_tokens,
                               dispatch=None, initial_exclude=(),
-                              t_ctx=None, tracer=None):
+                              t_ctx=None, tracer=None,
+                              version=None, version_soft=True,
+                              exclude_version=None,
+                              notify_rollout=True):
         """The retry envelope. ``dispatch(replica) -> chunk iterator``
         defaults to the replica's streaming primitive; the blocking
         path passes a single-yield wrapper over ``replica.generate``
@@ -1860,13 +2061,18 @@ class FleetRouter:
         recorder captured at open (a mid-request swap must not split a
         timeline across recorders)."""
         emitted = 0          # tokens already yielded to the caller
+        collected: List[int] = []   # the full live answer (shadow diff)
         attempt = 1
         tried: List[str] = list(initial_exclude)
         last_exc: Optional[BaseException] = None
         while attempt <= self.policy.max_attempts:
             t_pick0 = time.perf_counter()
             try:
-                replica = self._pick(prompt, exclude=tried)
+                replica = self._pick(
+                    prompt, exclude=tried,
+                    version=version, version_soft=version_soft,
+                    exclude_version=exclude_version,
+                )
             except EngineUnavailable:
                 # every distinct replica tried: allow a repeat pick
                 # (the survivor set may have recovered) only if some
@@ -1875,7 +2081,11 @@ class FleetRouter:
                     raise
                 tried = tried[-1:]
                 try:
-                    replica = self._pick(prompt, exclude=tried)
+                    replica = self._pick(
+                        prompt, exclude=tried,
+                        version=version, version_soft=version_soft,
+                        exclude_version=exclude_version,
+                    )
                 except EngineUnavailable:
                     if last_exc is not None:
                         raise last_exc
@@ -1887,7 +2097,13 @@ class FleetRouter:
                     replica=name, attempt=attempt,
                 )
             if attempt == 1:
-                self._flight.record("route", rid=rid, replica=name)
+                rver = self._replica_version(replica)
+                if rver is not None:
+                    self._flight.record(
+                        "route", rid=rid, replica=name, version=rver,
+                    )
+                else:
+                    self._flight.record("route", rid=rid, replica=name)
             else:
                 self._m_retries.labels(name).inc()
             attempt_span = (
@@ -1923,10 +2139,23 @@ class FleetRouter:
                     out = chunk[skip:] if skip else chunk
                     skip = 0
                     emitted += len(out)
+                    collected.extend(out)
                     yield out
                 self._note_latency(name, time.perf_counter() - t0)
                 self._record_success(name)
                 self._m_routed.labels(name, "ok").inc()
+                # the shadow hook: the complete live answer (replay-
+                # skip makes `collected` whole across retries) is
+                # offered to an operating RolloutController for
+                # duplicate dispatch onto the canary. Strictly
+                # free-rider — enqueue-only, exception-proof, after
+                # the caller already has every token. A partial-answer
+                # leg (disagg prefill) opts out: a 1-token leg result
+                # must not diff against a full canary answer.
+                if notify_rollout:
+                    self._notify_rollout(
+                        rid, name, prompt, max_new_tokens, collected,
+                    )
                 if tracer is not None:
                     tracer.record_span(
                         rid, "attempt", t0, time.perf_counter(),
@@ -2007,6 +2236,7 @@ class FleetRouter:
                 "router is draining", reason="draining",
             )
         self._deposit_budget()
+        version, version_soft, excl_version = self._resolve_route_version()
         rid, t_ctx, tracer = self._open_timeline(len(prompt))
         try:
             return self._collect(self._stream_with_failover(
@@ -2015,6 +2245,8 @@ class FleetRouter:
                     [rep.generate(prompt, max_new_tokens=max_new_tokens)]
                 ),
                 t_ctx=t_ctx, tracer=tracer,
+                version=version, version_soft=version_soft,
+                exclude_version=excl_version,
             ))
         finally:
             self._finish_timeline(tracer, rid)
@@ -2045,6 +2277,10 @@ class FleetRouter:
         self, rid, t_ctx, tracer, prompt, max_new_tokens,
     ) -> List[int]:
         delay_s = self._hedge_delay_s()
+        # resolved on the caller's thread (pin/split are thread-local /
+        # counter-ordered): both hedge lanes dispatch the SAME version,
+        # or deterministic decode could not guarantee identical tokens
+        version, version_soft, excl_version = self._resolve_route_version()
         done = threading.Event()
         results: List = [None, None]   # per-lane (tokens | exception)
         lanes: List[Optional[str]] = [None, None]
@@ -2115,7 +2351,11 @@ class FleetRouter:
                         priority_scope(priority), \
                         token_cap_scope(token_cap), \
                         telemetry.trace_scope(lane_ctx), _rid_scope(rid):
-                    replica = self._pick(prompt, exclude=exclude)
+                    replica = self._pick(
+                        prompt, exclude=exclude,
+                        version=version, version_soft=version_soft,
+                        exclude_version=excl_version,
+                    )
                     lanes[idx] = replica.name
                     t0 = time.perf_counter()
                     out: List[int] = []
@@ -2246,6 +2486,8 @@ class FleetRouter:
                     ),
                     initial_exclude=failed,
                     t_ctx=t_ctx, tracer=tracer,
+                    version=version, version_soft=version_soft,
+                    exclude_version=excl_version,
                 ))
             if last is not None:
                 raise last
@@ -2270,6 +2512,9 @@ class FleetRouter:
                 self._m_routed.labels(lose, "hedge_lose").inc()
                 if tracer is not None:
                     tracer.record_event(rid, "hedge_lose", replica=lose)
+        self._notify_rollout(
+            rid, win_name, prompt, max_new_tokens, results[w],
+        )
         return results[w]
 
 
@@ -2781,6 +3026,19 @@ def make_router_app(router: FleetRouter, *, name: str = "fleet-router",
             one is attached."""
             return self.router.fleet_report()
 
+        def debug_rollout(self) -> dict:
+            """``GET /debug/rollout``: the rollout operator surface —
+            stage, canary pool, split spec, shadow diff stats, streaks
+            and decision history (docs/robustness.md "Rollouts &
+            rollback"). 422 when no controller operates this router."""
+            rollout = self.router.rollout
+            if rollout is None:
+                raise ValueError(
+                    "no rollout controller operates this router — "
+                    "construct a RolloutController(router, ...) first"
+                )
+            return rollout.dashboard()
+
         def predict(self, payload: dict):
             if self._draining:
                 raise EngineUnavailable(
@@ -2799,6 +3057,9 @@ def make_router_app(router: FleetRouter, *, name: str = "fleet-router",
             deadline = current_deadline_ms()
             tenant = current_tenant()
             priority = current_priority()
+            # the version pin is thread-local like the rest: a pinned
+            # multi-row predict must pin EVERY row's dispatch
+            version_pin = current_model_version()
             trace_ctx = telemetry.current_trace_context()
             results: List = [None] * len(rows)
 
@@ -2806,6 +3067,7 @@ def make_router_app(router: FleetRouter, *, name: str = "fleet-router",
                 try:
                     with deadline_scope(deadline), tenant_scope(tenant), \
                             priority_scope(priority), \
+                            model_version_scope(version_pin), \
                             telemetry.trace_scope(trace_ctx):
                         results[i] = self.router.generate(
                             rows[i], max_new_tokens=cap,

@@ -64,6 +64,7 @@ from typing import Any, Dict, Iterator, List, Mapping, Optional, Tuple
 from unionml_tpu import telemetry
 
 __all__ = [
+    "DEFAULT_MODEL_VERSION",
     "DEFAULT_PHASE",
     "DEFAULT_PRIORITY",
     "PHASES",
@@ -71,10 +72,13 @@ __all__ = [
     "PreemptiveScheduler",
     "SchedulerConfig",
     "WaitingRoom",
+    "current_model_version",
     "current_priority",
     "current_token_cap",
+    "model_version_scope",
     "priority_scope",
     "token_cap_scope",
+    "validate_model_version",
     "validate_phase",
     "validate_priority",
     "validate_token_cap",
@@ -168,6 +172,79 @@ def current_priority() -> str:
     :data:`DEFAULT_PRIORITY`."""
     priority = getattr(_priority_tls, "priority", None)
     return priority if priority else DEFAULT_PRIORITY
+
+
+# model-version request pinning (docs/robustness.md "Rollouts &
+# rollback"): the ``X-Model-Version`` header vocabulary. Unlike
+# PRIORITIES the value space is registry versions, not a static enum,
+# so the boundary validates a closed GRAMMAR (label-safe slug, bounded
+# length) and the router's version-aware pick rejects ids that name no
+# registered version. ``auto`` is the no-pin sentinel: the request
+# follows the fleet's live/canary split.
+DEFAULT_MODEL_VERSION = "auto"
+MAX_MODEL_VERSION_LEN = 64
+_MODEL_VERSION_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyz0123456789._-"
+)
+
+
+def validate_model_version(value: Optional[str]) -> str:
+    """Normalize an ``X-Model-Version`` header: ``None``/empty →
+    :data:`DEFAULT_MODEL_VERSION` (no pin); anything else must be a
+    label-safe slug — lowercase alphanumerics plus ``._-``, leading
+    alphanumeric, at most :data:`MAX_MODEL_VERSION_LEN` chars — or
+    ``ValueError`` (→ 422). Grammar-closed like
+    :func:`~unionml_tpu.serving.usage.validate_tenant`: a hostile
+    header is rejected at the boundary, never minted into a metric
+    label or flight-event field; whether the id names a *registered*
+    version is the router pick's check, because only the fleet knows
+    its registry."""
+    if value is None or value == "":
+        return DEFAULT_MODEL_VERSION
+    version = str(value).lower()
+    if len(version) > MAX_MODEL_VERSION_LEN:
+        raise ValueError(
+            f"model version too long ({len(version)} chars, max "
+            f"{MAX_MODEL_VERSION_LEN})"
+        )
+    if not version[0].isalnum() or not all(
+        c in _MODEL_VERSION_OK for c in version
+    ):
+        raise ValueError(
+            f"invalid model version {value!r}: X-Model-Version must be "
+            "a slug of [a-z0-9._-] starting alphanumeric"
+        )
+    return version
+
+
+_model_version_tls = threading.local()
+
+
+@contextmanager
+def model_version_scope(version: Optional[str]) -> Iterator[None]:
+    """Expose a validated ``X-Model-Version`` pin to the router on
+    this thread (``None`` leaves any outer scope visible) — the
+    :func:`priority_scope` plumbing applied to version pinning: the
+    transports open it around the predictor call, and
+    :class:`~unionml_tpu.serving.router.HttpReplica` re-emits it
+    across the router hop so a pinned request stays pinned through a
+    router-of-routers."""
+    if version is None:
+        yield
+        return
+    prev = getattr(_model_version_tls, "version", None)
+    _model_version_tls.version = version
+    try:
+        yield
+    finally:
+        _model_version_tls.version = prev
+
+
+def current_model_version() -> str:
+    """The innermost :func:`model_version_scope` value on this thread,
+    else :data:`DEFAULT_MODEL_VERSION` (no pin)."""
+    version = getattr(_model_version_tls, "version", None)
+    return version if version else DEFAULT_MODEL_VERSION
 
 
 def validate_token_cap(value) -> Optional[int]:

@@ -42,8 +42,11 @@ from unionml_tpu.serving.faults import (
 )
 from unionml_tpu.serving.http import ServingApp
 from unionml_tpu.serving.scheduler import (
+    DEFAULT_MODEL_VERSION,
     DEFAULT_PRIORITY,
+    model_version_scope,
     priority_scope,
+    validate_model_version,
     validate_priority,
 )
 from unionml_tpu.serving.usage import (
@@ -152,6 +155,7 @@ def gateway_handler(
         trace_ctx = telemetry.server_trace_context(raw_traceparent)
         tenant = DEFAULT_TENANT
         priority = DEFAULT_PRIORITY
+        model_version = DEFAULT_MODEL_VERSION
         t0 = time.perf_counter()
 
         def respond(
@@ -169,6 +173,7 @@ def gateway_handler(
                     "X-Request-ID": rid,
                     "X-Tenant-ID": tenant,
                     "X-Priority": priority,
+                    "X-Model-Version": model_version,
                     "traceparent": telemetry.format_traceparent(trace_ctx),
                     **(extra or {}),
                 },
@@ -180,6 +185,9 @@ def gateway_handler(
             # below), echoed on every response like the HTTP transports
             tenant = validate_tenant(headers.get("x-tenant-id"))
             priority = validate_priority(headers.get("x-priority"))
+            model_version = validate_model_version(
+                headers.get("x-model-version")
+            )
             if method == "GET" and path == "/":
                 return respond(200, app.root(), content_type="text/html")
             if method == "GET" and path == "/health":
@@ -219,7 +227,8 @@ def gateway_handler(
                 ) as ctx:
                     trace_ctx = ctx
                     with tenant_scope(tenant):
-                        with priority_scope(priority):
+                        with priority_scope(priority), \
+                                model_version_scope(model_version):
                             with deadline_scope(deadline_ms):
                                 return respond(
                                     200, json.dumps(app.predict(payload))

@@ -134,12 +134,13 @@ class _TenantUsage:
         "requests", "queue_ms", "prefill_tokens", "cached_tokens",
         "decode_tokens", "device_seconds", "flops", "kv_block_seconds",
         "rejected", "deadline_shed", "dropped", "by_priority",
-        "by_phase",
+        "by_phase", "by_version",
     )
 
     def __init__(self):
         self.by_priority: Dict[str, int] = {}
         self.by_phase: Dict[str, int] = {}
+        self.by_version: Dict[str, int] = {}
         self.requests = 0
         self.queue_ms = 0.0
         self.prefill_tokens = 0
@@ -165,6 +166,12 @@ class _TenantUsage:
             # and the decode pool's streams are separately countable
             # per tenant (JSON-only, same cardinality argument)
             "requests_by_phase": dict(self.by_phase),
+            # model-version breakdown (slug-validated registry ids —
+            # a fleet serves at most live + canary during a rollout,
+            # so the key set stays bounded; JSON-only like the
+            # others): during a canary bake a tenant's bill is
+            # splittable by which weights answered
+            "requests_by_version": dict(self.by_version),
             "queue_ms": round(self.queue_ms, 3),
             "prefill_tokens": self.prefill_tokens,
             "cached_tokens": self.cached_tokens,
@@ -380,13 +387,15 @@ class UsageLedger:
         cached_tokens: int = 0,
         priority: Optional[str] = None,
         phase: Optional[str] = None,
+        version: Optional[str] = None,
     ) -> None:
         """One request completed and delivered: the per-request scalars
         (queue wait, prefill split, the scheduling ``priority`` class
-        it ran under, and the serving ``phase`` of the engine that
-        completed it) land here; decode tokens and device attribution
-        accumulated through :meth:`attribute` as the request's chunks
-        harvested."""
+        it ran under, the serving ``phase`` of the engine that
+        completed it, and the model ``version`` its weights were
+        published under) land here; decode tokens and device
+        attribution accumulated through :meth:`attribute` as the
+        request's chunks harvested."""
         with self._lock:
             label = self._label_locked(tenant)
             acct = self._acct_locked(tenant)
@@ -400,6 +409,10 @@ class UsageLedger:
                 )
             if phase is not None:
                 acct.by_phase[phase] = acct.by_phase.get(phase, 0) + 1
+            if version is not None:
+                acct.by_version[version] = (
+                    acct.by_version.get(version, 0) + 1
+                )
         lbl = (self.instance, label)
         self._f_requests.labels(*lbl).inc()
         if queue_ms > 0:
