@@ -48,6 +48,7 @@ bench-all: bench
 	UNIONML_TPU_BENCH_PRESET=serve_disagg python benchmarks/serve_latency.py
 	UNIONML_TPU_BENCH_PRESET=serve_autoscale python benchmarks/serve_latency.py
 	UNIONML_TPU_BENCH_PRESET=serve_fleet_obs python benchmarks/serve_latency.py
+	UNIONML_TPU_BENCH_PRESET=serve_perf python benchmarks/serve_latency.py
 	UNIONML_TPU_BENCH_PRESET=serve_rollout python benchmarks/serve_latency.py
 	python benchmarks/serve_http.py
 	UNIONML_TPU_BENCH_PRESET=serve_8b python benchmarks/serve_http.py

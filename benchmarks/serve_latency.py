@@ -80,6 +80,16 @@ Usage::
     #   paired plane-on/off legs asserting <= 2% p99 overhead at
     #   bit-identical tokens (docs/observability.md "Fleet
     #   observability")
+    UNIONML_TPU_BENCH_PRESET=serve_perf python benchmarks/serve_latency.py
+    # ^ serving goodput plane: a single-replica router fleet under
+    #   load with the plane ON — zero caller-visible failures, exact
+    #   token parity, fleet-merged /debug/goodput sane and the
+    #   per-token ITL histogram populated; then per-request paired
+    #   plane-on/off legs on the SAME engine (the engine.perf setter
+    #   seam) asserting <= 1% pooled-p99 overhead at bit-identical
+    #   tokens, with one tail probe per sweep resolved /debug/tail →
+    #   /debug/trace (docs/observability.md "Serving goodput & tail
+    #   attribution")
     UNIONML_TPU_BENCH_PRESET=serve_rollout python benchmarks/serve_latency.py
     # ^ zero-downtime model lifecycle: a 2-engine fleet under flood
     #   has a bad version rolled forward and auto-rolled back on its
@@ -3025,6 +3035,323 @@ def fleet_obs_leg() -> None:
             e.close()
 
 
+def perf_leg() -> None:
+    """Serving goodput plane overhead + tail attribution
+    (``UNIONML_TPU_BENCH_PRESET=serve_perf``; docs/observability.md
+    "Serving goodput & tail attribution").
+
+    Phase 1 — **the plane live**: a single-replica router fleet (the
+    engine, router app, and plane share one registry/flight/tracer, so
+    the tail endpoints resolve without federation) serves a concurrent
+    flood with the goodput plane ON. Asserts ZERO caller-visible
+    failures, exact token parity vs the solo oracle, a sane
+    fleet-merged ``/debug/goodput`` (ratios recomputed on summed
+    slot-step ledgers, goodput in (0, 1]), and a populated per-token
+    ITL histogram.
+
+    Phase 2 — **plane overhead**: the same requests with the plane OFF
+    and ON, paired PER REQUEST in alternating order on the SAME engine
+    instance via the ``engine.perf`` setter seam (two
+    separately-constructed engines differ by several percent from
+    thread/allocator placement alone, swamping a 1% bar). Flight ring
+    and tracer stay ON in both legs — only the goodput plane toggles,
+    so the delta is the plane's own cost. Same paired estimator as the
+    fleet-obs leg — per-request MIN over rounds, nearest-rank p99
+    computed UNROUNDED, three independent sweeps — but the BAR is held
+    against the p99 of the per-request mins POOLED across all three
+    sweeps rather than the median of per-sweep p99s: the plane's
+    measured cost (~26 us/request, ~0.3% of a tiny-model CPU request)
+    sits an order of magnitude below the per-sweep p99's own
+    scheduling noise on the 1-core host (measured per-sweep deltas
+    swing ±2-7% while the pooled estimate settles at +0.4-0.9% from
+    32 pooled rounds on), so the median-of-3 verdict would be a coin
+    flip about the host, not the plane. Per-sweep overheads and their
+    median are still reported as diagnostics. Asserts <= 1% pooled p99
+    and bit-identical tokens, and per sweep runs one streaming tail
+    probe whose decode exemplar resolves ``/debug/tail`` → per-phase
+    segments → ``/debug/trace`` (histogram bucket to stitched timeline
+    in one hop).
+    """
+    import gc
+    import threading
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu import telemetry
+    from unionml_tpu.models import Llama
+    from unionml_tpu.serving.engine import DecodeEngine
+    from unionml_tpu.serving.router import (
+        EngineReplica, FleetRouter, RouterPolicy, make_router_app,
+    )
+
+    backend = jax.default_backend()
+    if backend == "cpu":
+        cfg = serving_config("tiny")
+        module = Llama(cfg)
+        tokens0 = jnp.zeros((1, 8), jnp.int32)
+        params = jax.jit(module.init)(jax.random.PRNGKey(0), tokens0)["params"]
+        n_req, clients, slots = 48, 6, 2
+        new_tokens, bucket, chunk_steps = 16, 16, 4
+        overhead_reqs, overhead_rounds = 40, 6
+    else:
+        cfg = serving_config("serve_1p5b")
+        module = Llama(cfg)
+        params = random_quantized_params(module)
+        n_req, clients, slots = 192, 24, 8
+        new_tokens, bucket, chunk_steps = 32, 64, 8
+        overhead_reqs, overhead_rounds = 120, 8
+
+    # same estimator hardening as the fleet-obs leg, and MORE binding
+    # here: the bar is 1%, half the fleet-obs bar, while the plane's
+    # measured per-request cost is ~26 us (~0.3% of a tiny-model CPU
+    # request) — so the verdict hinges on min-over-rounds convergence,
+    # not the plane. 32 rounds per sweep × 3 sweeps = 96 pooled tries
+    # per request per leg, where the pooled p99 delta was measured
+    # stable (+0.4-0.9%); the per-sweep p99s individually still swing
+    # ±2-7% on the 1-core host and are reported as diagnostics only
+    overhead_reqs = max(overhead_reqs, 120)
+    if backend == "cpu":
+        overhead_rounds = max(overhead_rounds, 32)
+    registry = telemetry.MetricsRegistry()
+    flight = telemetry.FlightRecorder()
+    tracer = telemetry.TraceRecorder()
+    engine = DecodeEngine(
+        module, slots=slots, max_new_tokens=new_tokens,
+        prompt_buckets=(bucket,), chunk_steps=chunk_steps,
+        max_queue_depth=64, registry=registry, flight=flight,
+        tracer=tracer,
+    )
+    router = FleetRouter(
+        [EngineReplica(engine, params, name="r0")],
+        policy=RouterPolicy(health_ttl_s=0.05),
+        registry=registry,
+        flight=flight,
+        tracer=tracer,
+    )
+    app = make_router_app(
+        router, registry=registry, tracer=tracer, flight=flight,
+    )
+    plane = engine.perf
+    assert plane is not None, (
+        "goodput plane should be ON by default while introspect=True"
+    )
+    rng = np.random.default_rng(0)
+    distinct = [
+        rng.integers(1, cfg.vocab_size, bucket // 2).tolist()
+        for _ in range(8)
+    ]
+    try:
+        engine.warmup(params)
+        solo = {tuple(p): engine.generate(params, [p])[0] for p in distinct}
+
+        # ---- phase 1: loaded run, plane ON ----
+        results, failures, lock = [], [], threading.Lock()
+
+        def client(idx):
+            for p in (
+                distinct[(idx + k) % len(distinct)]
+                for k in range(n_req // clients)
+            ):
+                try:
+                    out = router.generate(p)
+                    with lock:
+                        results.append((tuple(p), out))
+                except BaseException as exc:  # EVERY failure counts
+                    with lock:
+                        failures.append(f"{type(exc).__name__}: {exc}")
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        assert not any(t.is_alive() for t in threads), "clients hung"
+        assert not failures, (
+            f"{len(failures)} caller-visible failures (want 0): "
+            f"{sorted(set(failures))[:3]}"
+        )
+        bad = sum(1 for key, out in results if out != solo[key])
+        assert bad == 0, f"{bad}/{len(results)} responses lost token parity"
+
+        goodput = app.debug_goodput()
+        fleet = goodput["fleet"]
+        assert fleet["replicas"] == 1
+        assert sum(fleet["passes"].values()) > 0, "no dispatcher passes"
+        assert 0.0 < fleet["goodput_ratio"] <= 1.0, fleet
+        assert fleet["occupancy_ratio"] >= fleet["goodput_ratio"], fleet
+        assert fleet["tokens"] > 0, fleet
+        itl = next(
+            f for f in registry.collect()
+            if f.name == "unionml_engine_itl_ms"
+        )
+        itl_n = sum(len(child.samples()) for _, child in itl.children())
+        assert itl_n > 0, "per-token ITL histogram is empty under load"
+        print(json.dumps({
+            "metric": "serve_perf_plane_under_load",
+            "offered": n_req,
+            "completed": len(results),
+            "caller_visible_failures": len(failures),
+            "goodput_ratio": fleet["goodput_ratio"],
+            "occupancy_ratio": fleet["occupancy_ratio"],
+            "itl_observations": itl_n,
+            "token_parity": "exact",
+            "unit": "requests",
+        }))
+
+        # ---- phase 2: paired per-request plane on/off overhead ----
+        prompts = [
+            rng.integers(1, cfg.vocab_size, bucket // 2).tolist()
+            for _ in range(overhead_reqs)
+        ]
+
+        def p99(vals):  # nearest-rank, UNROUNDED (0.1 ms rounding is
+            v = sorted(vals)  # percents of this workload)
+            return v[max(0, math.ceil(0.99 * len(v)) - 1)]
+
+        def tail_probe(sweep_i):
+            """One streaming request, then its decode exemplar walked
+            /debug/tail → segments → /debug/trace. Runs with the plane
+            ON (exemplar capture is plane-gated); the finish event and
+            exemplar land on the harvester thread moments after the
+            last chunk, so the resolution is a bounded wait."""
+            probe = distinct[sweep_i % len(distinct)]
+            streams_before = sum(
+                1 for _, meta_done, _ in tracer._done
+                if meta_done.get("kind") == "stream"
+            )
+            out = [t for c in router.generate_stream(probe) for t in c]
+            assert out == solo[tuple(probe)], "tail probe lost parity"
+            deadline = time.monotonic() + 10.0
+            row = None
+            while time.monotonic() < deadline:
+                stream_rids = [
+                    rid_done for rid_done, meta_done, _ in tracer._done
+                    if meta_done.get("kind") == "stream"
+                ]
+                if len(stream_rids) > streams_before:
+                    rows = app.debug_tail(
+                        metric="unionml_engine_decode_ms", n=64,
+                    )["requests"]
+                    row = next(
+                        (
+                            r for r in rows
+                            if r["rid"] == stream_rids[-1]
+                            and "segments" in r
+                        ),
+                        None,
+                    )
+                    if row is not None:
+                        break
+                time.sleep(0.01)
+            assert row is not None, (
+                "tail probe's decode exemplar never became resolvable "
+                "via /debug/tail"
+            )
+            assert row["segments"]["tokens"] == new_tokens, row
+            assert row["segments"]["itl_tokens"] == new_tokens - 1, row
+            doc, _ = app.debug_trace(rid=row["rid"])
+            assert doc["trace_id"] and doc["spans"], (
+                "tail exemplar rid did not resolve in /debug/trace"
+            )
+
+        def sweep(sweep_i):
+            """One full paired measurement; returns the per-request
+            min arrays so the caller can both report this sweep's own
+            p99 delta and pool the mins across sweeps for the bar."""
+            off_min = [math.inf] * overhead_reqs
+            on_min = [math.inf] * overhead_reqs
+            token_mismatch = 0
+            gc_was_enabled = gc.isenabled()
+            gc.collect()  # every sweep starts from the same heap state
+            gc.disable()
+            try:
+                for r in range(overhead_rounds):
+                    for i, p in enumerate(prompts):
+                        legs = [("off", i), ("on", i)]
+                        if (r + i + sweep_i) % 2:
+                            legs.reverse()  # drift cancels in the pair
+                        outs = {}
+                        for legname, idx in legs:
+                            # the setter seam: swap only while idle —
+                            # requests here are strictly serial
+                            engine.perf = (
+                                plane if legname == "on" else None
+                            )
+                            t0 = time.perf_counter()
+                            out = router.generate(p)
+                            dt = time.perf_counter() - t0
+                            mins = on_min if legname == "on" else off_min
+                            mins[idx] = min(mins[idx], dt)
+                            outs[legname] = out
+                        if outs["off"] != outs["on"]:
+                            token_mismatch += 1
+            finally:
+                engine.perf = plane
+                if gc_was_enabled:
+                    gc.enable()
+            assert token_mismatch == 0, (
+                f"{token_mismatch} plane-on responses diverged from "
+                "plane-off"
+            )
+            tail_probe(sweep_i)
+            return off_min, on_min
+
+        sweeps = [sweep(s) for s in range(3)]
+        sweep_overheads = sorted(
+            (p99(on_m) - p99(off_m)) / p99(off_m)
+            for off_m, on_m in sweeps
+        )
+        pooled_off = [
+            min(off_m[i] for off_m, _ in sweeps)
+            for i in range(overhead_reqs)
+        ]
+        pooled_on = [
+            min(on_m[i] for _, on_m in sweeps)
+            for i in range(overhead_reqs)
+        ]
+        off99, on99 = p99(pooled_off), p99(pooled_on)
+        overhead = (on99 - off99) / off99 if off99 > 0 else 0.0
+        assert overhead <= 0.01, (
+            f"goodput plane adds {overhead:.2%} pooled p99 "
+            f"(per-sweep: {', '.join(f'{o:.2%}' for o in sweep_overheads)}); "
+            "bar is 1%"
+        )
+        print(json.dumps({
+            "metric": "serve_perf_p99_overhead",
+            "requests": overhead_reqs,
+            "rounds": overhead_rounds,
+            "sweeps": 3,
+            "sweep_overheads_pct": [
+                round(o * 100, 2) for o in sweep_overheads
+            ],
+            "sweep_overhead_median_pct": round(
+                sweep_overheads[1] * 100, 2
+            ),
+            "plane_off_p99_ms": round(off99 * 1e3, 3),
+            "plane_on_p99_ms": round(on99 * 1e3, 3),
+            "value": round(overhead * 100, 2),
+            "token_parity": "exact",
+            "unit": "percent",
+        }))
+        print(json.dumps({
+            "metric": "serve_perf_summary",
+            "plane_under_load": "0 caller-visible failures, parity exact",
+            "goodput_ratio": fleet["goodput_ratio"],
+            "tail_probes_resolved": 3,
+            "p99_overhead_pct": round(overhead * 100, 2),
+        }))
+    finally:
+        engine.close()
+
+
 def rollout_leg() -> None:
     """Zero-downtime model lifecycle under flood
     (``UNIONML_TPU_BENCH_PRESET=serve_rollout``;
@@ -3383,6 +3710,17 @@ if __name__ == "__main__":
                 "workload is hardcoded in fleet_obs_leg"
             )
         fleet_obs_leg()
+    elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_perf":
+        if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
+            os.environ.get("UNIONML_TPU_BENCH_PREFIX")
+        ):
+            # hardcoded workload, same rule as the other engine legs
+            raise SystemExit(
+                "UNIONML_TPU_BENCH_PRESET=serve_perf takes no CLI "
+                f"flags or KV/PREFIX env legs (got {sys.argv[1:]}); its "
+                "workload is hardcoded in perf_leg"
+            )
+        perf_leg()
     elif os.environ.get("UNIONML_TPU_BENCH_PRESET") == "serve_rollout":
         if len(sys.argv) > 1 or os.environ.get("UNIONML_TPU_BENCH_KV") or (
             os.environ.get("UNIONML_TPU_BENCH_PREFIX")

@@ -510,6 +510,176 @@ def check_rollout_reasons(root: Path) -> list:
     return problems
 
 
+PERF_MODULE = "unionml_tpu/serving/perf.py"
+PERF_DOC = "docs/observability.md"
+_PERF_DOC_BEGIN = "<!-- PERF_REASONS:begin -->"
+_PERF_DOC_END = "<!-- PERF_REASONS:end -->"
+
+
+def check_perf_reasons(root: Path) -> list:
+    """Two-way drift check between the serving perf watchdog's closed
+    reasons vocabulary (``PERF_REGRESSION_REASONS`` in serving/perf.py)
+    and the watchdog reasons table in docs/observability.md "Serving
+    goodput & tail attribution" — the rollout-decision pattern applied
+    to ``perf_regression`` flight events, so an operator filtering
+    ``/debug/flight?kind=perf_regression`` can trust every ``reason``
+    value has a documented row."""
+    module_path = root / PERF_MODULE
+    doc_path = root / PERF_DOC
+    if not module_path.exists():
+        return [f"{PERF_MODULE}: missing (perf-reasons drift check needs it)"]
+    try:
+        tree = ast.parse(module_path.read_text(encoding="utf-8"))
+    except SyntaxError:
+        return []  # reported by the per-file checker
+    reasons = _module_tuple_literal(tree, "PERF_REGRESSION_REASONS")
+    if reasons is None:
+        return [
+            f"{PERF_MODULE}: PERF_REGRESSION_REASONS must be a "
+            "module-level literal tuple (the closed vocabulary the "
+            "doc-drift check parses)"
+        ]
+    if not doc_path.exists():
+        return [f"{PERF_DOC}: missing (perf-reasons drift check needs it)"]
+    problems = []
+    doc_text = doc_path.read_text(encoding="utf-8")
+    for value in reasons:
+        if f"`{value}`" not in doc_text:
+            problems.append(
+                f"{PERF_MODULE}: watchdog reason {value!r} is not "
+                f"documented in {PERF_DOC}"
+            )
+    begin = doc_text.find(_PERF_DOC_BEGIN)
+    end = doc_text.find(_PERF_DOC_END)
+    if begin < 0 or end < 0 or end < begin:
+        problems.append(
+            f"{PERF_DOC}: watchdog reasons table must be fenced by "
+            f"{_PERF_DOC_BEGIN} / {_PERF_DOC_END} markers (the reverse "
+            "drift direction scans that region)"
+        )
+        return problems
+    known = set(reasons)
+    offset = doc_text[:begin].count("\n") + 1
+    for lineno, line in enumerate(doc_text[begin:end].splitlines(), offset):
+        for token in _BACKTICK_TOKEN_RE.findall(line):
+            if token not in known:
+                problems.append(
+                    f"{PERF_DOC}:{lineno}: watchdog-reasons token "
+                    f"{token!r} is not in the PERF_REGRESSION_REASONS "
+                    f"vocabulary ({PERF_MODULE})"
+                )
+    return problems
+
+
+# Closed flight-event vocabulary: every *literal* kind recorded via a
+# ``*_flight_rec("kind", ...)`` / ``*flight*.record("kind", ...)`` call
+# under unionml_tpu/ must be listed here AND in the fenced table in
+# docs/observability.md — a postmortem filter (`/debug/flight?kind=`)
+# and the fleet merge both key on these strings, so an undocumented or
+# typo'd kind is an invisible event class. (Variable-kind pass-through
+# sites — e.g. the rollout controller recording its decision enum — are
+# covered by their own closed-set checks.)
+FLIGHT_EVENT_KINDS = (
+    # engine lifecycle
+    "submit", "reject", "prefill", "decode", "finish", "drop",
+    "promote", "preempt", "resume", "pool_pressure", "recovery",
+    # micro-batcher
+    "batch", "error",
+    # fleet router / membership / dispatch
+    "join", "leave", "rejoin", "drain", "eject", "probe", "route",
+    "retry", "hedge",
+    # autoscaler
+    "scale_out", "scale_in", "scale_hold", "scale_reap",
+    # disaggregated serving
+    "handoff",
+    # rollouts
+    "rollout_shadow",
+    # training goodput plane
+    "train_compile", "step_time_anomaly", "step_time_regression",
+    "straggler",
+    # serving perf plane
+    "perf_regression",
+)
+_FLIGHT_DOC_BEGIN = "<!-- FLIGHT_EVENT_KINDS:begin -->"
+_FLIGHT_DOC_END = "<!-- FLIGHT_EVENT_KINDS:end -->"
+
+
+def _flight_kind_literal(node: ast.Call):
+    """The literal kind string of a flight-record call, or None when
+    the call is not a flight record / the kind is not a literal."""
+    func = node.func
+    if not (isinstance(func, ast.Attribute) and node.args):
+        return None
+    if func.attr == "_flight_rec":
+        pass
+    elif func.attr == "record" and "flight" in ast.unparse(func.value):
+        pass
+    else:
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+def check_flight_event_kinds(root: Path) -> list:
+    """Both directions of the flight-event vocabulary contract: every
+    literal kind recorded under unionml_tpu/ must be in
+    ``FLIGHT_EVENT_KINDS``, and every backticked token in the fenced
+    docs/observability.md table must be a known kind."""
+    problems = []
+    for path in sorted((root / "unionml_tpu").rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"))
+        except SyntaxError:
+            continue  # reported by the per-file checker
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _flight_kind_literal(node)
+            if kind is not None and kind not in FLIGHT_EVENT_KINDS:
+                problems.append(
+                    f"{path}:{node.lineno}: flight event kind {kind!r} "
+                    "is not in FLIGHT_EVENT_KINDS (scripts/"
+                    "lint_basics.py) — extend the closed vocabulary "
+                    "and its docs/observability.md table"
+                )
+    doc_path = root / METRICS_DOC
+    if not doc_path.exists():
+        return problems + [
+            f"{METRICS_DOC}: missing (flight-kind drift check needs it)"
+        ]
+    doc_text = doc_path.read_text(encoding="utf-8")
+    begin = doc_text.find(_FLIGHT_DOC_BEGIN)
+    end = doc_text.find(_FLIGHT_DOC_END)
+    if begin < 0 or end < 0 or end < begin:
+        problems.append(
+            f"{METRICS_DOC}: flight-event kinds must be fenced by "
+            f"{_FLIGHT_DOC_BEGIN} / {_FLIGHT_DOC_END} markers (the "
+            "reverse drift direction scans that region)"
+        )
+        return problems
+    region = doc_text[begin:end]
+    offset = doc_text[:begin].count("\n") + 1
+    for lineno, line in enumerate(region.splitlines(), offset):
+        for token in _BACKTICK_TOKEN_RE.findall(line):
+            if token not in FLIGHT_EVENT_KINDS:
+                problems.append(
+                    f"{METRICS_DOC}:{lineno}: flight-kind token "
+                    f"{token!r} is not in FLIGHT_EVENT_KINDS "
+                    "(scripts/lint_basics.py)"
+                )
+    for kind in FLIGHT_EVENT_KINDS:
+        if f"`{kind}`" not in region:
+            problems.append(
+                f"{METRICS_DOC}: flight event kind {kind!r} is missing "
+                "from the fenced FLIGHT_EVENT_KINDS table"
+            )
+    return problems
+
+
 def _call_labelnames(node: ast.Call):
     """Constant label names of a metric registration call: the third
     positional arg or the ``labelnames`` kwarg, when it is a literal
@@ -661,6 +831,8 @@ def main(argv) -> int:
         problems.extend(check_label_cardinality(ROOT / "unionml_tpu"))
         problems.extend(check_span_names(ROOT / "unionml_tpu"))
         problems.extend(check_rollout_reasons(ROOT))
+        problems.extend(check_perf_reasons(ROOT))
+        problems.extend(check_flight_event_kinds(ROOT))
     for p in problems:
         print(p)
     print(f"lint_basics: {len(files)} files, {len(problems)} problem(s)")

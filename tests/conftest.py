@@ -28,14 +28,21 @@ jax.config.update("jax_platforms", "cpu")
 # cache keys on HLO hash, so repeats hit even WITHIN one cold suite
 # run, and the whole suite warms across runs. Scoped to the test
 # harness — production code paths never see this config.
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.environ.get(
-        "UNIONML_TPU_TEST_JAX_CACHE", "/tmp/unionml_tpu_test_jax_cache"
-    ),
+_JAX_TEST_CACHE = os.environ.get(
+    "UNIONML_TPU_TEST_JAX_CACHE", "/tmp/unionml_tpu_test_jax_cache"
 )
+jax.config.update("jax_compilation_cache_dir", _JAX_TEST_CACHE)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+# SUBPROCESS jax runs share the same cache via jax's env-var config
+# defaults: the CLI scaffold tests and the tutorial executors each
+# spawn child pytest/python processes that otherwise cold-compile the
+# same tiny models on every suite run (~100 s of repeat XLA work).
+# setdefault so an outer override (UNIONML_TPU_TEST_JAX_CACHE unset
+# but JAX_COMPILATION_CACHE_DIR exported) still wins.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _JAX_TEST_CACHE)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 
 import os.path
 

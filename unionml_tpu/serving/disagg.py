@@ -506,9 +506,12 @@ class DisaggRouter(FleetRouter):
         if blocks:
             self._m_kv_blocks.inc(blocks)
         now = time.perf_counter()
-        self._h_handoff_ms.observe((now - t0) * 1e3)
+        # exemplar-tagged: /debug/tail?metric=unionml_disagg_handoff_ms
+        # resolves a slow handoff straight to its stitched timeline
+        self._h_handoff_ms.observe((now - t0) * 1e3, exemplar=rid)
         self._flight.record(
             "handoff", rid=rid, result=result, blocks=blocks,
+            handoff_ms=round((now - t0) * 1e3, 3),
             prefill_replica=getattr(src, "name", None),
             decode_replica=dst.name,
             cached_tokens=int(handle.get("cached_tokens", 0) or 0),

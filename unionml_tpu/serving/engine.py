@@ -110,6 +110,7 @@ from unionml_tpu.serving.faults import (
 from unionml_tpu.serving.kv_pool import KVBlockPool, PoolExhausted
 from unionml_tpu.serving.scheduler import (
     DEFAULT_PRIORITY,
+    PRIORITIES,
     PreemptiveScheduler,
     SchedulerConfig,
     current_priority,
@@ -287,6 +288,18 @@ class _Request:
     # have landed in the host prefix-cache store (the insert entry's
     # lease release — or any terminal path, so a waiter never hangs)
     _kv_event: Optional[threading.Event] = None
+    # serving goodput plane (docs/observability.md "Serving goodput &
+    # tail attribution"): admission_ms is the host-side admission span
+    # (dispatch start → final prefill program dispatched — the
+    # chunked-admission machinery's share of prefill_ms); _itl_anchor
+    # is the harvest time of this decode segment's previous tokens
+    # (0.0 = unanchored: before the first token, or cleared by
+    # preemption so the evict→resume gap never counts as inter-token
+    # latency); the accumulators feed itl_mean_ms per request
+    admission_ms: float = 0.0
+    _itl_anchor: float = 0.0
+    _itl_sum_ms: float = 0.0
+    _itl_n: int = 0
 
     def emit(self, chunk: List[int]) -> None:
         if self.stream is not None and chunk:
@@ -433,6 +446,24 @@ class DecodeEngine:
             ``unionml_tenant_*`` series; ``None`` (default) disables
             metering entirely — every record site is one attr-is-None
             check (the ``serve_usage`` bench measures the delta).
+        perf: the serving goodput plane (docs/observability.md
+            "Serving goodput & tail attribution"): every dispatcher
+            pass is classified into a bounded ring (full-batch /
+            padded-slots / prefill-mix / idle →
+            ``unionml_serving_goodput_ratio`` and friends, read by
+            ``GET /debug/goodput``), decode-chunk harvests feed the
+            ``unionml_engine_itl_ms`` inter-token-latency histograms
+            and per-request ITL accumulators, completed requests tag
+            the latency histograms with rid exemplars (``GET
+            /debug/tail``), and a :class:`~unionml_tpu.serving.perf
+            .ServingRegressionWatchdog` watches TTFT/ITL/goodput for
+            regressions (``perf_regression`` flight events). ``None``
+            (default) enables the plane iff ``introspect`` is on;
+            ``False`` disables it (every hook is one attr-is-None
+            check — the ``serve_perf`` bench holds the on/off p99
+            delta under 1%); an explicit
+            :class:`~unionml_tpu.serving.perf.ServingPerfPlane`
+            injects one.
         paged/kv_pool_bytes/kv_pool_blocks/kv_block_size: BLOCK-PAGED
             device KV (docs/performance.md "Paged KV attention";
             PagedAttention lineage). Instead of ``slots`` contiguous
@@ -513,6 +544,7 @@ class DecodeEngine:
         introspect: bool = True,
         flight=None,
         usage=None,
+        perf=None,
         paged: bool = False,
         kv_pool_bytes: Optional[int] = None,
         kv_pool_blocks: Optional[int] = None,
@@ -636,6 +668,25 @@ class DecodeEngine:
 
             usage = UsageLedger(registry=self._registry)
         self._usage = usage or None
+        # serving goodput plane (docs/observability.md "Serving
+        # goodput & tail attribution"): dispatcher-pass classification
+        # into the bounded ring, ITL histograms + tail exemplars, and
+        # the perf-regression watchdog. Defaults on with introspection
+        # (perf=None); ``False`` disables it, an explicit
+        # ServingPerfPlane injects one. Every hook below is a single
+        # attr-is-None check — the serve_perf bench holds the on/off
+        # p99 delta under 1%.
+        if perf is None:
+            perf = self.introspect
+        if perf is True:
+            from unionml_tpu.serving.perf import ServingPerfPlane
+
+            perf = ServingPerfPlane(
+                registry=self._registry, flight=self._flight,
+                engine=self.instance, phase=self.phase,
+                slots=self.slots, chunk_steps=self.chunk_steps,
+            )
+        self._perf = perf or None
         # harvester-thread clock: end of the previous readback, so each
         # entry's attributed device time is the wall it exclusively
         # occupied the device pipeline (consecutive-harvest spacing ==
@@ -974,6 +1025,24 @@ class DecodeEngine:
             "unionml_engine_drain_ms",
             "drain() wall time: stop-admissions to queue+slots idle.",
         )
+        # per-token attribution (the serving goodput plane): chunk
+        # harvest spacing over the chunk's harvested tokens, split by
+        # priority class — observed only while the perf plane is on,
+        # so a plane-off engine records nothing here. Children are
+        # pre-resolved: the harvester must not pay the family-lock
+        # labels() lookup per chunk.
+        itl = R.histogram(
+            "unionml_engine_itl_ms",
+            "Inter-token latency per harvested decode chunk (harvest "
+            "spacing / tokens in the chunk), by priority class.",
+            ("engine", "phase", "priority"),
+        )
+        self._h_itl = {
+            p: itl.labels(
+                engine=self.instance, phase=self.phase, priority=p
+            )
+            for p in PRIORITIES
+        }
 
     def _instrument_programs(self):
         """Wrap the compiled hot-path programs in a cost-analysis
@@ -1077,6 +1146,23 @@ class DecodeEngine:
         so the off-leg's idle gap never inflates the first on-leg
         window."""
         self._usage = ledger or None
+
+    @property
+    def perf(self):
+        """The engine's :class:`~unionml_tpu.serving.perf
+        .ServingPerfPlane` (``None`` when the goodput plane is off) —
+        ``GET /debug/goodput`` reads it via :meth:`goodput_report`."""
+        return self._perf
+
+    @perf.setter
+    def perf(self, plane) -> None:
+        """Swap the goodput plane on a live engine — ONLY while idle,
+        like the ``usage`` seam above. The ``serve_perf`` bench
+        toggles this between its paired overhead legs so both run on
+        the SAME engine instance (two separately-constructed engines
+        differ by several percent from thread/allocator placement
+        alone, swamping a 1% bar)."""
+        self._perf = plane or None
         # the waiting room's fair-share weighting follows the swap
         self._room._usage = self._usage
 
@@ -2381,6 +2467,60 @@ class DecodeEngine:
             summary = h.summary()
             if summary:
                 out[name] = summary
+        # decode-lane-pure inter-token latency (the perf plane's
+        # chunk-spacing histograms merged across priority classes):
+        # unlike decode_ms, no harvest/admission gaps are lumped in
+        itl = self._itl_summary()
+        if itl:
+            out["itl_ms"] = itl
+            out["itl_mean_ms"] = itl["mean"]
+            out["itl_p99_ms"] = itl["p99"]
+        if self._perf is not None:
+            out["goodput"] = self._perf.report()
+        return out
+
+    def _itl_summary(self) -> dict:
+        """Exact percentile summary of the ITL histograms' retained
+        windows merged across this engine's priority children
+        (``{}`` when the plane is off or nothing decoded yet)."""
+        samples: List[float] = []
+        for child in self._h_itl.values():
+            samples.extend(child.samples())
+        if not samples:
+            return {}
+        return telemetry.percentile_summary(samples)
+
+    def goodput_report(self) -> dict:
+        """The ``GET /debug/goodput`` body for this engine: the perf
+        plane's ring classification + ratios + watchdog advisory,
+        with the ITL/TTFT summaries and — when introspection is on —
+        the per-program MFU/roofline view, so achieved tokens/s and
+        hardware utilization read off one dashboard. Raises
+        ``ValueError`` when the plane is off (transports map it to
+        422)."""
+        if self._perf is None:
+            raise ValueError(
+                "serving perf plane is off — construct the engine "
+                "with perf=True (the default while introspect=True)"
+            )
+        out = self._perf.report()
+        itl = self._itl_summary()
+        if itl:
+            out["itl_ms"] = itl
+        ttft = self._h_ttft.summary()
+        if ttft:
+            out["ttft_ms"] = ttft
+        if self._programs is not None:
+            progs = self._programs.stats()
+            out["programs"] = {
+                name: {
+                    "mfu": p["mfu"],
+                    "hbm_utilization": p.get("hbm_utilization"),
+                    "achieved_flops_per_s": p.get("achieved_flops_per_s"),
+                }
+                for name, p in progs.items()
+                if isinstance(p, dict) and "mfu" in p
+            }
         return out
 
     def reset_stats(self) -> None:
@@ -2395,8 +2535,11 @@ class DecodeEngine:
             *self._m_rejected.values(),
             self._h_queue, self._h_prefill, self._h_decode, self._h_ttft,
             self._h_dispatch, self._h_harvest, self._h_drain,
+            *self._h_itl.values(),
         ):
             m.reset()
+        if self._perf is not None:
+            self._perf.reset()
         if self.prefix_cache is not None:
             self.prefix_cache.reset_stats()
         if self.kv_pool is not None:
@@ -2544,6 +2687,10 @@ class DecodeEngine:
             # accounting continues from them (fresh admissions: 0 + 1)
             req._expected = len(req.tokens) + 1
             self._m_slots_busy.set(self._slots_in_use_locked())
+        # admission segment: dispatch start → prefill program enqueued
+        # (host-side admission machinery; the device part of prefill
+        # lands in prefill_ms at harvest)
+        req.admission_ms = (time.perf_counter() - req._dispatch_t) * 1e3
         self._flight_rec(
             "prefill", rid=req.rid, tenant=req.tenant, slot=slot,
             bucket=_bucket, tokens=req._prefilled_tokens,
@@ -2812,6 +2959,24 @@ class DecodeEngine:
             or len(req.tokens) >= req.max_new_tokens
         )
 
+    def _observe_itl(self, req: _Request, now: float, n_tokens: int) -> None:
+        """Harvester, lock held, perf plane on: one decode chunk's
+        inter-token latency — harvest spacing since the previous
+        harvested token batch, divided over this chunk's tokens. An
+        unanchored request (anchor 0.0: first batch of a segment, or
+        just resumed after preemption) only re-anchors, so neither the
+        prefill gap nor the evict→resume gap ever counts as ITL and
+        resume segments never double-count."""
+        self._perf.note_tokens(n_tokens)
+        anchor = req._itl_anchor
+        req._itl_anchor = now
+        if anchor <= 0.0:
+            return
+        gap_ms = (now - anchor) * 1e3
+        self._h_itl[req.priority].observe(gap_ms / n_tokens)
+        req._itl_sum_ms += gap_ms
+        req._itl_n += n_tokens
+
     def _finish_if_done(self, slot: int, tok: int) -> bool:
         """Harvester thread, called with the lock held."""
         req = self._occupant[slot]
@@ -2821,12 +2986,23 @@ class DecodeEngine:
         if done:
             now = time.perf_counter()
             req.decode_ms = (now - req._prefill_end) * 1e3
+            # decode_ms is wall time first-token→retirement, so it
+            # includes harvest/queue gaps between chunks; the ITL
+            # accumulators (chunk-spacing only, reset across
+            # preemption) are the decode-lane-pure view
+            itl_mean = req._itl_sum_ms / req._itl_n if req._itl_n else 0.0
             if not req.abandoned:
-                self._h_queue.observe(req.queue_wait_ms)
-                self._h_prefill.observe(req.prefill_ms)
-                self._h_decode.observe(req.decode_ms)
-                self._h_ttft.observe(req.ttft_ms)
+                # exemplar tagging (perf plane only): a top-bucket
+                # observation keeps its rid, so GET /debug/tail can
+                # hand the slowest recent requests to /debug/trace
+                ex = req.rid if self._perf is not None else None
+                self._h_queue.observe(req.queue_wait_ms, exemplar=ex)
+                self._h_prefill.observe(req.prefill_ms, exemplar=ex)
+                self._h_decode.observe(req.decode_ms, exemplar=ex)
+                self._h_ttft.observe(req.ttft_ms, exemplar=ex)
                 self._m_requests.inc()
+                if self._perf is not None:
+                    self._perf.observe_request(req.ttft_ms, itl_mean)
                 # a successful completion proves the rebuilt state
                 # serves: only CONSECUTIVE rebuild failures accumulate
                 # toward the circuit breaker
@@ -2857,8 +3033,17 @@ class DecodeEngine:
             self._flight_rec(
                 "finish", rid=req.rid, tenant=req.tenant, slot=slot,
                 tokens=len(req.tokens), abandoned=req.abandoned,
+                # the per-request ledger split (docs/observability.md
+                # "Serving goodput & tail attribution"): queue →
+                # admission → prefill → decode segments + the
+                # decode-lane-pure ITL rollup
+                queue_ms=round(req.queue_wait_ms, 3),
+                admission_ms=round(req.admission_ms, 3),
+                prefill_ms=round(req.prefill_ms, 3),
                 ttft_ms=round(req.ttft_ms, 3),
                 decode_ms=round(req.decode_ms, 3),
+                itl_mean_ms=round(itl_mean, 3),
+                itl_tokens=req._itl_n,
             )
             req.event.set()
             req.finish_stream()
@@ -2979,12 +3164,18 @@ class DecodeEngine:
                     # its ttft must stay the first segment's
                     req.ttft_ms = (now - req.submitted) * 1e3
                 req._prefill_end = now
+                # ITL anchor: the next decode chunk's harvest spacing
+                # measures from this first token (re-anchored here on
+                # resume too, so the evict→resume gap never counts)
+                req._itl_anchor = now
                 self._tracer.record_span(
                     req.rid, "prefill", req._dispatch_t, now,
                     tokens=req._prefilled_tokens,
                 )
                 req.tokens.append(tok)
                 req.emit([tok])
+                if self._perf is not None:
+                    self._perf.note_tokens(1)
                 self._finish_if_done(slot, tok)
             if self._usage is not None:
                 # the prefill's exclusive pipeline window (consecutive-
@@ -3038,6 +3229,8 @@ class DecodeEngine:
                 )
                 req._chunk_i += 1
                 req.emit(chunk)
+                if self._perf is not None and chunk:
+                    self._observe_itl(req, now, len(chunk))
                 if self._usage is not None:
                     tenant_tokens[req.tenant] = (
                         tenant_tokens.get(req.tenant, 0) + len(chunk)
@@ -3108,6 +3301,8 @@ class DecodeEngine:
                 )
                 req._chunk_i += 1
                 req.emit(chunk)
+                if self._perf is not None and chunk:
+                    self._observe_itl(req, now, len(chunk))
                 if self._usage is not None and chunk:
                     tenant_tokens[req.tenant] = (
                         tenant_tokens.get(req.tenant, 0) + len(chunk)
@@ -3212,7 +3407,23 @@ class DecodeEngine:
             gens = tuple(self._slot_gen)
             self._m_chunks.inc()
             self._m_steps.inc(self.chunk_steps)
-            self._m_occupied.inc(int(mask.sum()) * self.chunk_steps)
+            occupied_now = int(mask.sum())
+            self._m_occupied.inc(occupied_now * self.chunk_steps)
+            if self._perf is not None:
+                # goodput ring: classify this pass (full batch /
+                # padded slots / prefill-mix) + KV pool pressure
+                self._perf.note_pass(
+                    occupied_now,
+                    prefill_mix=self._admission is not None,
+                    kv_in_use=(
+                        self.kv_pool.in_use
+                        if self.kv_pool is not None else 0
+                    ),
+                    kv_capacity=(
+                        self.kv_pool.capacity
+                        if self.kv_pool is not None else 0
+                    ),
+                )
         self._inflight.put(("chunk", ep0, mask, gens, toks, t_dispatch, seq))
         return True
 
@@ -3409,6 +3620,9 @@ class DecodeEngine:
             self._occupant[slot] = None
             victim._preempts += 1
             victim._preempted_at = time.perf_counter()
+            # unanchor ITL: the evict→resume gap is queueing, not
+            # decode cadence — the resume prefill re-anchors
+            victim._itl_anchor = 0.0
             resume_prompt = np.concatenate([
                 victim.prompt,
                 np.asarray(
@@ -3707,6 +3921,11 @@ class DecodeEngine:
                 req._expected = len(req.tokens) + 1
                 self._admitting -= 1
                 self._m_slots_busy.set(self._slots_in_use_locked())
+            # admission segment: dispatch start → final prefill chunk
+            # enqueued (covers every interleaved lead chunk + splice)
+            req.admission_ms = (
+                (time.perf_counter() - req._dispatch_t) * 1e3
+            )
             self._flight_rec(
                 "prefill", rid=req.rid, tenant=req.tenant, slot=adm.slot,
                 bucket=adm.bucket, tokens=req._prefilled_tokens,
@@ -3809,6 +4028,9 @@ class DecodeEngine:
                     # nothing admittable or dispatchable: arrivals and
                     # harvest-freed slots are picked up next pass (2 ms
                     # keeps the 1-core host responsive without spinning)
+                    if self._perf is not None:
+                        # goodput ring: the device is parked this pass
+                        self._perf.note_idle()
                     time.sleep(0.002)
             except BaseException as exc:  # pragma: no cover - engine crash
                 self._recover(exc)

@@ -360,6 +360,20 @@ def serving_app(
         except ValueError as exc:
             raise HTTPException(status_code=422, detail=str(exc))
 
+    @app.get("/debug/goodput")
+    async def debug_goodput():
+        try:
+            return core.debug_goodput()
+        except ValueError as exc:
+            raise HTTPException(status_code=422, detail=str(exc))
+
+    @app.get("/debug/tail")
+    async def debug_tail(metric: str = "", n: Optional[int] = None):
+        try:
+            return core.debug_tail(metric=metric, n=n)
+        except (ValueError, TypeError) as exc:
+            raise HTTPException(status_code=422, detail=str(exc))
+
     # one middleware gives every route the X-Request-ID header, the
     # traceparent echo (predict endpoints already set their recorded
     # server context — setdefault keeps it), and the per-endpoint

@@ -165,6 +165,7 @@ KNOWN_ROUTES = (
     "/debug/profile", "/debug/memory", "/debug/flight", "/debug/trace",
     "/debug/slo", "/debug/usage", "/debug/cache/peek", "/debug/fleet",
     "/debug/rollout", "/debug/kv/export", "/debug/kv/import",
+    "/debug/goodput", "/debug/tail",
 )
 
 # the routes that open a RECORDED trace timeline (a server span the
@@ -223,6 +224,7 @@ class ServingApp:
         cache_peek: Optional[Any] = None,
         kv_export: Optional[Any] = None,
         kv_import: Optional[Any] = None,
+        goodput: Optional[Any] = None,
         **batcher_kwargs,
     ):
         """``warmup``: optional callable invoked with the loaded model
@@ -310,7 +312,16 @@ class ServingApp:
         [...]}``) attaches a donor's entries to this process's store.
         A disaggregated router uses the pair to move a prefill
         replica's finalized KV onto a decode replica on another host;
-        both answer 422 when unwired."""
+        both answer 422 when unwired.
+
+        ``goodput``: a zero-arg callable returning the serving goodput
+        plane's report — wire ``engine.goodput_report`` — served at
+        ``GET /debug/goodput``: batch-occupancy classification
+        (full-batch / padded-slot / prefill-mix / idle device passes),
+        goodput + occupancy + KV-pressure ratios, achieved tokens/s
+        tied to the introspection MFU gauges, and the perf-regression
+        watchdog advisory (docs/observability.md "Serving goodput &
+        tail attribution"). Answers 422 when unwired."""
         self.model = model
         self.remote = remote
         self.app_version = app_version
@@ -337,6 +348,7 @@ class ServingApp:
         self._cache_peek = cache_peek
         self._kv_export = kv_export
         self._kv_import = kv_import
+        self._goodput = goodput
         self._otlp = None
         endpoint = otlp_endpoint or os.getenv("UNIONML_TPU_OTLP_ENDPOINT")
         if endpoint:
@@ -685,14 +697,112 @@ class ServingApp:
 
     def debug_slo(self) -> dict:
         """``GET /debug/slo``: a fresh SLO watchdog evaluation (burn
-        rates per objective and window, breach flags). Raises
-        ``ValueError`` (→ 422) when the app has no watchdog."""
+        rates per objective and window, breach flags), plus a
+        ``serving`` block of TTFT/ITL percentile rows and per-engine
+        goodput ratios read from the serving perf plane's histograms —
+        the rows an ITL- or goodput-targeted ``SloObjective`` (and the
+        per-pool autoscalers) key on. Raises ``ValueError`` (→ 422)
+        when the app has no watchdog."""
         if self._slo is None:
             raise ValueError(
                 "no SLO watchdog on this app — construct "
                 "ServingApp(slo=SloWatchdog([...]))"
             )
-        return self._slo.evaluate()
+        report = self._slo.evaluate()
+        serving = self._serving_percentiles()
+        if serving:
+            report["serving"] = serving
+        return report
+
+    def _serving_percentiles(self) -> dict:
+        """TTFT/ITL percentile rows (exact, over each histogram's
+        retained sample window, merged across label children) and the
+        per-engine goodput ratio gauges — ``{}`` when no serving perf
+        plane has recorded into this app's registry."""
+        out: dict = {}
+        for family in self.registry.collect():
+            if family.name in ("unionml_engine_ttft_ms",
+                               "unionml_engine_itl_ms"):
+                samples: list = []
+                for _values, child in family.children():
+                    samples.extend(child.samples())
+                if samples:
+                    key = ("ttft_ms" if family.name.endswith("ttft_ms")
+                           else "itl_ms")
+                    out[key] = telemetry.percentile_summary(samples)
+            elif family.name == "unionml_serving_goodput_ratio":
+                ratios = {
+                    values[0]: round(child.value, 6)
+                    for values, child in family.children()
+                }
+                if ratios:
+                    out["goodput_ratio"] = ratios
+        return out
+
+    def debug_goodput(self) -> dict:
+        """``GET /debug/goodput``: the serving goodput plane's report —
+        dispatcher-pass classification (full-batch / padded-slot /
+        prefill-mix / idle), goodput + occupancy + KV-pressure ratios,
+        achieved tokens/s alongside the introspection layer's MFU
+        figures, and the perf-regression watchdog advisory. Raises
+        ``ValueError`` (→ 422) when the app has no goodput source (or
+        the engine's plane is off)."""
+        if self._goodput is None:
+            raise ValueError(
+                "no goodput source on this app — construct "
+                "ServingApp(goodput=engine.goodput_report) with a "
+                "perf-enabled engine"
+            )
+        return self._goodput()
+
+    def debug_tail(self, metric: str = "", n: Optional[int] = None) -> dict:
+        """``GET /debug/tail?metric=&n=``: the ``n`` slowest recent
+        requests by exemplar value of one histogram (default
+        ``unionml_engine_decode_ms``), each with its per-phase latency
+        split (queue / admission / prefill / decode / ITL, from the
+        flight recorder's ``finish`` event) and a ``trace`` link whose
+        rid resolves in ``GET /debug/trace?rid=`` — histogram bucket →
+        stitched timeline in one hop. Raises ``ValueError`` (→ 422)
+        for an unknown or non-histogram metric."""
+        name = metric or "unionml_engine_decode_ms"
+        family = next(
+            (f for f in self.registry.collect() if f.name == name), None
+        )
+        if family is None:
+            raise ValueError(
+                f"unknown metric {name!r} (nothing by that name in "
+                "this app's registry)"
+            )
+        if family.kind != "histogram":
+            raise ValueError(
+                f"metric {name!r} is a {family.kind} — tail exemplars "
+                "exist only on histograms"
+            )
+        k = 5 if n is None else max(1, min(64, int(n)))
+        rows = []
+        for values, child in family.children():
+            labels = dict(zip(family.labelnames, values))
+            for value, rid in child.exemplars(k):
+                rows.append({
+                    "rid": rid,
+                    "value_ms": round(value, 3),
+                    "labels": labels,
+                })
+        rows.sort(key=lambda r: r["value_ms"], reverse=True)
+        rows = rows[:k]
+        segment_keys = (
+            "queue_ms", "admission_ms", "prefill_ms", "decode_ms",
+            "ttft_ms", "itl_mean_ms", "itl_tokens", "tokens",
+        )
+        for row in rows:
+            events = self._flight.dump(rid=row["rid"], kind="finish")
+            if events:
+                ev = events[-1]
+                row["segments"] = {
+                    key: ev[key] for key in segment_keys if key in ev
+                }
+            row["trace"] = f"/debug/trace?rid={row['rid']}"
+        return {"metric": name, "n": k, "requests": rows}
 
     def open_traced_request(
         self, path: str, raw_traceparent: Optional[str],
@@ -1072,6 +1182,22 @@ class ServingApp:
                 elif path == "/debug/rollout":
                     try:
                         self._send(200, app.debug_rollout())
+                    except ValueError as exc:
+                        self._send(422, {"error": str(exc)})
+                elif path == "/debug/goodput":
+                    try:
+                        self._send(200, app.debug_goodput())
+                    except ValueError as exc:
+                        self._send(422, {"error": str(exc)})
+                elif path == "/debug/tail":
+                    try:
+                        self._send(200, app.debug_tail(
+                            metric=query.get("metric", [""])[0],
+                            n=(
+                                int(query["n"][0])
+                                if "n" in query else None
+                            ),
+                        ))
                     except ValueError as exc:
                         self._send(422, {"error": str(exc)})
                 else:

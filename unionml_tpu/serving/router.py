@@ -303,6 +303,12 @@ class ReplicaHandle:
         meters nothing)."""
         return None
 
+    def goodput_report(self) -> Optional[dict]:
+        """This replica's ``/debug/goodput`` body — the serving perf
+        plane's batch-occupancy report (``None`` when the replica runs
+        no plane or the fetch failed)."""
+        return None
+
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Finish in-flight work; stop admitting. True when drained."""
         return True
@@ -437,6 +443,12 @@ class EngineReplica(ReplicaHandle):
     def usage_report(self) -> Optional[dict]:
         ledger = self.engine.usage
         return None if ledger is None else ledger.report()
+
+    def goodput_report(self) -> Optional[dict]:
+        try:
+            return self.engine.goodput_report()
+        except ValueError:
+            return None  # plane off on this engine: degrade, don't error
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         return self.engine.drain(timeout)
@@ -767,6 +779,9 @@ class HttpReplica(ReplicaHandle):
 
     def usage_report(self) -> Optional[dict]:
         return self._get_debug_json("/debug/usage")
+
+    def goodput_report(self) -> Optional[dict]:
+        return self._get_debug_json("/debug/goodput")
 
     def cached_prefix_len(self, prompt) -> int:
         """Cache-affinity across hosts: probe the remote transport's
@@ -2930,6 +2945,66 @@ def make_router_app(router: FleetRouter, *, name: str = "fleet-router",
                     "breached": sorted(set(breached)),
                 },
                 "router": router_report,
+                "replicas": replicas,
+            }
+
+        def debug_goodput(self) -> dict:
+            """The fleet ``GET /debug/goodput``: every replica's
+            serving goodput report plus fleet-merged ratios recomputed
+            on the SUMMED slot-step ledgers (a big engine's padding
+            must outweigh a small one's — averaging per-replica ratios
+            would weight them equally). 422 only when no replica runs
+            the perf plane."""
+            replicas: Dict[str, Optional[dict]] = dict(self._fanout(
+                list(self.router.members().items()),
+                lambda h: h.goodput_report(),
+            ))
+            reports = [r for r in replicas.values() if r]
+            if not reports:
+                raise ValueError(
+                    "no serving goodput plane anywhere in the fleet — "
+                    "build the replica engines with DecodeEngine("
+                    "perf=True) (the default while introspect=True)"
+                )
+            passes: Dict[str, int] = {}
+            slot_steps: Dict[str, float] = {}
+            occupied = tokens = tokens_per_s = 0.0
+            reasons: List[str] = []
+            for report in reports:
+                for kind, count in report.get("passes", {}).items():
+                    passes[kind] = passes.get(kind, 0) + int(count)
+                for kind, steps in report.get("slot_steps", {}).items():
+                    slot_steps[kind] = (
+                        slot_steps.get(kind, 0.0) + float(steps)
+                    )
+                occupied += float(report.get("occupied_slot_steps", 0))
+                tokens += float(report.get("tokens", 0))
+                tokens_per_s += float(report.get("tokens_per_s", 0.0))
+                reasons.extend(
+                    (report.get("watchdog") or {}).get("reasons", ())
+                )
+            idle = slot_steps.get("idle", 0.0)
+            dispatched = sum(slot_steps.values()) - idle
+            total = dispatched + idle
+            return {
+                "fleet": {
+                    "replicas": len(reports),
+                    "passes": passes,
+                    "slot_steps": {
+                        k: round(v, 3) for k, v in slot_steps.items()
+                    },
+                    "occupied_slot_steps": round(occupied, 3),
+                    "goodput_ratio": (
+                        round(occupied / total, 6) if total else 0.0
+                    ),
+                    "occupancy_ratio": (
+                        round(occupied / dispatched, 6)
+                        if dispatched else 0.0
+                    ),
+                    "tokens": int(tokens),
+                    "tokens_per_s": round(tokens_per_s, 3),
+                    "regressed": sorted(set(reasons)),
+                },
                 "replicas": replicas,
             }
 

@@ -308,9 +308,18 @@ class Histogram(_Child):
     (capped like the accounting lists it replaces: 10k samples, trimmed
     to the newest 5k) feeds :meth:`summary`'s exact percentiles so
     ``stats()`` output keeps its historical meaning.
+
+    Exemplars (Dapper lineage): an ``observe`` call may attach a
+    request id, kept in a bounded ring of ``(value, exemplar)`` pairs.
+    :meth:`exemplars` returns the largest recent values with their
+    ids, which is how ``GET /debug/tail`` links a p99 spike back to
+    the exact request (``/debug/trace?rid=``) that caused it. The
+    ring is recency-bounded, not value-sorted, so old outliers age
+    out and the view stays "slowest *recent* requests".
     """
 
     WINDOW_CAP = 10_000
+    EXEMPLAR_CAP = 64
 
     def __init__(self, family: "_Family", values: Tuple[str, ...]):
         super().__init__(family, values)
@@ -319,8 +328,9 @@ class Histogram(_Child):
         self._sum = 0.0
         self._count = 0
         self._window: List[float] = []
+        self._exemplars: List[Tuple[float, str]] = []
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         value = float(value)
         idx = bisect.bisect_left(self._bounds, value)
         with self._lock:
@@ -330,6 +340,19 @@ class Histogram(_Child):
             self._window.append(value)
             if len(self._window) > self.WINDOW_CAP:
                 del self._window[: self.WINDOW_CAP // 2]
+            if exemplar is not None:
+                self._exemplars.append((value, str(exemplar)))
+                if len(self._exemplars) > self.EXEMPLAR_CAP:
+                    del self._exemplars[: self.EXEMPLAR_CAP // 2]
+
+    def exemplars(self, n: int = 5) -> List[Tuple[float, str]]:
+        """The ``n`` largest recent ``(value, exemplar)`` pairs,
+        slowest first — the per-series tail view behind
+        ``GET /debug/tail``."""
+        with self._lock:
+            pairs = list(self._exemplars)
+        pairs.sort(key=lambda p: p[0], reverse=True)
+        return pairs[: max(0, int(n))]
 
     @property
     def count(self) -> int:
@@ -360,12 +383,20 @@ class Histogram(_Child):
             return {}
         return percentile_summary(window)
 
+    def samples(self) -> List[float]:
+        """The retained raw-sample window (oldest first) — cross-series
+        percentile reads (e.g. engine ITL merged over its priority
+        children) recompute exact percentiles from these."""
+        with self._lock:
+            return list(self._window)
+
     def reset(self) -> None:
         with self._lock:
             self._counts = [0] * (len(self._bounds) + 1)
             self._sum = 0.0
             self._count = 0
             self._window.clear()
+            self._exemplars.clear()
 
 
 class _Family:
